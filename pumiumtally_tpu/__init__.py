@@ -1,0 +1,36 @@
+"""pumiumtally_tpu — TPU-native unstructured-mesh track-length tally framework.
+
+A ground-up JAX/XLA re-design of the capabilities of PUMI-Tally
+(reference: /root/reference, Fuad-HH/PumiUMTally): GPU-accelerated
+track-length tallies for Monte Carlo neutral-particle transport on
+tetrahedral meshes, re-architected for TPU hardware:
+
+- the Kokkos device layer (reference PumiTallyImpl.cpp:159-193) becomes
+  XLA: jitted kernels, ``jax.device_put`` staging, deterministic
+  scatter-adds instead of ``Kokkos::atomic_add``;
+- the PUMIPic adjacency-walk search (reference PumiTallyImpl.cpp:454)
+  becomes a masked lock-step ``lax.while_loop`` / Pallas kernel over
+  precomputed face-adjacency arrays;
+- the MPI rank parallelism (reference PumiTallyImpl.cpp:111,145) becomes
+  SPMD over a ``jax.sharding.Mesh``: particle batches sharded over the
+  ``dp`` axis, per-element flux reduced with ``psum`` over ICI.
+
+Public surface mirrors the reference's three-call protocol
+(reference PumiTally.h:66-95): ``CopyInitialPosition`` /
+``MoveToNextLocation`` / ``WriteTallyResults``.
+"""
+
+from pumiumtally_tpu.config import TallyConfig
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+from pumiumtally_tpu.mesh.box import build_box
+from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TallyConfig",
+    "TetMesh",
+    "build_box",
+    "PumiTally",
+    "TallyTimes",
+]

@@ -1,0 +1,3 @@
+from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
+
+__all__ = ["PumiTally", "TallyTimes"]

@@ -1,0 +1,329 @@
+"""The three-call public API (reference PumiTally.h:34-107).
+
+``PumiTally`` mirrors the reference's PIMPL facade protocol exactly —
+``CopyInitialPosition`` / ``MoveToNextLocation`` / ``WriteTallyResults``
+— so a physics host app (e.g. the OpenMC ``--ohMesh`` fork,
+reference README.md:84-104) can drive it with flat builtin-typed
+buffers. Internally everything is jitted JAX; host↔device staging goes
+through ``jax.device_put`` in place of the reference's unmanaged-view
+``Kokkos::deep_copy`` (PumiTallyImpl.cpp:223-236).
+
+Semantics preserved from the reference:
+
+- Construction seeds all particles at the centroid of element 0
+  (PumiTallyImpl.cpp:492-528); ``CopyInitialPosition`` then runs one
+  non-tallying search to localize them (PumiTallyImpl.cpp:195-221).
+- ``MoveToNextLocation`` is the two-phase move (PumiTallyImpl.cpp:66-149):
+  phase A relocates flying particles to their (possibly resampled)
+  origins without tallying — the reference does this by zeroing weights
+  (cpp:105) — and holds non-flying particles in place (cpp:100-103);
+  phase B transports flying particles to their destinations, tallying
+  track-length × weight per element.
+- The caller's ``flying`` array is ZEROED after the copy — a documented
+  side effect OpenMC relies on (PumiTallyImpl.cpp:169-172, pinned by
+  test:186-212).
+- Particles leaving the domain clamp to the boundary intersection point
+  and stay "done" for the remainder of that move (vacuum BC,
+  PumiTallyImpl.cpp:256-286).
+- ``WriteTallyResults`` normalizes by element volume only — NOT by total
+  weight; the reference README claims otherwise but its code never uses
+  ``total_initial_weight`` (TODO at PumiTallyImpl.cpp:60,372) — and
+  writes a VTK file with "flux" and "volume" cell data
+  (PumiTallyImpl.cpp:411-416).
+
+Note on the reference's in-repo oracle test: its second move passes the
+ORIGINAL source points as ``particle_origin`` while its expected fluxes
+assume the walk starts from the particles' current committed positions
+(test:318-320 vs test:371-389 — the test is never built by the
+reference's CI due to the PUMITALLYOPENMC_/PUMITALLY_ flag mismatch,
+SURVEY.md §2.1). The production contract — which this class implements —
+is that ``particle_origin`` equals the committed position for continuing
+particles and the resampled birth position for reincarnated ones; our
+parity suite passes correct origins and reproduces the oracle values
+exactly.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu.config import TallyConfig
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+from pumiumtally_tpu.ops.walk import walk
+from pumiumtally_tpu.io.vtk import write_vtk
+
+
+@dataclass
+class TallyTimes:
+    """Per-phase wall-clock accumulation (reference PumiTallyImpl.h:18-27).
+
+    Device work is fenced with ``block_until_ready`` before timestamps —
+    the reference intended ``Kokkos::fence()`` here but its macro name
+    mismatch left timing unfenced (SURVEY.md §5).
+    """
+
+    initialization_time: float = 0.0
+    total_time_to_tally: float = 0.0
+    vtk_file_write_time: float = 0.0
+
+    def print_times(self) -> None:  # reference PrintTimes, PumiTallyImpl.cpp:22-29
+        print()
+        print(f"[TIME] Initialization time     : {self.initialization_time:f} seconds")
+        print(f"[TIME] Total time to tally     : {self.total_time_to_tally:f} seconds")
+        print(f"[TIME] VTK file write time     : {self.vtk_file_write_time:f} seconds")
+        total = (
+            self.initialization_time
+            + self.total_time_to_tally
+            + self.vtk_file_write_time
+        )
+        print(f"[TIME] Total PUMI-Tally time   : {total:f} seconds")
+
+
+@partial(jax.jit, static_argnames=("tol", "max_iters"))
+def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
+    n = x.shape[0]
+    in_flight = jnp.ones((n,), jnp.int8)
+    weight = jnp.zeros((n,), x.dtype)
+    flux = jnp.zeros((mesh.volumes.shape[0],), x.dtype)
+    r = walk(
+        mesh, x, elem, dest, in_flight, weight, flux,
+        tally=False, tol=tol, max_iters=max_iters,
+    )
+    return r.x, r.elem, r.done, r.exited
+
+
+@partial(jax.jit, static_argnames=("tol", "max_iters"))
+def _move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_iters):
+    """One full MoveToNextLocation: phase A (relocate, no tally) then
+    phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149."""
+    in_flight = flying
+    is_flying = in_flight[:, None] == 1
+    # Phase A: flying → walk to origin (no tally); stopped → hold.
+    dest_a = jnp.where(is_flying, origins, x)
+    zero_w = jnp.zeros_like(weights)  # reference zeroes weights, cpp:105
+    ra = walk(
+        mesh, x, elem, dest_a, in_flight, zero_w, flux,
+        tally=False, tol=tol, max_iters=max_iters,
+    )
+    # Phase B: flying → walk to destination with tallying; stopped → hold.
+    dest_b = jnp.where(is_flying, dests, ra.x)
+    rb = walk(
+        mesh, ra.x, ra.elem, dest_b, in_flight, weights, ra.flux,
+        tally=True, tol=tol, max_iters=max_iters,
+    )
+    found_all = jnp.all(ra.done) & jnp.all(rb.done)
+    return rb.x, rb.elem, rb.flux, found_all
+
+
+class PumiTally:
+    """Track-length tally over an unstructured tet mesh — TPU native.
+
+    Args:
+      mesh: a ``TetMesh``, or a mesh file path (``.msh`` Gmsh ASCII or
+        ``.osh`` Omega_h directory — reference ctor takes the ``.osh``
+        path, PumiTally.h:50).
+      num_particles: particle-batch capacity (reference default 1e5,
+        PumiTallyImpl.h:155).
+      config: engine knobs; see ``TallyConfig``.
+    """
+
+    def __init__(
+        self,
+        mesh: Union[TetMesh, str],
+        num_particles: int = 100_000,
+        config: Optional[TallyConfig] = None,
+    ):
+        t0 = time.perf_counter()
+        self.config = config or TallyConfig()
+        if self.config.device_mesh is not None:
+            raise NotImplementedError(
+                "config.device_mesh sharding is not implemented yet"
+            )
+        self.dtype = self.config.resolved_dtype()
+        if isinstance(mesh, str):
+            from pumiumtally_tpu.io.load import load_mesh
+
+            mesh = load_mesh(mesh, dtype=self.dtype)
+        self.mesh = mesh
+        self.num_particles = int(num_particles)
+        self._tol = self.config.resolved_tolerance()
+        self._max_iters = self.config.resolved_max_iters(mesh.nelems)
+        n = self.num_particles
+
+        # Seed every particle at the centroid of element 0, as the
+        # reference does (PumiTallyImpl.cpp:492-528): localization then
+        # happens by walking, with no search tree.
+        c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0).astype(self.dtype)
+        self.x = jnp.broadcast_to(c0, (n, 3))
+        self.elem = jnp.zeros((n,), jnp.int32)
+        self.flux = jnp.zeros((mesh.nelems,), self.dtype)
+        self.iter_count = 0
+        self.is_initialized = False
+        self.tally_times = TallyTimes()
+        jax.block_until_ready(self.x)
+        self.tally_times.initialization_time += time.perf_counter() - t0
+
+    # -- staging helpers -------------------------------------------------
+    def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
+        a = np.asarray(buf, dtype=np.float64).reshape(-1)
+        if size is not None and size != 3 * self.num_particles:
+            raise ValueError(
+                f"size {size} != 3*num_particles {3 * self.num_particles}"
+            )
+        if a.shape[0] < 3 * self.num_particles:
+            raise ValueError(
+                f"position buffer has {a.shape[0]} values, need "
+                f"{3 * self.num_particles}"
+            )
+        a = a[: 3 * self.num_particles]
+        return jnp.asarray(a.reshape(self.num_particles, 3), dtype=self.dtype)
+
+    # -- the three-call protocol ----------------------------------------
+    def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
+        """Localize particles to the host app's sampled source points
+        (reference PumiTally.h:66-67; non-tallying initial search,
+        PumiTallyImpl.cpp:54-64)."""
+        t0 = time.perf_counter()
+        dest = self._as_positions(init_particle_positions, size)
+        self.x, self.elem, done, exited = _localize_step(
+            self.mesh, self.x, self.elem, dest,
+            tol=self._tol, max_iters=self._max_iters,
+        )
+        if self.config.check_found_all:
+            if not bool(jnp.all(done)):
+                print(
+                    "ERROR: Not all particles are found. May need more loops "
+                    "in search"
+                )
+            nex = int(jnp.sum(exited))
+            if nex:
+                # The straight walk from element 0's centroid left the
+                # domain before reaching the source point — happens only on
+                # non-convex geometry, which the reference also requires to
+                # be convex (reference README.md:112-113).
+                print(
+                    f"WARNING: {nex} particles exited the domain during "
+                    "localization (non-convex mesh?); they were clamped to "
+                    "the boundary"
+                )
+        self.is_initialized = True
+        jax.block_until_ready(self.x)
+        self.tally_times.initialization_time += time.perf_counter() - t0
+
+    def MoveToNextLocation(
+        self, particle_origin, particle_destinations, flying, weights,
+        size: Optional[int] = None,
+    ):
+        """Two-phase tracked move (reference PumiTally.h:87-89).
+
+        ``flying`` is zeroed in place after staging, matching the
+        reference's host-side side effect (PumiTallyImpl.cpp:169-172).
+        """
+        if not self.is_initialized:
+            raise RuntimeError(
+                "CopyInitialPosition must be called before MoveToNextLocation "
+                "(reference invariant, PumiTallyImpl.cpp:437-438)"
+            )
+        t0 = time.perf_counter()
+        origins = self._as_positions(particle_origin, size)
+        dests = self._as_positions(particle_destinations, size)
+        n = self.num_particles
+        flying_np = np.asarray(flying)
+        if flying_np.size < n:
+            raise ValueError(
+                f"flying buffer has {flying_np.size} values, need {n}"
+            )
+        weights_np = np.asarray(weights, dtype=np.float64).reshape(-1)
+        if weights_np.size < n:
+            raise ValueError(
+                f"weights buffer has {weights_np.size} values, need {n}"
+            )
+        # Copy BEFORE staging: jnp.asarray on the CPU backend may alias
+        # the caller's buffer zero-copy, and we are about to zero that
+        # buffer in place below — without the copy the staged flags
+        # would be zeroed too and no particle would fly.
+        fly = jnp.asarray(
+            np.array(flying_np.reshape(-1)[:n], dtype=np.int8, copy=True)
+        )
+        w = jnp.asarray(weights_np[:n].copy(), dtype=self.dtype)
+        # Reference zeroes the caller's flying array after copy
+        # (PumiTallyImpl.cpp:169-172) — OpenMC relies on this side
+        # effect. ndarray.flat writes through to the original storage
+        # even when the array is non-contiguous; other mutable buffers
+        # are zeroed by slice/item assignment; buffers we cannot write
+        # get a warning rather than silent skipping.
+        if isinstance(flying, np.ndarray):
+            if flying.flags.writeable:
+                flying.flat[:n] = 0
+            else:
+                warnings.warn(
+                    "flying array is read-only: skipping the in-place "
+                    "zeroing side effect the host protocol specifies"
+                )
+        elif isinstance(flying, list):
+            flying[:n] = [0] * min(n, len(flying))
+        else:
+            try:
+                for i in range(min(n, len(flying))):
+                    flying[i] = 0
+            except (TypeError, ValueError):
+                warnings.warn(
+                    "flying buffer is not writeable: skipping the "
+                    "in-place zeroing side effect the host protocol "
+                    "specifies"
+                )
+
+        self.x, self.elem, self.flux, found_all = _move_step(
+            self.mesh, self.x, self.elem, origins, dests, fly, w, self.flux,
+            tol=self._tol, max_iters=self._max_iters,
+        )
+        self.iter_count += 1
+        if self.config.check_found_all and not bool(found_all):
+            print("ERROR: Not all particles are found. May need more loops in search")
+        jax.block_until_ready(self.flux)
+        self.tally_times.total_time_to_tally += time.perf_counter() - t0
+
+    def WriteTallyResults(self, filename: Optional[str] = None) -> None:
+        """Normalize flux by element volume and write VTK
+        (reference PumiTallyImpl.cpp:151-157, 382-416)."""
+        t0 = time.perf_counter()
+        out = filename or self.config.output_filename
+        normalized = self.normalized_flux()
+        write_vtk(
+            out,
+            np.asarray(self.mesh.coords),
+            np.asarray(self.mesh.tet2vert),
+            cell_data={
+                "flux": np.asarray(normalized),
+                "volume": np.asarray(self.mesh.volumes),
+            },
+        )
+        self.tally_times.vtk_file_write_time += time.perf_counter() - t0
+        self.tally_times.print_times()
+
+    # -- inspection (white-box surface used by the parity suite) ---------
+    def normalized_flux(self) -> jnp.ndarray:
+        """flux / element volume (reference NormalizeFlux,
+        PumiTallyImpl.cpp:382-409 — deliberately NOT divided by total
+        weight, matching the code rather than the README claim)."""
+        return self.flux / self.mesh.volumes
+
+    @property
+    def elem_ids(self) -> np.ndarray:
+        """Current element of each particle (reference
+        ``ParticleTracer::getElementIds``, test:154)."""
+        return np.asarray(self.elem)
+
+    @property
+    def positions(self) -> np.ndarray:
+        """Committed particle positions (reference particle origin
+        segment get<0>, post-search)."""
+        return np.asarray(self.x)

@@ -1,0 +1,76 @@
+"""Runtime configuration.
+
+The reference has no runtime config system — everything is hard-coded:
+tolerance 1e-8 (reference PumiTallyImpl.cpp:51), migration period 100
+(PumiTallyImpl.cpp:111), output name "fluxresult.vtk"
+(PumiTallyImpl.cpp:153), default num_particles 1e5 (PumiTallyImpl.h:155).
+Here those become fields of a small dataclass, per SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def default_float_dtype() -> Any:
+    """f64 when x64 mode is on (parity suites), else f32 (TPU fast path)."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+@dataclasses.dataclass
+class TallyConfig:
+    """Knobs for the tally engine.
+
+    Attributes:
+      tolerance: geometric comparison tolerance for the face-exit test in
+        the walk kernel. ``None`` → 1e-8 in f64 (reference
+        PumiTallyImpl.cpp:51) or 1e-6 in f32.
+      max_iters: hard bound on walk iterations (the reference's search
+        loop bound, whose exhaustion prints "Not all particles are
+        found", PumiTallyImpl.cpp:455-458). ``None`` → heuristic from
+        mesh size at first use.
+      dtype: float dtype for coordinates/flux. ``None`` → f64 if JAX x64
+        is enabled, else f32.
+      check_found_all: if True, device→host sync after each search to
+        warn when particles did not converge (costs a sync; disable for
+        max throughput).
+      migrate_every: particle-migration period in *moves* for the
+        partitioned-mesh mode (reference: ``iter_count % 100 == 0``,
+        PumiTallyImpl.cpp:111).
+      device_mesh: optional ``jax.sharding.Mesh`` with a ``dp`` axis.
+        When set, particle batches are sharded over it and per-element
+        flux is psum-reduced across it (the TPU-native replacement for
+        the reference's MPI rank parallelism, SURVEY.md §2.3).
+      output_filename: default VTK output path (reference hard-codes
+        "fluxresult.vtk", PumiTallyImpl.cpp:153).
+    """
+
+    tolerance: Optional[float] = None
+    max_iters: Optional[int] = None
+    dtype: Any = None
+    check_found_all: bool = True
+    migrate_every: int = 100
+    device_mesh: Optional[jax.sharding.Mesh] = None
+    output_filename: str = "fluxresult.vtk"
+
+    def resolved_dtype(self) -> Any:
+        return self.dtype if self.dtype is not None else default_float_dtype()
+
+    def resolved_tolerance(self) -> float:
+        if self.tolerance is not None:
+            return float(self.tolerance)
+        return 1e-8 if self.resolved_dtype() == jnp.float64 else 1e-6
+
+    def resolved_max_iters(self, nelems: int) -> int:
+        if self.max_iters is not None:
+            return int(self.max_iters)
+        # Safety cap only: the walk's while_loop exits as soon as every
+        # particle is done, so a generous bound costs nothing at runtime.
+        # A straight segment can cross up to O(E) tets on a degenerate /
+        # highly anisotropic mesh, so cap at the element count rather
+        # than an isotropic O(E^(1/3)) guess.
+        return 64 + int(nelems)

@@ -1,0 +1,108 @@
+"""Gmsh ``.msh`` ASCII reader (formats 2.2 and 4.1), tets only.
+
+The reference's mesh pipeline is Gmsh → ``msh2osh`` → ``.osh``
+(reference README.md:115-125); we read the Gmsh file directly and keep
+an ``.osh`` reader separately for meshes already converted.
+Only what the tally needs is parsed: node coordinates and 4-node
+tetrahedra (Gmsh element type 4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def read_gmsh(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (coords[V,3] float64, tet2vert[E,4] int32, 0-based)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    sections = {}
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("$") and not line.startswith("$End"):
+            name = line[1:]
+            j = i + 1
+            while j < len(lines) and lines[j].strip() != f"$End{name}":
+                j += 1
+            sections[name] = lines[i + 1 : j]
+            i = j + 1
+        else:
+            i += 1
+    if "MeshFormat" not in sections:
+        raise ValueError(f"{path}: not a Gmsh mesh (no $MeshFormat)")
+    version = float(sections["MeshFormat"][0].split()[0])
+    if sections["MeshFormat"][0].split()[1] != "0":
+        raise ValueError(f"{path}: binary .msh not supported; export ASCII")
+    if version >= 4.0:
+        return _parse_v4(sections)
+    return _parse_v2(sections)
+
+
+def _parse_v2(sections) -> Tuple[np.ndarray, np.ndarray]:
+    nodes = sections["Nodes"]
+    nn = int(nodes[0])
+    ids = np.empty(nn, np.int64)
+    coords = np.empty((nn, 3), np.float64)
+    for k in range(nn):
+        parts = nodes[1 + k].split()
+        ids[k] = int(parts[0])
+        coords[k] = [float(parts[1]), float(parts[2]), float(parts[3])]
+    remap = {int(v): k for k, v in enumerate(ids)}
+
+    elems = sections["Elements"]
+    ne = int(elems[0])
+    tets: List[List[int]] = []
+    for k in range(ne):
+        parts = elems[1 + k].split()
+        etype = int(parts[1])
+        if etype != 4:  # 4-node tetrahedron
+            continue
+        ntags = int(parts[2])
+        vs = parts[3 + ntags : 7 + ntags]
+        tets.append([remap[int(v)] for v in vs])
+    if not tets:
+        raise ValueError("no tetrahedra (type 4) found in mesh")
+    return coords, np.asarray(tets, np.int32)
+
+
+def _parse_v4(sections) -> Tuple[np.ndarray, np.ndarray]:
+    nodes = sections["Nodes"]
+    header = nodes[0].split()
+    num_blocks, nn = int(header[0]), int(header[1])
+    ids = np.empty(nn, np.int64)
+    coords = np.empty((nn, 3), np.float64)
+    row, k = 1, 0
+    for _ in range(num_blocks):
+        bh = nodes[row].split()
+        nblock = int(bh[3])
+        row += 1
+        for b in range(nblock):
+            ids[k + b] = int(nodes[row + b])
+        row += nblock
+        for b in range(nblock):
+            parts = nodes[row + b].split()
+            coords[k + b] = [float(parts[0]), float(parts[1]), float(parts[2])]
+        row += nblock
+        k += nblock
+    remap = {int(v): i for i, v in enumerate(ids)}
+
+    elems = sections["Elements"]
+    header = elems[0].split()
+    num_blocks = int(header[0])
+    row = 1
+    tets: List[List[int]] = []
+    for _ in range(num_blocks):
+        bh = elems[row].split()
+        etype, nblock = int(bh[2]), int(bh[3])
+        row += 1
+        if etype == 4:
+            for b in range(nblock):
+                parts = elems[row + b].split()
+                tets.append([remap[int(v)] for v in parts[1:5]])
+        row += nblock
+    if not tets:
+        raise ValueError("no tetrahedra (type 4) found in mesh")
+    return coords, np.asarray(tets, np.int32)

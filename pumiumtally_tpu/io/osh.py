@@ -1,0 +1,20 @@
+"""Omega_h ``.osh`` binary directory reader.
+
+The reference constructor takes this format (``Omega_h::binary::read``,
+reference PumiTallyImpl.cpp:562). Planned: parse the directory-of-arrays
+layout (zlib-compressed) for coords and REGION→VERT connectivity.
+Until then this raises with a clear workaround (the ``.msh`` path).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    raise NotImplementedError(
+        f".osh reading not implemented yet ({path!r}); pass the Gmsh .msh "
+        "source mesh instead, or convert with meshio"
+    )

@@ -1,0 +1,82 @@
+"""Legacy VTK (ASCII) writer for tet meshes with cell data.
+
+Replaces ``Omega_h::vtk::write_parallel`` (reference
+PumiTallyImpl.cpp:415). The reference writes Omega_h's .vtu piece
+directory; we write a single legacy-format ``.vtk`` file — readable by
+ParaView/VisIt — carrying the same payload: the mesh plus "flux" and
+"volume" cell arrays (reference tags added at PumiTallyImpl.cpp:407,414).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def write_vtk(
+    path: str,
+    coords: np.ndarray,
+    tet2vert: np.ndarray,
+    cell_data: Optional[Dict[str, np.ndarray]] = None,
+    point_data: Optional[Dict[str, np.ndarray]] = None,
+    title: str = "pumiumtally_tpu flux result",
+) -> None:
+    coords = np.asarray(coords, dtype=np.float64)
+    tet2vert = np.asarray(tet2vert, dtype=np.int64)
+    nv, ne = coords.shape[0], tet2vert.shape[0]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write("# vtk DataFile Version 3.0\n")
+        f.write(title + "\n")
+        f.write("ASCII\nDATASET UNSTRUCTURED_GRID\n")
+        f.write(f"POINTS {nv} double\n")
+        np.savetxt(f, coords, fmt="%.17g")
+        f.write(f"CELLS {ne} {ne * 5}\n")
+        cells = np.hstack([np.full((ne, 1), 4, dtype=np.int64), tet2vert])
+        np.savetxt(f, cells, fmt="%d")
+        f.write(f"CELL_TYPES {ne}\n")
+        np.savetxt(f, np.full(ne, 10, dtype=np.int64), fmt="%d")  # VTK_TETRA
+        if cell_data:
+            f.write(f"CELL_DATA {ne}\n")
+            for name, arr in cell_data.items():
+                arr = np.asarray(arr, dtype=np.float64).reshape(-1)
+                if arr.shape[0] != ne:
+                    raise ValueError(
+                        f"cell data {name!r} has {arr.shape[0]} values, "
+                        f"need {ne}"
+                    )
+                f.write(f"SCALARS {name} double 1\nLOOKUP_TABLE default\n")
+                np.savetxt(f, arr, fmt="%.17g")
+        if point_data:
+            f.write(f"POINT_DATA {nv}\n")
+            for name, arr in point_data.items():
+                arr = np.asarray(arr, dtype=np.float64).reshape(-1)
+                if arr.shape[0] != nv:
+                    raise ValueError(
+                        f"point data {name!r} has {arr.shape[0]} values, "
+                        f"need {nv}"
+                    )
+                f.write(f"SCALARS {name} double 1\nLOOKUP_TABLE default\n")
+                np.savetxt(f, arr, fmt="%.17g")
+
+
+def read_vtk_cell_scalars(path: str, name: str) -> np.ndarray:
+    """Minimal reader for round-trip tests: pull one cell scalar array."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    ncells = None
+    for i, line in enumerate(lines):
+        if line.startswith("CELL_DATA"):
+            ncells = int(line.split()[1])
+        if line.startswith(f"SCALARS {name} ") and ncells is not None:
+            vals: list[float] = []
+            j = i + 2  # skip LOOKUP_TABLE line
+            while len(vals) < ncells:
+                vals.extend(float(v) for v in lines[j].split())
+                j += 1
+            return np.array(vals[:ncells])
+    raise KeyError(f"cell scalar {name!r} not found in {path}")

@@ -1,0 +1,4 @@
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+from pumiumtally_tpu.mesh.box import build_box
+
+__all__ = ["TetMesh", "build_box"]

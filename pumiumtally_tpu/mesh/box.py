@@ -1,0 +1,94 @@
+"""Structured box → tet mesh builder (Kuhn/Freudenthal decomposition).
+
+TPU-native stand-in for ``Omega_h::build_box(world, OMEGA_H_SIMPLEX,
+x,y,z, nx,ny,nz, false)``, which the reference test fixture uses to make
+the 6-tet unit cube oracle mesh (reference
+test/test_pumi_tally_impl_methods.cpp:34-35, 399-400).
+
+Each grid cell is split into the 6 Kuhn simplices, one per permutation
+of the axis order. The local ordering below reproduces the element
+numbering the reference oracles depend on for the 1×1×1 unit cube:
+
+- element 0 has centroid (0.5, 0.75, 0.25)       (test:83)
+- the point (0.1, 0.4, 0.5) lies in element 2    (test:157-159)
+- the +x ray at (y,z)=(0.4,0.5) crosses elements 2→3→4 with segment
+  lengths 0.3 / 0.1 / 0.5                        (test:267-282)
+
+Local tet k of a cell occupies the region where the coordinates sorted
+by the k-th permutation are descending:
+
+  0: y≥x≥z   1: y≥z≥x   2: z≥y≥x   3: z≥x≥y   4: x≥z≥y   5: x≥y≥z
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+
+# Corner index c = ix + 2*iy + 4*iz of the unit cell.
+# Kuhn tet for permutation (p1,p2,p3): corners 0, e_{p1}, e_{p1}+e_{p2}, (1,1,1).
+# Axis unit corners: x → 1, y → 2, z → 4.
+_KUHN_CORNERS = np.array(
+    [
+        [0, 2, 3, 7],  # y≥x≥z  (y,x,z)
+        [0, 2, 6, 7],  # y≥z≥x  (y,z,x)
+        [0, 4, 6, 7],  # z≥y≥x  (z,y,x)
+        [0, 4, 5, 7],  # z≥x≥y  (z,x,y)
+        [0, 1, 5, 7],  # x≥z≥y  (x,z,y)
+        [0, 1, 3, 7],  # x≥y≥z  (x,y,z)
+    ],
+    dtype=np.int32,
+)
+
+
+def box_arrays(
+    lx: float,
+    ly: float,
+    lz: float,
+    nx: int,
+    ny: int,
+    nz: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw (coords, tet2vert) for an nx×ny×nz grid box of size lx×ly×lz."""
+    if min(nx, ny, nz) < 1:
+        raise ValueError("grid divisions must be >= 1")
+    xs = np.linspace(0.0, lx, nx + 1)
+    ys = np.linspace(0.0, ly, ny + 1)
+    zs = np.linspace(0.0, lz, nz + 1)
+    # Vertex id = i + (nx+1)*(j + (ny+1)*k)
+    zz, yy, xx = np.meshgrid(zs, ys, xs, indexing="ij")
+    coords = np.stack([xx, yy, zz], axis=-1).reshape(-1, 3)
+
+    i, j, k = np.meshgrid(
+        np.arange(nx), np.arange(ny), np.arange(nz), indexing="ij"
+    )
+    i = i.transpose(2, 1, 0).reshape(-1)  # cell order: k-major, then j, then i
+    j = j.transpose(2, 1, 0).reshape(-1)
+    k = k.transpose(2, 1, 0).reshape(-1)
+
+    def vid(di: np.ndarray, dj: np.ndarray, dk: np.ndarray) -> np.ndarray:
+        return (i + di) + (nx + 1) * ((j + dj) + (ny + 1) * (k + dk))
+
+    # Cell corner c (bit 0=x, 1=y, 2=z) → global vertex id, [ncells, 8]
+    corners = np.stack(
+        [vid((c >> 0) & 1, (c >> 1) & 1, (c >> 2) & 1) for c in range(8)],
+        axis=1,
+    )
+    tets = corners[:, _KUHN_CORNERS]  # [ncells, 6, 4]
+    return coords, tets.reshape(-1, 4).astype(np.int32)
+
+
+def build_box(
+    lx: float = 1.0,
+    ly: float = 1.0,
+    lz: float = 1.0,
+    nx: int = 1,
+    ny: int = 1,
+    nz: int = 1,
+    dtype: Any = None,
+) -> TetMesh:
+    coords, tet2vert = box_arrays(lx, ly, lz, nx, ny, nz)
+    return TetMesh.from_arrays(coords, tet2vert, dtype=dtype)

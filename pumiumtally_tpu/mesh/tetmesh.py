@@ -1,0 +1,181 @@
+"""Tetrahedral mesh with precomputed walk geometry.
+
+TPU-native replacement for the Omega_h mesh layer (SURVEY.md §1 L1) and
+the PUMIPic picparts wrapper (SURVEY.md §2.2). Where the reference asks
+Omega_h for downward adjacency and simplex geometry on demand
+(``ask_down(REGION, VERT)``, ``simplex_basis`` — reference
+PumiTallyImpl.cpp:384-407), we precompute everything the walk kernel
+needs ONCE on the host and ship it to HBM as flat arrays:
+
+- ``coords[V,3]``        vertex coordinates
+- ``tet2vert[E,4]``      tet connectivity (positively oriented)
+- ``face_normals[E,4,3]`` unit OUTWARD normal of the face opposite each
+                          local vertex
+- ``face_offsets[E,4]``  plane offset: ``n · p`` for any point p on the face
+- ``face_adj[E,4]``      neighbor tet across each face, −1 at the boundary
+                          (replaces PUMIPic's adjacency search structures)
+- ``volumes[E]``         tet volumes (reference NormalizeFlux,
+                          PumiTallyImpl.cpp:382-409)
+
+This turns the per-step ray/tet-face intersection into four dot products
+and a gather — dense, static-shaped work that XLA vectorizes over the
+whole particle batch (no per-particle pointer chasing as in the Kokkos
+implementation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Local face f is the face opposite local vertex f.
+_FACE_OF_VERT = np.array(
+    [[1, 2, 3], [0, 2, 3], [0, 1, 3], [0, 1, 2]], dtype=np.int32
+)
+
+
+def _signed_volumes(coords: np.ndarray, tet2vert: np.ndarray) -> np.ndarray:
+    v = coords[tet2vert]  # [E,4,3]
+    a = v[:, 1] - v[:, 0]
+    b = v[:, 2] - v[:, 0]
+    c = v[:, 3] - v[:, 0]
+    return np.einsum("ei,ei->e", np.cross(a, b), c) / 6.0
+
+
+def _build_face_adjacency(tet2vert: np.ndarray) -> np.ndarray:
+    """face_adj[E,4]: tet across the face opposite local vertex f, or -1.
+
+    Vectorized half-face matching: each tet contributes 4 faces keyed by
+    their sorted global vertex triple; identical keys appearing twice are
+    interior faces shared by two tets.
+    """
+    ne = tet2vert.shape[0]
+    faces = tet2vert[:, _FACE_OF_VERT]  # [E,4,3]
+    keys = np.sort(faces.reshape(-1, 3), axis=1)  # [4E,3]
+    order = np.lexsort((keys[:, 2], keys[:, 1], keys[:, 0]))
+    sk = keys[order]
+    same = np.all(sk[1:] == sk[:-1], axis=1)
+    # owning tet of each half-face, in sorted order
+    owner = order // 4
+    face_adj = np.full(ne * 4, -1, dtype=np.int32)
+    lo = np.nonzero(same)[0]  # sk[lo] == sk[lo+1] → paired half-faces
+    face_adj[order[lo]] = owner[lo + 1]
+    face_adj[order[lo + 1]] = owner[lo]
+    return face_adj.reshape(ne, 4)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class TetMesh:
+    """Immutable tet mesh as a pytree of device arrays."""
+
+    coords: Any  # [V,3] float
+    tet2vert: Any  # [E,4] int32
+    face_normals: Any  # [E,4,3] float, unit outward
+    face_offsets: Any  # [E,4] float
+    face_adj: Any  # [E,4] int32, -1 = boundary
+    volumes: Any  # [E] float
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        children = (
+            self.coords,
+            self.tet2vert,
+            self.face_normals,
+            self.face_offsets,
+            self.face_adj,
+            self.volumes,
+        )
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_arrays(
+        cls, coords: np.ndarray, tet2vert: np.ndarray, dtype: Any = None
+    ) -> "TetMesh":
+        """Build a mesh (host-side precompute) from raw connectivity.
+
+        Reorders each tet for positive orientation, computes outward face
+        planes, face adjacency, and volumes.
+        """
+        if dtype is None:
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        coords = np.asarray(coords, dtype=np.float64)
+        tet2vert = np.array(tet2vert, dtype=np.int32, copy=True)
+        if coords.ndim != 2 or coords.shape[1] != 3:
+            raise ValueError(f"coords must be [V,3], got {coords.shape}")
+        if tet2vert.ndim != 2 or tet2vert.shape[1] != 4:
+            raise ValueError(f"tet2vert must be [E,4], got {tet2vert.shape}")
+
+        # Positive orientation: swap two verts where the signed volume < 0.
+        sv = _signed_volumes(coords, tet2vert)
+        neg = sv < 0
+        tet2vert[neg, 2], tet2vert[neg, 3] = (
+            tet2vert[neg, 3].copy(),
+            tet2vert[neg, 2].copy(),
+        )
+        volumes = _signed_volumes(coords, tet2vert)
+        if np.any(volumes <= 0):
+            bad = int(np.sum(volumes <= 0))
+            raise ValueError(f"{bad} degenerate (zero-volume) tets in mesh")
+
+        v = coords[tet2vert]  # [E,4,3]
+        # Face opposite vertex f: other three vertices.
+        fa = v[:, _FACE_OF_VERT]  # [E,4,3verts,3xyz]
+        e1 = fa[:, :, 1] - fa[:, :, 0]
+        e2 = fa[:, :, 2] - fa[:, :, 0]
+        n = np.cross(e1, e2)  # [E,4,3]
+        # Outward: n · (v_opp - face_point) must be negative.
+        opp = v  # vertex f itself, [E,4,3]
+        s = np.einsum("efc,efc->ef", n, opp - fa[:, :, 0])
+        n = np.where((s > 0)[..., None], -n, n)
+        norm = np.linalg.norm(n, axis=2, keepdims=True)
+        n = n / norm
+        offsets = np.einsum("efc,efc->ef", n, fa[:, :, 0])
+
+        face_adj = _build_face_adjacency(tet2vert)
+
+        return cls(
+            coords=jnp.asarray(coords, dtype=dtype),
+            tet2vert=jnp.asarray(tet2vert),
+            face_normals=jnp.asarray(n, dtype=dtype),
+            face_offsets=jnp.asarray(offsets, dtype=dtype),
+            face_adj=jnp.asarray(face_adj),
+            volumes=jnp.asarray(volumes, dtype=dtype),
+        )
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def nelems(self) -> int:
+        return int(self.tet2vert.shape[0])
+
+    @property
+    def nverts(self) -> int:
+        return int(self.coords.shape[0])
+
+    def centroids(self) -> jnp.ndarray:
+        """Element centroids [E,3] (reference InitializeParticlesInElement0
+        computes the centroid of element 0 this way, PumiTallyImpl.cpp:500-509)."""
+        return jnp.mean(self.coords[self.tet2vert], axis=1)
+
+    def bounding_box(self) -> Tuple[np.ndarray, np.ndarray]:
+        c = np.asarray(self.coords)
+        return c.min(axis=0), c.max(axis=0)
+
+    def astype(self, dtype: Any) -> "TetMesh":
+        return TetMesh(
+            coords=self.coords.astype(dtype),
+            tet2vert=self.tet2vert,
+            face_normals=self.face_normals.astype(dtype),
+            face_offsets=self.face_offsets.astype(dtype),
+            face_adj=self.face_adj,
+            volumes=self.volumes.astype(dtype),
+        )

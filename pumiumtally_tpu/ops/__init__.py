@@ -1,0 +1,4 @@
+from pumiumtally_tpu.ops.walk import WalkResult, walk
+from pumiumtally_tpu.ops import geometry
+
+__all__ = ["WalkResult", "walk", "geometry"]

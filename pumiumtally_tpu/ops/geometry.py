@@ -1,0 +1,75 @@
+"""Pure jnp geometry helpers (simplex volumes, barycentric tests, locate).
+
+Replaces the Omega_h simplex utilities used by the reference
+(``simplex_basis<3,3>`` / ``simplex_size_from_basis``,
+reference PumiTallyImpl.cpp:398-399) with batched, jit-friendly
+equivalents.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def tet_volumes(coords: jnp.ndarray, tet2vert: jnp.ndarray) -> jnp.ndarray:
+    """Signed tet volumes [E] from coords [V,3] and connectivity [E,4]."""
+    v = coords[tet2vert]
+    a = v[:, 1] - v[:, 0]
+    b = v[:, 2] - v[:, 0]
+    c = v[:, 3] - v[:, 0]
+    return jnp.einsum("ei,ei->e", jnp.cross(a, b), c) / 6.0
+
+
+def barycentric(
+    coords: jnp.ndarray, tet2vert: jnp.ndarray, elem: jnp.ndarray, p: jnp.ndarray
+) -> jnp.ndarray:
+    """Barycentric coordinates [N,4] of points p [N,3] w.r.t. tets elem [N]."""
+    v = coords[tet2vert[elem]]  # [N,4,3]
+    a = v[:, 1] - v[:, 0]
+    b = v[:, 2] - v[:, 0]
+    c = v[:, 3] - v[:, 0]
+    d = p - v[:, 0]
+    vol = jnp.einsum("ni,ni->n", jnp.cross(a, b), c)
+    l1 = jnp.einsum("ni,ni->n", jnp.cross(d, b), c) / vol
+    l2 = jnp.einsum("ni,ni->n", jnp.cross(a, d), c) / vol
+    l3 = jnp.einsum("ni,ni->n", jnp.cross(a, b), d) / vol
+    l0 = 1.0 - l1 - l2 - l3
+    return jnp.stack([l0, l1, l2, l3], axis=1)
+
+
+def contains(
+    coords: jnp.ndarray,
+    tet2vert: jnp.ndarray,
+    elem: jnp.ndarray,
+    p: jnp.ndarray,
+    tol: float = 1e-10,
+) -> jnp.ndarray:
+    """Boolean [N]: is point p[n] inside tet elem[n] (within tol)."""
+    lam = barycentric(coords, tet2vert, elem, p)
+    return jnp.all(lam >= -tol, axis=1)
+
+
+def locate_bruteforce(
+    coords: jnp.ndarray, tet2vert: jnp.ndarray, p: jnp.ndarray, tol: float = 1e-10
+) -> jnp.ndarray:
+    """Containing element id [N] for each point, by testing every tet.
+
+    O(N·E) — intended for tests and small meshes only; production
+    localization uses the adjacency walk (reference localizes by walking
+    from element 0's centroid, PumiTallyImpl.cpp:195-221).
+    """
+    ne = tet2vert.shape[0]
+    v = coords[tet2vert]  # [E,4,3]
+    a = v[:, 1] - v[:, 0]
+    b = v[:, 2] - v[:, 0]
+    c = v[:, 3] - v[:, 0]
+    vol = jnp.einsum("ei,ei->e", jnp.cross(a, b), c)  # [E]
+    d = p[:, None, :] - v[None, :, 0, :]  # [N,E,3]
+    l1 = jnp.einsum("nei,ei->ne", jnp.cross(d, b[None]), c) / vol
+    l2 = jnp.einsum("nei,ei->ne", jnp.cross(a[None], d), c) / vol
+    l3 = jnp.einsum("ei,nei->ne", jnp.cross(a, b), d) / vol
+    l0 = 1.0 - l1 - l2 - l3
+    inside = (l0 >= -tol) & (l1 >= -tol) & (l2 >= -tol) & (l3 >= -tol)
+    first = jnp.argmax(inside, axis=1)
+    found = jnp.any(inside, axis=1)
+    return jnp.where(found, first, -1).astype(jnp.int32)

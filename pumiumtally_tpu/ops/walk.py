@@ -1,0 +1,131 @@
+"""The mesh-walk kernel: masked lock-step ray/tet traversal with tallying.
+
+This is the TPU-native equivalent of the PUMIPic ``ParticleTracer::search``
+adjacency walk plus the ``ParticleAtElemBoundary`` handler (SURVEY.md §2.2,
+reference PumiTallyImpl.cpp:297-380 and the make_search_class fork): all
+particles advance one element per iteration of a ``lax.while_loop`` until
+every particle has either reached its destination or left the domain —
+the same lock-step property as the reference's search loop (SURVEY.md
+§3.3), but expressed as dense, static-shaped array ops XLA can fuse.
+
+Per iteration, for every not-done particle:
+  1. gather the 4 face planes + neighbor ids of its current tet
+     (replaces PUMIPic's per-particle adjacency chase),
+  2. exit parameter ``t_f = (off_f − n_f·x) / (n_f·d)`` over faces with
+     ``n_f·d > tol`` — the ray/tet-face intersection (reference fork's
+     search internals; semantics pinned by the oracles in BASELINE.md),
+  3. tally ``‖Δx‖ · weight`` into the current element — the reference's
+     ``EvaluateFlux`` + ``Kokkos::atomic_add`` (PumiTallyImpl.cpp:352-380)
+     becomes a deterministic XLA scatter-add,
+  4. vacuum BC: a particle whose exit face has no neighbor is done and
+     its position clamps to the boundary intersection point — reference
+     ``ApplyVacuumBC`` (PumiTallyImpl.cpp:256-286),
+  5. advance to the neighbor tet — reference ``UpdateCurrentElement``
+     (PumiTallyImpl.cpp:243-254).
+
+Tally on/off is a static flag: the initial localization pass never
+tallies (reference ``is_initial_track``, PumiTallyImpl.cpp:309) and the
+relocate-to-origin phase runs with weights zeroed (PumiTallyImpl.cpp:105);
+here both simply compile a no-tally variant of the loop body.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+
+
+class WalkResult(NamedTuple):
+    """Post-walk particle state.
+
+    ``x`` is the committed position: the destination, clamped to the
+    boundary intersection for particles that left the domain (the
+    reference commits dest→origin after each search; clamp semantics at
+    PumiTallyImpl.cpp:275-281, oracle test:242-245).
+    ``elem`` is the final element (boundary leavers keep the last tet
+    they were in, reference UpdateCurrentElement skips next==-1).
+    """
+
+    x: jnp.ndarray  # [N,3]
+    elem: jnp.ndarray  # [N] int32
+    done: jnp.ndarray  # [N] bool (False = walk iteration cap hit)
+    exited: jnp.ndarray  # [N] bool: finished by leaving the domain (vacuum BC)
+    flux: jnp.ndarray  # [E] accumulated track-length tally
+    iters: jnp.ndarray  # [] int32: iterations taken
+
+
+def walk(
+    mesh: TetMesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    dest: jnp.ndarray,
+    in_flight: jnp.ndarray,
+    weight: jnp.ndarray,
+    flux: jnp.ndarray,
+    *,
+    tally: bool,
+    tol: float,
+    max_iters: int,
+) -> WalkResult:
+    """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
+
+    Particles with ``in_flight == 0`` must be given ``dest == x`` by the
+    caller (hold position — reference PumiTallyImpl.cpp:100-103); they
+    finish on the first iteration with zero tally contribution
+    (EvaluateFlux skips them, PumiTallyImpl.cpp:364).
+    """
+    n = x.shape[0]
+    fdtype = x.dtype
+    one = jnp.asarray(1.0, fdtype)
+    active0 = jnp.zeros((n,), dtype=bool)
+    flying = in_flight.astype(bool)
+
+    def cond(state):
+        it, _x, _elem, done, _exited, _flux = state
+        return (it < max_iters) & jnp.any(~done)
+
+    def body(state):
+        it, x, elem, done, exited, flux = state
+        active = ~done
+        d = dest - x  # remaining segment
+        fn = mesh.face_normals[elem]  # [N,4,3]
+        fo = mesh.face_offsets[elem]  # [N,4]
+        adj = mesh.face_adj[elem]  # [N,4]
+        denom = jnp.einsum("nfc,nc->nf", fn, d)
+        numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
+        crossing = denom > tol
+        t = jnp.where(crossing, numer / jnp.where(crossing, denom, one), jnp.inf)
+        # x may sit epsilon-outside a face after a previous step; don't
+        # step backwards.
+        t = jnp.maximum(t, 0.0)
+        t_exit = jnp.min(t, axis=1)
+        f_exit = jnp.argmin(t, axis=1)
+        # Destination inside the current tet (or no forward crossing at
+        # all, e.g. zero-length segment) → done at dest.
+        reached = t_exit >= one
+        t_step = jnp.where(reached, one, t_exit)
+        x_new = x + t_step[:, None] * d
+        next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
+        hit_boundary = (~reached) & (next_elem == -1)
+
+        if tally:
+            seg = t_step * jnp.linalg.norm(d, axis=1)
+            contrib = jnp.where(active & flying, seg * weight, 0.0)
+            flux = flux.at[elem].add(contrib, mode="drop")
+
+        advance = active & ~reached & ~hit_boundary
+        elem = jnp.where(advance, next_elem, elem)
+        x = jnp.where(active[:, None], x_new, x)
+        done = done | reached | hit_boundary
+        exited = exited | (active & hit_boundary)
+        return it + 1, x, elem, done, exited, flux
+
+    it0 = jnp.asarray(0, jnp.int32)
+    it, x, elem, done, exited, flux = lax.while_loop(
+        cond, body, (it0, x, elem, active0, active0, flux)
+    )
+    return WalkResult(x=x, elem=elem, done=done, exited=exited, flux=flux, iters=it)

@@ -1,0 +1,37 @@
+"""Test harness config: 8 virtual CPU devices + x64.
+
+Must run before jax initializes. The parity oracles are 1e-8-tight
+(reference test/test_pumi_tally_impl_methods.cpp:21-27) so the suite
+runs in f64 on the CPU backend; multi-chip tests use the 8-device
+virtual mesh (SURVEY.md §4: "add what the reference lacks: multi-chip
+tests via 8-device CPU simulation").
+"""
+
+import os
+import sys
+
+# Force (not setdefault): the surrounding environment may point JAX at
+# a remote TPU (JAX_PLATFORMS=axon); the parity suite must run on the
+# local CPU backend with 8 virtual devices regardless. jax may already
+# be *imported* (a sitecustomize can import it at interpreter start) —
+# that is fine as long as no backend has been initialized yet, since
+# XLA_FLAGS and platform selection are read at first backend use.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+from jax._src import xla_bridge  # noqa: E402
+
+if xla_bridge._backends:
+    raise RuntimeError(
+        "tests/conftest.py must run before any jax backend is initialized"
+    )
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,96 @@
+"""API surface tests: VTK output, normalization, timing, config."""
+
+import numpy as np
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+NUM = 5
+
+
+def _run_move1(tally):
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1)).reshape(-1)
+    tally.CopyInitialPosition(init.copy(), 3 * NUM)
+    dests = np.tile([1.2, 0.4, 0.5], (NUM, 1)).reshape(-1)
+    tally.MoveToNextLocation(
+        init.copy(), dests, np.ones(NUM, np.int8), np.ones(NUM), 3 * NUM
+    )
+
+
+def test_write_tally_results(tmp_path, capsys):
+    tally = PumiTally(build_box(1, 1, 1, 1, 1, 1), NUM)
+    _run_move1(tally)
+    out = str(tmp_path / "fluxresult.vtk")
+    tally.WriteTallyResults(out)
+
+    # Normalization: flux / volume (volume = 1/6 per tet). Reference
+    # NormalizeFlux (PumiTallyImpl.cpp:382-409).
+    flux = read_vtk_cell_scalars(out, "flux")
+    vol = read_vtk_cell_scalars(out, "volume")
+    np.testing.assert_allclose(vol, 1.0 / 6.0, atol=1e-12)
+    raw = np.array([0.0, 0.0, 1.5, 0.5, 2.5, 0.0])
+    np.testing.assert_allclose(flux, raw / (1.0 / 6.0), atol=1e-6)
+
+    # Timing report printed (reference PrintTimes at WriteTallyResults,
+    # PumiTally.cpp:59).
+    captured = capsys.readouterr()
+    assert "[TIME] Initialization time" in captured.out
+    assert "[TIME] Total PUMI-Tally time" in captured.out
+    times = tally.tally_times
+    assert times.initialization_time > 0
+    assert times.total_time_to_tally > 0
+    assert times.vtk_file_write_time > 0
+
+
+def test_default_output_filename(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    tally = PumiTally(build_box(1, 1, 1, 1, 1, 1), NUM)
+    _run_move1(tally)
+    tally.WriteTallyResults()  # reference hard-codes fluxresult.vtk (cpp:153)
+    assert (tmp_path / "fluxresult.vtk").exists()
+
+
+def test_size_assertion():
+    import pytest
+
+    tally = PumiTally(build_box(1, 1, 1, 1, 1, 1), NUM)
+    with pytest.raises(ValueError):
+        tally.CopyInitialPosition(np.zeros(3 * NUM), size=7)
+    with pytest.raises(ValueError):
+        tally.CopyInitialPosition(np.zeros(4))  # too short, no size given
+
+
+def test_move_before_init_raises():
+    import pytest
+
+    tally = PumiTally(build_box(1, 1, 1, 1, 1, 1), NUM)
+    z = np.zeros(3 * NUM)
+    with pytest.raises(RuntimeError):
+        # reference invariant: cpp:437-438
+        tally.MoveToNextLocation(z, z, np.zeros(NUM, np.int8), np.zeros(NUM))
+
+
+def test_flying_side_effect_on_list_and_noncontiguous():
+    m = build_box(1, 1, 1, 1, 1, 1)
+    t = PumiTally(m, NUM)
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1)).reshape(-1)
+    t.CopyInitialPosition(init.copy())
+    # list input
+    fly_list = [1] * NUM
+    t.MoveToNextLocation(init.copy(), init.copy(), fly_list, np.ones(NUM))
+    assert fly_list == [0] * NUM
+    # non-contiguous ndarray input (stride 2 view)
+    backing = np.ones(2 * NUM, np.int8)
+    fly_view = backing[::2]
+    t.MoveToNextLocation(init.copy(), init.copy(), fly_view, np.ones(NUM))
+    assert fly_view.sum() == 0
+
+
+def test_flat_and_2d_inputs_equivalent():
+    m = build_box(1, 1, 1, 1, 1, 1)
+    t1 = PumiTally(m, NUM)
+    t2 = PumiTally(m, NUM)
+    init2d = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    t1.CopyInitialPosition(init2d.reshape(-1))
+    t2.CopyInitialPosition(init2d)
+    np.testing.assert_array_equal(t1.elem_ids, t2.elem_ids)

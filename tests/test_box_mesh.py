@@ -1,0 +1,84 @@
+"""Box-mesh fixture geometry checks.
+
+Pins the 6-tet unit-cube decomposition the oracle suite depends on
+(reference builds it with Omega_h::build_box, test:34-35):
+element 0 centroid (0.5, 0.75, 0.25) (test:83) and the element
+containment the rays assume.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu.mesh.box import build_box
+from pumiumtally_tpu.ops import geometry
+
+
+@pytest.fixture(scope="module")
+def cube():
+    return build_box(1, 1, 1, 1, 1, 1)
+
+
+def test_counts(cube):
+    assert cube.nelems == 6
+    assert cube.nverts == 8
+
+
+def test_positive_volumes_sum_to_one(cube):
+    v = np.asarray(cube.volumes)
+    assert np.all(v > 0)
+    np.testing.assert_allclose(v, 1.0 / 6.0, atol=1e-12)
+    np.testing.assert_allclose(v.sum(), 1.0, atol=1e-12)
+
+
+def test_elem0_centroid(cube):
+    # Reference oracle: centroid of element 0 is (0.5, 0.75, 0.25)
+    # (test_pumi_tally_impl_methods.cpp:83).
+    c = np.asarray(cube.centroids())
+    np.testing.assert_allclose(c[0], [0.5, 0.75, 0.25], atol=1e-12)
+
+
+def test_point_containment_matches_oracle(cube):
+    # (0.1,0.4,0.5) in elem 2 (test:157-159); phase-2 destinations in
+    # elems 3 and 4 (test:286-289).
+    pts = np.array(
+        [[0.1, 0.4, 0.5], [0.15, 0.05, 0.2], [0.85, 0.05, 0.1]]
+    )
+    elems = geometry.locate_bruteforce(
+        cube.coords, cube.tet2vert, pts
+    )
+    np.testing.assert_array_equal(np.asarray(elems), [2, 3, 4])
+
+
+def test_face_adjacency_symmetric(cube):
+    adj = np.asarray(cube.face_adj)
+    # Interior faces: neighbor's adjacency must point back.
+    for e in range(6):
+        for f in range(4):
+            nb = adj[e, f]
+            if nb >= 0:
+                assert e in adj[nb], (e, f, nb)
+    # A unit cube of 6 Kuhn tets has 12 boundary half-faces (2 per cube face).
+    assert (adj == -1).sum() == 12
+
+
+def test_outward_normals(cube):
+    # n·(centroid - face_point) < 0 for the tet's own centroid.
+    import numpy as np
+
+    n = np.asarray(cube.face_normals)
+    off = np.asarray(cube.face_offsets)
+    cent = np.asarray(cube.centroids())
+    s = np.einsum("efc,ec->ef", n, cent) - off
+    assert np.all(s < 0)
+
+
+def test_larger_box_adjacency_counts():
+    m = build_box(2.0, 1.0, 3.0, 3, 2, 4)
+    ncells = 3 * 2 * 4
+    assert m.nelems == 6 * ncells
+    v = np.asarray(m.volumes)
+    np.testing.assert_allclose(v.sum(), 2.0 * 1.0 * 3.0, rtol=1e-12)
+    adj = np.asarray(m.face_adj)
+    # boundary faces = 2 triangles per exposed quad
+    nbnd = 2 * 2 * (3 * 2 + 2 * 4 + 3 * 4)
+    assert (adj == -1).sum() == nbnd

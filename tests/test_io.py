@@ -1,0 +1,70 @@
+"""Mesh-file loading tests (.msh v2/v4 → TetMesh → full tally run)."""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally
+from pumiumtally_tpu.io.load import load_mesh
+from pumiumtally_tpu.mesh.box import box_arrays
+
+
+def _write_msh_v2(path, coords, tets):
+    with open(path, "w") as f:
+        f.write("$MeshFormat\n2.2 0 8\n$EndMeshFormat\n$Nodes\n")
+        f.write(f"{len(coords)}\n")
+        for i, (x, y, z) in enumerate(coords, start=1):
+            f.write(f"{i} {x:.17g} {y:.17g} {z:.17g}\n")
+        f.write("$EndNodes\n$Elements\n")
+        f.write(f"{len(tets)}\n")
+        for i, t in enumerate(tets, start=1):
+            f.write(f"{i} 4 2 0 1 {t[0]+1} {t[1]+1} {t[2]+1} {t[3]+1}\n")
+        f.write("$EndElements\n")
+
+
+def _write_msh_v4(path, coords, tets):
+    with open(path, "w") as f:
+        f.write("$MeshFormat\n4.1 0 8\n$EndMeshFormat\n$Nodes\n")
+        nv = len(coords)
+        f.write(f"1 {nv} 1 {nv}\n")
+        f.write(f"3 1 0 {nv}\n")
+        for i in range(1, nv + 1):
+            f.write(f"{i}\n")
+        for x, y, z in coords:
+            f.write(f"{x:.17g} {y:.17g} {z:.17g}\n")
+        f.write("$EndNodes\n$Elements\n")
+        ne = len(tets)
+        f.write(f"1 {ne} 1 {ne}\n")
+        f.write(f"3 1 4 {ne}\n")
+        for i, t in enumerate(tets, start=1):
+            f.write(f"{i} {t[0]+1} {t[1]+1} {t[2]+1} {t[3]+1}\n")
+        f.write("$EndElements\n")
+
+
+@pytest.mark.parametrize("writer", [_write_msh_v2, _write_msh_v4])
+def test_gmsh_round_trip(tmp_path, writer):
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    path = str(tmp_path / "m.msh")
+    writer(path, coords, tets)
+    mesh = load_mesh(path)
+    assert mesh.nelems == 48
+    np.testing.assert_allclose(np.asarray(mesh.volumes).sum(), 1.0, atol=1e-12)
+
+
+def test_pumitally_from_msh_path(tmp_path):
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    path = str(tmp_path / "cube.msh")
+    _write_msh_v2(path, coords, tets)
+    t = PumiTally(path, 5)
+    init = np.tile([0.1, 0.4, 0.5], (5, 1)).reshape(-1)
+    t.CopyInitialPosition(init.copy())
+    np.testing.assert_array_equal(t.elem_ids, np.full(5, 2))
+
+
+def test_osh_clear_error(tmp_path):
+    with pytest.raises((NotImplementedError, FileNotFoundError)):
+        load_mesh(str(tmp_path / "missing.osh"))
+
+
+def test_unknown_format():
+    with pytest.raises(ValueError):
+        load_mesh("mesh.stl")

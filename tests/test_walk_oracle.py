@@ -1,0 +1,153 @@
+"""THE parity suite: the reference's exact-arithmetic flux oracles.
+
+Reproduces the white-box integration test of the reference
+(test/test_pumi_tally_impl_methods.cpp) against our three-call API, with
+the hand-computed expected values from BASELINE.md:
+
+- localization: all particles → element 2 from (0.1,0.4,0.5), flux all
+  zero after the initial search (test:152-170)
+- move 1: ray to (1.2,0.4,0.5) crosses elems 2,3,4 with lengths
+  0.3/0.1/0.5; exits the box → position clamps to x=1.0, element 4
+  (test:221-282)
+- move 2: mixed weights/flying; flux[3] += 0.08790490988459178*2,
+  flux[4] += 0.879049070406094*2 + 0.552268050859363*0.5 (test:361-389)
+
+Note: move 2 passes the particles' CURRENT committed positions
+(1.0,0.4,0.5) as origins — the production contract (see
+api/tally.py docstring); the reference test passes stale origins there
+but was never built by its CI (SURVEY.md §2.1).
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+NUM = 5
+TOL = 1e-8  # reference comparison tolerance (test:21-27)
+
+
+@pytest.fixture()
+def tally():
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    return PumiTally(mesh, NUM, TallyConfig())
+
+
+def _flat(points):
+    return np.ascontiguousarray(np.asarray(points, dtype=np.float64).reshape(-1))
+
+
+def test_initial_seed_at_elem0_centroid(tally):
+    # All particles start at elem 0's centroid (test:81-109).
+    np.testing.assert_allclose(
+        tally.positions, np.tile([0.5, 0.75, 0.25], (NUM, 1)), atol=TOL
+    )
+    np.testing.assert_array_equal(tally.elem_ids, np.zeros(NUM))
+
+
+def test_full_oracle_sequence(tally):
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    tally.CopyInitialPosition(_flat(init), 3 * NUM)
+
+    # -- localization oracle (test:152-170) --
+    np.testing.assert_array_equal(tally.elem_ids, np.full(NUM, 2))
+    np.testing.assert_allclose(np.asarray(tally.flux), 0.0, atol=TOL)
+    np.testing.assert_allclose(tally.positions, init, atol=TOL)
+
+    # -- move 1 (test:176-282) --
+    dests = np.tile([1.2, 0.4, 0.5], (NUM, 1))
+    flying = np.ones(NUM, dtype=np.int8)
+    weights = np.ones(NUM)
+    tally.MoveToNextLocation(_flat(init), _flat(dests), flying, weights, 3 * NUM)
+
+    # flying zeroed in place (test:186-212, reference cpp:169-172)
+    np.testing.assert_array_equal(flying, np.zeros(NUM, dtype=np.int8))
+    # all particles reach element 4 (test:221-228)
+    np.testing.assert_array_equal(tally.elem_ids, np.full(NUM, 4))
+    # boundary clamp to x=1.0 (test:242-245)
+    np.testing.assert_allclose(
+        tally.positions, np.tile([1.0, 0.4, 0.5], (NUM, 1)), atol=TOL
+    )
+    flux = np.asarray(tally.flux)
+    expected1 = np.array([0.0, 0.0, 0.3 * NUM, 0.1 * NUM, 0.5 * NUM, 0.0])
+    np.testing.assert_allclose(flux, expected1, atol=TOL)
+
+    # -- move 2 (test:284-390) --
+    # Origins are the committed positions (production contract).
+    origins = np.tile([1.0, 0.4, 0.5], (NUM, 1))
+    next_pos = np.tile([1.0, 0.4, 0.5], (NUM, 1))
+    flying2 = np.zeros(NUM, dtype=np.int8)
+    weights2 = np.ones(NUM)
+    next_pos[0] = [0.15, 0.05, 0.20]
+    flying2[0], weights2[0] = 1, 2.0
+    next_pos[2] = [0.85, 0.05, 0.10]
+    flying2[2], weights2[2] = 1, 0.5
+
+    tally.MoveToNextLocation(
+        _flat(origins), _flat(next_pos), flying2, weights2, 3 * NUM
+    )
+
+    # new committed positions == destinations (test:323-346)
+    np.testing.assert_allclose(tally.positions, next_pos, atol=TOL)
+    # final elements (test:348-359)
+    np.testing.assert_array_equal(tally.elem_ids, [3, 4, 4, 4, 4])
+
+    flux2 = np.asarray(tally.flux)
+    expected2 = expected1.copy()
+    expected2[3] += 0.08790490988459178 * 2.0
+    expected2[4] += 0.879049070406094 * 2.0 + 0.552268050859363 * 0.5
+    np.testing.assert_allclose(flux2, expected2, atol=TOL)
+
+
+def test_resampled_particle_relocates_without_tally(tally):
+    """Phase A's purpose (reference PumiTally.h:80-86): a reincarnated
+    particle shows up at a new origin; it must relocate there WITHOUT
+    contributing flux, then tally only the origin→destination leg."""
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    tally.CopyInitialPosition(_flat(init), 3 * NUM)
+
+    # Particle 0 is "resampled" far from its current position.
+    origins = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    origins[0] = [0.9, 0.1, 0.05]  # x≥y≥z region → elem 5
+    dests = origins.copy()
+    dests[0] = [0.9, 0.2, 0.05]  # short +y hop staying in elem 5
+    flying = np.zeros(NUM, dtype=np.int8)
+    flying[0] = 1
+    weights = np.ones(NUM)
+    tally.MoveToNextLocation(_flat(origins), _flat(dests), flying, weights, 3 * NUM)
+
+    flux = np.asarray(tally.flux)
+    # Only the tallied leg (length 0.1, weight 1) in elem 5.
+    expected = np.zeros(6)
+    expected[5] = 0.1
+    np.testing.assert_allclose(flux, expected, atol=TOL)
+    np.testing.assert_allclose(tally.positions[0], dests[0], atol=TOL)
+    assert tally.elem_ids[0] == 5
+
+
+def test_nonflying_particles_hold_and_do_not_tally(tally):
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    tally.CopyInitialPosition(_flat(init), 3 * NUM)
+    origins = init.copy()
+    dests = np.tile([0.9, 0.4, 0.5], (NUM, 1))
+    flying = np.zeros(NUM, dtype=np.int8)  # nobody flies
+    weights = np.ones(NUM)
+    tally.MoveToNextLocation(_flat(origins), _flat(dests), flying, weights, 3 * NUM)
+    np.testing.assert_allclose(np.asarray(tally.flux), 0.0, atol=TOL)
+    np.testing.assert_allclose(tally.positions, init, atol=TOL)
+    np.testing.assert_array_equal(tally.elem_ids, np.full(NUM, 2))
+
+
+def test_flux_accumulates_across_moves(tally):
+    init = np.tile([0.2, 0.4, 0.5], (NUM, 1))
+    tally.CopyInitialPosition(_flat(init), 3 * NUM)
+    origins = init.copy()
+    dests = np.tile([0.3, 0.4, 0.5], (NUM, 1))  # stays inside elem 2
+    flying = np.ones(NUM, dtype=np.int8)
+    weights = np.full(NUM, 0.25)
+    tally.MoveToNextLocation(_flat(origins), _flat(dests), flying.copy(), weights, 3 * NUM)
+    tally.MoveToNextLocation(_flat(dests), _flat(init), flying.copy(), weights, 3 * NUM)
+    flux = np.asarray(tally.flux)
+    expected = np.zeros(6)
+    expected[2] = 2 * NUM * 0.1 * 0.25
+    np.testing.assert_allclose(flux, expected, atol=TOL)
