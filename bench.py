@@ -1,0 +1,105 @@
+"""Headline benchmark: particle-move throughput of the tallied walk.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.json configs[0] analogue): a 48k-tet box mesh —
+the scale of the OpenMC pincell's ~10k-tet Gmsh mesh, rounded up — with
+500k particles per batch doing full two-phase MoveToNextLocation steps
+(localize + tallied transport; reference PumiTallyImpl.cpp:66-149).
+``value`` is particle-moves/sec on the default backend (the real TPU
+chip under the driver).
+
+``vs_baseline``: the reference publishes no numbers in-tree
+(BASELINE.md), so the recorded baseline is a measured CPU run of OUR
+engine on the same workload (a stand-in for the reference's
+Kokkos-Serial path, which cannot be built here: its dependency stack
+needs network access). vs_baseline = tpu_rate / cpu_rate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+MESH_DIV = 20  # 20x20x20 cells → 48000 tets
+N = 500_000
+MOVES = 8
+MEAN_STEP = 0.25  # mean segment length: a few tets per move
+
+
+def run_workload(n: int, moves: int) -> float:
+    """Particle-moves/sec for `moves` tallied move steps of n particles."""
+    import jax
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+    mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
+    cfg = TallyConfig(check_found_all=False)
+    t = PumiTally(mesh, n, cfg)
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(0.05, 0.95, (n, 3))
+    t.CopyInitialPosition(pos.reshape(-1).copy())
+
+    def next_dest(p):
+        step = rng.normal(scale=MEAN_STEP / np.sqrt(3.0), size=(n, 3))
+        return np.clip(p + step, 0.0, 1.0)
+
+    # Warmup: compile the move step once.
+    d = next_dest(pos)
+    t.MoveToNextLocation(pos.reshape(-1).copy(), d.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    pos = t.positions.astype(np.float64)
+
+    t0 = time.perf_counter()
+    for _ in range(moves):
+        d = next_dest(pos)
+        t.MoveToNextLocation(pos.reshape(-1).copy(), d.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        pos = t.positions.astype(np.float64)
+    jax.block_until_ready(t.flux)
+    dt = time.perf_counter() - t0
+    return n * moves / dt
+
+
+def main() -> None:
+    if os.environ.get("PUMIUMTALLY_BENCH_CPU") == "1":
+        # Subprocess mode: CPU stand-in baseline, smaller batch.
+        rate = run_workload(N // 10, 4)
+        print(json.dumps({"cpu_rate": rate * 1.0}))
+        return
+
+    rate = run_workload(N, MOVES)
+
+    vs_baseline = None
+    try:
+        env = dict(os.environ)
+        env["PUMIUMTALLY_BENCH_CPU"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        # Don't let the child's interpreter-startup hook try to claim
+        # the TPU tunnel the parent may be holding (it would block).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=1200,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        cpu_rate = json.loads(out.stdout.strip().splitlines()[-1])["cpu_rate"]
+        vs_baseline = rate / cpu_rate
+    except Exception as e:  # noqa: BLE001 — baseline is best-effort
+        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "particle_moves_per_sec",
+        "value": rate,
+        "unit": "moves/s",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,209 @@
+/* C ABI implementation: embeds CPython hosting the JAX tally engine.
+ *
+ * Native-runtime equivalent of the reference's PIMPL facade
+ * (reference src/pumitally/PumiTally.cpp:16-60): the host app sees
+ * builtin-typed C calls; device work happens in the embedded
+ * interpreter (XLA on TPU). Buffers cross the boundary zero-copy as
+ * numpy views over the host pointers — the same trick as the
+ * reference's unmanaged Kokkos views over OpenMC's arrays (reference
+ * PumiTallyImpl.cpp:159-236) — and the Python layer copies them to
+ * device exactly once.
+ *
+ * Interpreter ownership: if the process already runs Python (e.g. the
+ * ctypes test harness), we attach via PyGILState; otherwise we
+ * initialize an interpreter on first create and keep it until process
+ * exit (finalizing JAX's runtime mid-process is not supported).
+ */
+#include "pumiumtally_c.h"
+
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#define PY_ARRAY_UNIQUE_SYMBOL pumiumtally_ARRAY_API
+#include <numpy/arrayobject.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+/* numpy C-API table is per-shared-object; resolve lazily. */
+bool g_numpy_ready = false;
+
+bool ensure_numpy() {
+  if (g_numpy_ready) return true;
+  if (_import_array() < 0) {
+    PyErr_Print();
+    return false;
+  }
+  g_numpy_ready = true;
+  return true;
+}
+
+struct GilGuard {
+  PyGILState_STATE state;
+  GilGuard() : state(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state); }
+};
+
+void ensure_interpreter() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    /* Release the GIL acquired by initialization so GilGuard works
+     * uniformly afterwards. */
+    PyEval_SaveThread();
+  }
+}
+
+PyObject* np_view_1d(void* data, npy_intp n, int typenum, bool writeable) {
+  int flags = NPY_ARRAY_C_CONTIGUOUS | (writeable ? NPY_ARRAY_WRITEABLE : 0);
+  return PyArray_New(&PyArray_Type, 1, &n, typenum, nullptr, data, 0, flags,
+                     nullptr);
+}
+
+int fail_py(const char* what) {
+  std::fprintf(stderr, "[ERROR] pumiumtally: %s failed:\n", what);
+  PyErr_Print();
+  return -1;
+}
+
+}  // namespace
+
+struct pumiumtally_handle {
+  PyObject* tally;  // pumiumtally_tpu.PumiTally instance
+  int32_t num_particles;
+};
+
+extern "C" {
+
+pumiumtally_handle* pumiumtally_create(const char* mesh_filename,
+                                       int32_t num_particles) {
+  ensure_interpreter();
+  GilGuard gil;
+  if (!ensure_numpy()) return nullptr;
+
+  PyObject* mod = PyImport_ImportModule("pumiumtally_tpu");
+  if (!mod) {
+    fail_py("import pumiumtally_tpu");
+    return nullptr;
+  }
+  PyObject* cls = PyObject_GetAttrString(mod, "PumiTally");
+  Py_DECREF(mod);
+  if (!cls) {
+    fail_py("PumiTally lookup");
+    return nullptr;
+  }
+  PyObject* tally = PyObject_CallFunction(cls, "si", mesh_filename,
+                                          (int)num_particles);
+  Py_DECREF(cls);
+  if (!tally) {
+    fail_py("PumiTally()");
+    return nullptr;
+  }
+  auto* h = new pumiumtally_handle{tally, num_particles};
+  return h;
+}
+
+int pumiumtally_copy_initial_position(pumiumtally_handle* h,
+                                      const double* positions,
+                                      int32_t size) {
+  if (!h) return -1;
+  GilGuard gil;
+  PyObject* arr =
+      np_view_1d(const_cast<double*>(positions), size, NPY_DOUBLE, false);
+  if (!arr) return fail_py("position view");
+  PyObject* r = PyObject_CallMethod(h->tally, "CopyInitialPosition", "Oi",
+                                    arr, (int)size);
+  Py_DECREF(arr);
+  if (!r) return fail_py("CopyInitialPosition");
+  Py_DECREF(r);
+  return 0;
+}
+
+int pumiumtally_move_to_next_location(pumiumtally_handle* h,
+                                      const double* origins,
+                                      const double* destinations,
+                                      int8_t* flying,
+                                      const double* weights,
+                                      int32_t size) {
+  if (!h) return -1;
+  GilGuard gil;
+  PyObject* o =
+      np_view_1d(const_cast<double*>(origins), size, NPY_DOUBLE, false);
+  PyObject* d =
+      np_view_1d(const_cast<double*>(destinations), size, NPY_DOUBLE, false);
+  /* flying is writeable: the Python layer zeroes it in place (the
+   * reference's documented side effect, PumiTallyImpl.cpp:169-172). */
+  PyObject* f = np_view_1d(flying, h->num_particles, NPY_INT8, true);
+  PyObject* w = np_view_1d(const_cast<double*>(weights), h->num_particles,
+                           NPY_DOUBLE, false);
+  if (!o || !d || !f || !w) {
+    Py_XDECREF(o);
+    Py_XDECREF(d);
+    Py_XDECREF(f);
+    Py_XDECREF(w);
+    return fail_py("buffer views");
+  }
+  PyObject* r = PyObject_CallMethod(h->tally, "MoveToNextLocation", "OOOOi",
+                                    o, d, f, w, (int)size);
+  Py_DECREF(o);
+  Py_DECREF(d);
+  Py_DECREF(f);
+  Py_DECREF(w);
+  if (!r) return fail_py("MoveToNextLocation");
+  Py_DECREF(r);
+  return 0;
+}
+
+int pumiumtally_write_tally_results(pumiumtally_handle* h,
+                                    const char* filename) {
+  if (!h) return -1;
+  GilGuard gil;
+  PyObject* r;
+  if (filename) {
+    r = PyObject_CallMethod(h->tally, "WriteTallyResults", "s", filename);
+  } else {
+    r = PyObject_CallMethod(h->tally, "WriteTallyResults", nullptr);
+  }
+  if (!r) return fail_py("WriteTallyResults");
+  Py_DECREF(r);
+  return 0;
+}
+
+int64_t pumiumtally_get_flux(pumiumtally_handle* h, double* out,
+                             int64_t capacity) {
+  if (!h) return -1;
+  GilGuard gil;
+  PyObject* flux = PyObject_GetAttrString(h->tally, "flux");
+  if (!flux) return fail_py("flux attr");
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    Py_DECREF(flux);
+    return fail_py("import numpy");
+  }
+  PyObject* dtype = PyObject_GetAttrString(np, "float64");
+  PyObject* asarr =
+      dtype ? PyObject_CallMethod(np, "asarray", "OO", flux, dtype) : nullptr;
+  Py_XDECREF(dtype);
+  Py_DECREF(np);
+  Py_DECREF(flux);
+  if (!asarr) return fail_py("flux asarray");
+  auto* a = reinterpret_cast<PyArrayObject*>(asarr);
+  int64_t n = (int64_t)PyArray_SIZE(a);
+  if (out && capacity >= n) {
+    std::memcpy(out, PyArray_DATA(a), (size_t)n * sizeof(double));
+  }
+  Py_DECREF(asarr);
+  return n;
+}
+
+void pumiumtally_destroy(pumiumtally_handle* h) {
+  if (!h) return;
+  {
+    GilGuard gil;
+    Py_DECREF(h->tally);
+  }
+  delete h;
+}
+
+}  // extern "C"

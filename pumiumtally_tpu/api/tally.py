@@ -100,10 +100,14 @@ def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
     return r.x, r.elem, r.done, r.exited
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iters"))
-def _move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_iters):
+def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_iters):
     """One full MoveToNextLocation: phase A (relocate, no tally) then
-    phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149."""
+    phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149.
+
+    Unjitted and functional — the building block for the jitted
+    single-chip path below, the sharded path in ``parallel.sharded``,
+    and external drivers that want to fuse it into larger programs.
+    """
     in_flight = flying
     is_flying = in_flight[:, None] == 1
     # Phase A: flying → walk to origin (no tally); stopped → hold.
@@ -121,6 +125,9 @@ def _move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max
     )
     found_all = jnp.all(ra.done) & jnp.all(rb.done)
     return rb.x, rb.elem, rb.flux, found_all
+
+
+_move_step = partial(jax.jit, static_argnames=("tol", "max_iters"))(move_step)
 
 
 class PumiTally:
@@ -143,10 +150,7 @@ class PumiTally:
     ):
         t0 = time.perf_counter()
         self.config = config or TallyConfig()
-        if self.config.device_mesh is not None:
-            raise NotImplementedError(
-                "config.device_mesh sharding is not implemented yet"
-            )
+        self.device_mesh = self.config.device_mesh
         self.dtype = self.config.resolved_dtype()
         if isinstance(mesh, str):
             from pumiumtally_tpu.io.load import load_mesh
@@ -157,13 +161,25 @@ class PumiTally:
         self._tol = self.config.resolved_tolerance()
         self._max_iters = self.config.resolved_max_iters(mesh.nelems)
         n = self.num_particles
+        # Internal capacity: padded up to a multiple of the device-mesh
+        # size so the particle axis shards evenly; padded slots always
+        # carry in_flight=0 / dest=x and finish on the first walk
+        # iteration with zero flux contribution.
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import axis_name
+
+            axis_name(self.device_mesh)  # fail fast: must be 1-D
+            ndev = self.device_mesh.devices.size
+            self._cap = -(-n // ndev) * ndev
+        else:
+            self._cap = n
 
         # Seed every particle at the centroid of element 0, as the
         # reference does (PumiTallyImpl.cpp:492-528): localization then
         # happens by walking, with no search tree.
         c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0).astype(self.dtype)
-        self.x = jnp.broadcast_to(c0, (n, 3))
-        self.elem = jnp.zeros((n,), jnp.int32)
+        self.x = jnp.broadcast_to(c0, (self._cap, 3))
+        self.elem = jnp.zeros((self._cap,), jnp.int32)
         self.flux = jnp.zeros((mesh.nelems,), self.dtype)
         self.iter_count = 0
         self.is_initialized = False
@@ -186,17 +202,33 @@ class PumiTally:
         a = a[: 3 * self.num_particles]
         return jnp.asarray(a.reshape(self.num_particles, 3), dtype=self.dtype)
 
+    def _pad_particles(self, a: jnp.ndarray, fill) -> jnp.ndarray:
+        """Extend [n,...] staged data to the internal [cap,...] capacity."""
+        if self._cap == self.num_particles:
+            return a
+        return jnp.concatenate([a, fill[self.num_particles :]], axis=0)
+
     # -- the three-call protocol ----------------------------------------
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
         """Localize particles to the host app's sampled source points
         (reference PumiTally.h:66-67; non-tallying initial search,
         PumiTallyImpl.cpp:54-64)."""
         t0 = time.perf_counter()
-        dest = self._as_positions(init_particle_positions, size)
-        self.x, self.elem, done, exited = _localize_step(
-            self.mesh, self.x, self.elem, dest,
-            tol=self._tol, max_iters=self._max_iters,
+        dest = self._pad_particles(
+            self._as_positions(init_particle_positions, size), self.x
         )
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
+
+            self.x, self.elem, done, exited = sharded_localize_step(
+                self.device_mesh, self.mesh, self.x, self.elem, dest,
+                tol=self._tol, max_iters=self._max_iters,
+            )
+        else:
+            self.x, self.elem, done, exited = _localize_step(
+                self.mesh, self.x, self.elem, dest,
+                tol=self._tol, max_iters=self._max_iters,
+            )
         if self.config.check_found_all:
             if not bool(jnp.all(done)):
                 print(
@@ -281,10 +313,23 @@ class PumiTally:
                     "specifies"
                 )
 
-        self.x, self.elem, self.flux, found_all = _move_step(
-            self.mesh, self.x, self.elem, origins, dests, fly, w, self.flux,
-            tol=self._tol, max_iters=self._max_iters,
-        )
+        origins = self._pad_particles(origins, self.x)
+        dests = self._pad_particles(dests, self.x)
+        fly = self._pad_particles(fly, jnp.zeros((self._cap,), jnp.int8))
+        w = self._pad_particles(w, jnp.zeros((self._cap,), self.dtype))
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import sharded_move_step
+
+            self.x, self.elem, self.flux, found_all = sharded_move_step(
+                self.device_mesh, self.mesh, self.x, self.elem,
+                origins, dests, fly, w, self.flux,
+                tol=self._tol, max_iters=self._max_iters,
+            )
+        else:
+            self.x, self.elem, self.flux, found_all = _move_step(
+                self.mesh, self.x, self.elem, origins, dests, fly, w,
+                self.flux, tol=self._tol, max_iters=self._max_iters,
+            )
         self.iter_count += 1
         if self.config.check_found_all and not bool(found_all):
             print("ERROR: Not all particles are found. May need more loops in search")
@@ -320,10 +365,10 @@ class PumiTally:
     def elem_ids(self) -> np.ndarray:
         """Current element of each particle (reference
         ``ParticleTracer::getElementIds``, test:154)."""
-        return np.asarray(self.elem)
+        return np.asarray(self.elem)[: self.num_particles]
 
     @property
     def positions(self) -> np.ndarray:
         """Committed particle positions (reference particle origin
         segment get<0>, post-search)."""
-        return np.asarray(self.x)
+        return np.asarray(self.x)[: self.num_particles]

@@ -78,10 +78,13 @@ def walk(
     finish on the first iteration with zero tally contribution
     (EvaluateFlux skips them, PumiTallyImpl.cpp:364).
     """
-    n = x.shape[0]
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
-    active0 = jnp.zeros((n,), dtype=bool)
+    # All-False initial done/exited masks, derived from an input so they
+    # carry the same sharding/varying-axis type as the particle arrays
+    # when this runs inside shard_map (a literal zeros() constant would
+    # be "unvarying" and break the while_loop carry typing).
+    active0 = in_flight != in_flight
     flying = in_flight.astype(bool)
 
     def cond(state):

@@ -1,0 +1,23 @@
+"""SPMD parallelism over a ``jax.sharding.Mesh`` of TPU chips.
+
+TPU-native replacement for the reference's MPI rank parallelism
+(SURVEY.md §2.3): where the reference reaches MPI through
+``pumipic::Library`` and the ``search(migrate)`` flag (reference
+PumiTallyImpl.cpp:111,145,454), we shard the particle batch over a
+``dp`` device-mesh axis with ``shard_map``, keep the tet mesh replicated
+per chip (exactly the reference's all-elements-on-rank-0 partition,
+PumiTallyImpl.cpp:530-539, generalized to every chip), and reduce the
+per-element flux with ``psum`` over ICI.
+"""
+
+from pumiumtally_tpu.parallel.device import make_device_mesh
+from pumiumtally_tpu.parallel.sharded import (
+    sharded_localize_step,
+    sharded_move_step,
+)
+
+__all__ = [
+    "make_device_mesh",
+    "sharded_localize_step",
+    "sharded_move_step",
+]

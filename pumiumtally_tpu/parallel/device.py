@@ -1,0 +1,33 @@
+"""Device-mesh construction.
+
+The reference's process topology is implicit in MPI_COMM_WORLD (owned by
+``pumipic::Library``, reference PumiTallyImpl.cpp:238-241); here it is
+an explicit 1-D ``jax.sharding.Mesh`` whose ``dp`` axis shards the
+particle batch. Multi-host pods extend the same mesh over DCN via
+``jax.distributed.initialize()`` — no code change in the kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_device_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = "dp",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))

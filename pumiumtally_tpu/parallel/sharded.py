@@ -1,0 +1,154 @@
+"""shard_map'd walk steps: particles sharded over ``dp``, flux psum'd.
+
+Replicated-mesh data parallelism — the TPU-native form of the
+reference's latent MPI mode (SURVEY.md §2.3): every chip holds the full
+tet mesh (as every reference rank does, PumiTallyImpl.cpp:530-539), each
+chip walks its shard of the particle batch independently (the walk is
+embarrassingly parallel across particles), and the per-element flux is
+all-reduced with ``psum`` over the ICI mesh axis — replacing the
+device-atomic + MPI-reduction combination of the reference
+(Kokkos::atomic_add at PumiTallyImpl.cpp:376; vtk::write_parallel's
+rank-aware output at cpp:415).
+
+The particle-batch size must be divisible by the mesh size; the API
+layer pads its capacity to guarantee this (padded slots carry
+``in_flight=0, dest=x`` and finish on the first walk iteration with
+zero contribution).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+from pumiumtally_tpu.ops.walk import walk
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def _pvary(x, axis_name: str):
+    """Mark a body-constructed constant as varying over the mesh axis
+    (shard_map's while_loop carries require consistent varying types)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))  # pragma: no cover — older jax
+
+
+def axis_name(device_mesh: Mesh) -> str:
+    """The single particle-sharding axis of a 1-D device mesh."""
+    if len(device_mesh.axis_names) != 1:
+        raise ValueError(
+            f"expected a 1-D device mesh, got axes {device_mesh.axis_names}"
+        )
+    return device_mesh.axis_names[0]
+
+
+_axis_name = axis_name
+
+
+@partial(
+    jax.jit,
+    static_argnames=("device_mesh", "tol", "max_iters"),
+)
+def sharded_localize_step(
+    device_mesh: Mesh,
+    mesh: TetMesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    dest: jnp.ndarray,
+    *,
+    tol: float,
+    max_iters: int,
+):
+    """Non-tallying localization walk, particles sharded over ``dp``.
+
+    Returns (x, elem, done, exited) with particle arrays sharded.
+    """
+    ax = _axis_name(device_mesh)
+    pp = P(ax)
+
+    @partial(
+        shard_map,
+        mesh=device_mesh,
+        in_specs=(P(), pp, pp, pp),
+        out_specs=(pp, pp, pp, pp),
+    )
+    def step(mesh_, x_, elem_, dest_):
+        n = x_.shape[0]
+        r = walk(
+            mesh_,
+            x_,
+            elem_,
+            dest_,
+            _pvary(jnp.ones((n,), jnp.int8), ax),
+            _pvary(jnp.zeros((n,), x_.dtype), ax),
+            _pvary(jnp.zeros((mesh_.volumes.shape[0],), x_.dtype), ax),
+            tally=False,
+            tol=tol,
+            max_iters=max_iters,
+        )
+        return r.x, r.elem, r.done, r.exited
+
+    return step(mesh, x, elem, dest)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("device_mesh", "tol", "max_iters"),
+)
+def sharded_move_step(
+    device_mesh: Mesh,
+    mesh: TetMesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    origins: jnp.ndarray,
+    dests: jnp.ndarray,
+    flying: jnp.ndarray,
+    weights: jnp.ndarray,
+    flux: jnp.ndarray,
+    *,
+    tol: float,
+    max_iters: int,
+):
+    """One two-phase MoveToNextLocation over the device mesh.
+
+    Particle arrays are sharded over ``dp``; the tet mesh and the flux
+    array are replicated. Each chip accumulates a local flux delta from
+    zero and the deltas are ``psum``'d over ICI, so the returned flux is
+    identical (and bitwise deterministic) on every chip.
+    """
+    ax = _axis_name(device_mesh)
+    pp = P(ax)
+
+    @partial(
+        shard_map,
+        mesh=device_mesh,
+        in_specs=(P(), pp, pp, pp, pp, pp, pp, P()),
+        out_specs=(pp, pp, P(), P()),
+    )
+    def step(mesh_, x_, elem_, origins_, dests_, fly_, w_, flux_):
+        from pumiumtally_tpu.api.tally import move_step
+
+        # Each shard runs the SAME two-phase move as the single-chip
+        # path, accumulating its local flux delta from a varying zero;
+        # the replicated input flux is added after the psum.
+        zero_flux = _pvary(jnp.zeros_like(flux_), ax)
+        x2, elem2, dflux, local_ok = move_step(
+            mesh_, x_, elem_, origins_, dests_, fly_, w_, zero_flux,
+            tol=tol, max_iters=max_iters,
+        )
+        flux_out = flux_ + lax.psum(dflux, ax)
+        found_all = (
+            lax.psum(local_ok.astype(jnp.int32), ax) == device_mesh.shape[ax]
+        )
+        return x2, elem2, flux_out, found_all
+
+    return step(mesh, x, elem, origins, dests, flying, weights, flux)

@@ -1,0 +1,14 @@
+"""Aux subsystems: logging, profiling, checkpoint/resume (SURVEY.md §5)."""
+
+from pumiumtally_tpu.utils.logging import get_logger, set_verbosity
+from pumiumtally_tpu.utils.profiling import phase_timer, trace
+from pumiumtally_tpu.utils.checkpoint import load_tally_state, save_tally_state
+
+__all__ = [
+    "get_logger",
+    "set_verbosity",
+    "phase_timer",
+    "trace",
+    "save_tally_state",
+    "load_tally_state",
+]

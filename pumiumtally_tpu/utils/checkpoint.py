@@ -1,0 +1,71 @@
+"""Checkpoint/resume of tally state.
+
+The reference has none — its flux lives only in device memory until the
+final VTK write (SURVEY.md §5 "Checkpoint/resume: none"), so a crashed
+run loses the whole tally. Here the complete engine state (flux,
+committed positions, element ids, move counter) round-trips through one
+``.npz`` file; long campaigns checkpoint between MoveToNextLocation
+calls and resume exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def save_tally_state(tally, path: str) -> None:
+    """Write the full engine state of a ``PumiTally`` to ``path``."""
+    np.savez_compressed(
+        path,
+        format_version=np.int64(_FORMAT_VERSION),
+        flux=np.asarray(tally.flux),
+        x=np.asarray(tally.x),
+        elem=np.asarray(tally.elem),
+        iter_count=np.int64(tally.iter_count),
+        num_particles=np.int64(tally.num_particles),
+        capacity=np.int64(tally.x.shape[0]),
+        nelems=np.int64(tally.mesh.nelems),
+        is_initialized=np.bool_(tally.is_initialized),
+    )
+
+
+def load_tally_state(tally, path: str) -> None:
+    """Restore state saved by ``save_tally_state`` into ``tally``.
+
+    The target must be built over the same mesh and particle capacity;
+    mismatches raise rather than silently corrupt the tally.
+    """
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        if int(z["format_version"]) != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {int(z['format_version'])} != "
+                f"{_FORMAT_VERSION}"
+            )
+        if int(z["nelems"]) != tally.mesh.nelems:
+            raise ValueError(
+                f"checkpoint mesh has {int(z['nelems'])} elements, "
+                f"target has {tally.mesh.nelems}"
+            )
+        if int(z["num_particles"]) != tally.num_particles:
+            raise ValueError(
+                f"checkpoint has {int(z['num_particles'])} particles, "
+                f"target has {tally.num_particles}"
+            )
+        # The internal capacity differs across device-mesh configs
+        # (padding to a multiple of the mesh size); restoring across
+        # them would corrupt array shapes.
+        if int(z["capacity"]) != tally._cap:
+            raise ValueError(
+                f"checkpoint particle capacity {int(z['capacity'])} != "
+                f"target capacity {tally._cap} (was it saved under a "
+                "different device_mesh configuration?)"
+            )
+        tally.flux = jnp.asarray(z["flux"], dtype=tally.dtype)
+        tally.x = jnp.asarray(z["x"], dtype=tally.dtype)
+        tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
+        tally.iter_count = int(z["iter_count"])
+        tally.is_initialized = bool(z["is_initialized"])

@@ -1,0 +1,46 @@
+"""Leveled logging with the reference's message style.
+
+The reference logs with raw printf and ``[INFO]``/``[ERROR]``/``[TIME]``
+prefixes and no verbosity control (reference PumiTallyImpl.cpp:23-28,
+292-294, 445, 536). We keep the exact prefix style — host-app log
+scrapers keyed on it keep working — but route through ``logging`` with
+a settable level (env ``PUMIUMTALLY_LOG`` or ``set_verbosity``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER_NAME = "pumiumtally_tpu"
+_PREFIXES = {
+    logging.DEBUG: "[DEBUG]",
+    logging.INFO: "[INFO]",
+    logging.WARNING: "[WARNING]",
+    logging.ERROR: "[ERROR]",
+    logging.CRITICAL: "[CRITICAL]",
+}
+
+
+class _PrefixFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        prefix = _PREFIXES.get(record.levelno, f"[{record.levelname}]")
+        return f"{prefix} {record.getMessage()}"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_PrefixFormatter())
+        logger.addHandler(handler)
+        logger.propagate = False
+        level = os.environ.get("PUMIUMTALLY_LOG", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+    return logger
+
+
+def set_verbosity(level: str) -> None:
+    """'DEBUG' | 'INFO' | 'WARNING' | 'ERROR' | 'CRITICAL'."""
+    get_logger().setLevel(getattr(logging, level.upper()))

@@ -1,0 +1,46 @@
+"""Profiling: fenced phase timers + XLA trace capture.
+
+The reference's instrumentation is wall-clock only, and its intended
+``Kokkos::fence()`` before timestamps never fires due to a macro-name
+mismatch (SURVEY.md §5) — so its device timing is unfenced as shipped.
+Here ``phase_timer`` always fences with ``block_until_ready``, and
+``trace`` wraps ``jax.profiler`` for real XLA timeline capture
+(view with TensorBoard / xprof).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def phase_timer(sink, field: str, fence=None) -> Iterator[None]:
+    """Accumulate fenced wall seconds into ``sink.<field>``.
+
+    ``fence`` is an optional array/pytree to ``block_until_ready``
+    before taking the closing timestamp.
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if fence is not None:
+            jax.block_until_ready(fence)
+        setattr(sink, field, getattr(sink, field) + time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture an XLA profiler trace around the block.
+
+    No-op when log_dir is None so call sites can be left in place.
+    """
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

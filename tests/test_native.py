@@ -1,0 +1,136 @@
+"""C ABI tests: build libpumiumtally_c.so, drive it via ctypes, and run
+the pure-C++ demo host end-to-end.
+
+The ctypes path loads the shared library into this (Python) process —
+exercising the attach-to-existing-interpreter branch — while the demo
+binary embeds its own interpreter the way a physics host app (the
+OpenMC --ohMesh fork, reference README.md:84-104) would.
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "native")
+SO = os.path.join(NATIVE, "libpumiumtally_c.so")
+
+
+def _write_box_msh(path):
+    """Unit-cube 6-tet mesh as Gmsh v2.2 ASCII."""
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    with open(path, "w") as f:
+        f.write("$MeshFormat\n2.2 0 8\n$EndMeshFormat\n$Nodes\n")
+        f.write(f"{len(coords)}\n")
+        for i, (x, y, z) in enumerate(coords, 1):
+            f.write(f"{i} {x} {y} {z}\n")
+        f.write("$EndNodes\n$Elements\n")
+        f.write(f"{len(tets)}\n")
+        for i, t in enumerate(tets, 1):
+            f.write(f"{i} 4 2 0 1 {t[0]+1} {t[1]+1} {t[2]+1} {t[3]+1}\n")
+        f.write("$EndElements\n")
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    r = subprocess.run(
+        ["make", "-C", NATIVE, "-s", f"PY={sys.executable}"],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"native build unavailable: {r.stderr[-500:]}")
+    lib = ctypes.CDLL(SO)
+    lib.pumiumtally_create.restype = ctypes.c_void_p
+    lib.pumiumtally_create.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.pumiumtally_copy_initial_position.restype = ctypes.c_int
+    lib.pumiumtally_copy_initial_position.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int32]
+    lib.pumiumtally_move_to_next_location.restype = ctypes.c_int
+    lib.pumiumtally_move_to_next_location.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int32]
+    lib.pumiumtally_write_tally_results.restype = ctypes.c_int
+    lib.pumiumtally_write_tally_results.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
+    lib.pumiumtally_get_flux.restype = ctypes.c_int64
+    lib.pumiumtally_get_flux.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+    lib.pumiumtally_destroy.restype = None
+    lib.pumiumtally_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _dp(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def test_c_abi_oracle_sequence(native_lib, tmp_path):
+    lib = native_lib
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    n = 5
+    h = lib.pumiumtally_create(msh.encode(), n)
+    assert h, "create failed"
+    try:
+        init = np.tile([0.1, 0.4, 0.5], (n, 1)).reshape(-1)
+        rc = lib.pumiumtally_copy_initial_position(h, _dp(init), 3 * n)
+        assert rc == 0
+
+        dests = np.tile([1.2, 0.4, 0.5], (n, 1)).reshape(-1)
+        flying = np.ones(n, dtype=np.int8)
+        weights = np.ones(n, dtype=np.float64)
+        rc = lib.pumiumtally_move_to_next_location(
+            h, _dp(init), _dp(dests),
+            flying.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            _dp(weights), 3 * n,
+        )
+        assert rc == 0
+        # in-place zeroing crossed the C boundary
+        np.testing.assert_array_equal(flying, np.zeros(n, dtype=np.int8))
+
+        ne = lib.pumiumtally_get_flux(h, None, 0)
+        assert ne == 6
+        flux = np.zeros(ne, dtype=np.float64)
+        lib.pumiumtally_get_flux(h, _dp(flux), ne)
+        np.testing.assert_allclose(
+            flux, [0.0, 0.0, 0.3 * n, 0.1 * n, 0.5 * n, 0.0], atol=1e-8
+        )
+
+        out = str(tmp_path / "flux.vtk")
+        rc = lib.pumiumtally_write_tally_results(h, out.encode())
+        assert rc == 0
+        assert os.path.getsize(out) > 0
+    finally:
+        lib.pumiumtally_destroy(h)
+
+
+def test_cpp_demo_host(native_lib, tmp_path):
+    """Full embedding path: a pure-C++ binary hosts the engine."""
+    r = subprocess.run(
+        ["make", "-C", NATIVE, "-s", "demo", f"PY={sys.executable}"],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"demo build failed: {r.stderr[-500:]}")
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't contend for the TPU tunnel
+    r = subprocess.run(
+        [os.path.join(NATIVE, "demo"), msh, "200"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=300,
+    )
+    assert r.returncode == 0, f"demo failed:\n{r.stdout}\n{r.stderr}"
+    assert "demo OK" in r.stdout
+    assert os.path.exists(str(tmp_path / "demo_fluxresult.vtk"))
