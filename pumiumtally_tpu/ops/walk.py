@@ -9,8 +9,10 @@ the same lock-step property as the reference's search loop (SURVEY.md
 §3.3), but expressed as dense, static-shaped array ops XLA can fuse.
 
 Per iteration, for every not-done particle:
-  1. gather the 4 face planes + neighbor ids of its current tet
-     (replaces PUMIPic's per-particle adjacency chase),
+  1. gather the packed walk row of its current tet — 4 face planes +
+     4 neighbor ids in ONE contiguous [20]-float row (replaces PUMIPic's
+     per-particle adjacency chase; packing measured ~2.6× faster than
+     three separate gathers on TPU),
   2. exit parameter ``t_f = (off_f − n_f·x) / (n_f·d)`` over faces with
      ``n_f·d > tol`` — the ray/tet-face intersection (reference fork's
      search internals; semantics pinned by the oracles in BASELINE.md),
@@ -22,6 +24,17 @@ Per iteration, for every not-done particle:
      ``ApplyVacuumBC`` (PumiTallyImpl.cpp:256-286),
   5. advance to the neighbor tet — reference ``UpdateCurrentElement``
      (PumiTallyImpl.cpp:243-254).
+
+Lock-step waste is bounded by **active-particle compaction**: the walk
+runs as a cascade of stages with halving windows. Each stage iterates
+only over the first W particles; when the number of still-active
+particles drops to the next window size, survivors are sorted to the
+front (stable argsort on the done mask — a deterministic, XLA-friendly
+stand-in for the reference's stream compaction inside PUMIPic's rebuild)
+and the window halves. Without this, every iteration pays for the full
+batch while the slowest particle finishes (reference's search loop has
+the same property, SURVEY.md §3.3); with it, total work approaches
+Σ(per-particle path length) instead of N × max(path length).
 
 Tally on/off is a static flag: the initial localization pass never
 tallies (reference ``is_initial_track``, PumiTallyImpl.cpp:309) and the
@@ -36,7 +49,16 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
-from pumiumtally_tpu.mesh.tetmesh import TetMesh
+from pumiumtally_tpu.mesh.tetmesh import (
+    TetMesh,
+    WALK_TABLE_ADJ,
+    WALK_TABLE_NORMALS,
+    WALK_TABLE_OFFSETS,
+)
+
+# Smallest compaction window: below this, shrinking the batch no longer
+# pays for the sort (and TPU vector units run underutilized anyway).
+_MIN_WINDOW = 8192
 
 
 class WalkResult(NamedTuple):
@@ -58,6 +80,20 @@ class WalkResult(NamedTuple):
     iters: jnp.ndarray  # [] int32: iterations taken
 
 
+def _gather_walk_row(mesh: TetMesh, elem: jnp.ndarray):
+    """(face_normals[N,4,3], face_offsets[N,4], face_adj[N,4]) of each
+    particle's current tet — via the packed single-row gather when the
+    mesh provides it."""
+    if mesh.walk_table is not None:
+        row = mesh.walk_table[elem]  # [N,WALK_TABLE_WIDTH]
+        n = row.shape[0]
+        fn = row[:, WALK_TABLE_NORMALS].reshape(n, 4, 3)
+        fo = row[:, WALK_TABLE_OFFSETS]
+        adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
+        return fn, fo, adj
+    return mesh.face_normals[elem], mesh.face_offsets[elem], mesh.face_adj[elem]
+
+
 def walk(
     mesh: TetMesh,
     x: jnp.ndarray,
@@ -70,6 +106,8 @@ def walk(
     tally: bool,
     tol: float,
     max_iters: int,
+    compact: bool = True,
+    min_window: int = _MIN_WINDOW,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -79,6 +117,7 @@ def walk(
     (EvaluateFlux skips them, PumiTallyImpl.cpp:364).
     """
     fdtype = x.dtype
+    n_total = x.shape[0]
     one = jnp.asarray(1.0, fdtype)
     # All-False initial done/exited masks, derived from an input so they
     # carry the same sharding/varying-axis type as the particle arrays
@@ -87,17 +126,12 @@ def walk(
     active0 = in_flight != in_flight
     flying = in_flight.astype(bool)
 
-    def cond(state):
-        it, _x, _elem, done, _exited, _flux = state
-        return (it < max_iters) & jnp.any(~done)
-
     def body(state):
-        it, x, elem, done, exited, flux = state
+        """One lock-step iteration over a (possibly windowed) batch."""
+        it, x, elem, dest, flying, weight, done, exited, flux = state
         active = ~done
         d = dest - x  # remaining segment
-        fn = mesh.face_normals[elem]  # [N,4,3]
-        fo = mesh.face_offsets[elem]  # [N,4]
-        adj = mesh.face_adj[elem]  # [N,4]
+        fn, fo, adj = _gather_walk_row(mesh, elem)
         denom = jnp.einsum("nfc,nc->nf", fn, d)
         numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
         crossing = denom > tol
@@ -125,10 +159,82 @@ def walk(
         x = jnp.where(active[:, None], x_new, x)
         done = done | reached | hit_boundary
         exited = exited | (active & hit_boundary)
-        return it + 1, x, elem, done, exited, flux
+        return it + 1, x, elem, dest, flying, weight, done, exited, flux
 
     it0 = jnp.asarray(0, jnp.int32)
-    it, x, elem, done, exited, flux = lax.while_loop(
-        cond, body, (it0, x, elem, active0, active0, flux)
+
+    min_window = max(1, min_window)
+    if not compact or n_total <= min_window:
+        def cond(state):
+            it, _x, _elem, _dest, _flying, _weight, done, _exited, _flux = state
+            return (it < max_iters) & jnp.any(~done)
+
+        it, x, elem, _, _, _, done, exited, flux = lax.while_loop(
+            cond, body, (it0, x, elem, dest, flying, weight, active0, active0, flux)
+        )
+        return WalkResult(x=x, elem=elem, done=done, exited=exited, flux=flux, iters=it)
+
+    # ---- compaction cascade --------------------------------------------
+    # Static window schedule: N, N/2, …, down to min_window.
+    windows = [n_total]
+    while windows[-1] > min_window:
+        windows.append(max(min_window, -(-windows[-1] // 2)))
+
+    # Original slot of the particle currently in each row, so the
+    # compaction permutations can be undone at the end.
+    idx = jnp.cumsum(jnp.ones_like(elem)) - 1  # iota, varying under shard_map
+
+    done = active0
+    exited = active0
+    it = it0
+    for si, w in enumerate(windows):
+        nxt = windows[si + 1] if si + 1 < len(windows) else 0
+
+        def cond(state, _w=w, _nxt=nxt):
+            it, _x, _elem, _dest, _flying, _weight, done, _exited, _flux = state
+            n_active = jnp.sum(~done)
+            return (it < max_iters) & (n_active > _nxt)
+
+        head = lambda a: a[:w]  # noqa: E731 — static-size window slice
+        it, xh, eh, _, _, _, dh, exh, flux = lax.while_loop(
+            cond,
+            body,
+            (
+                it, head(x), head(elem), head(dest), head(flying),
+                head(weight), head(done), head(exited), flux,
+            ),
+        )
+        # NOTE: these window write-backs deliberately use concatenate,
+        # NOT `a.at[:w].set(a[:w][perm])`: the in-place form miscompiles
+        # under jit when the dynamic-update-slice is fused with a gather
+        # reading the same buffer (observed on the CPU backend,
+        # jax 0.8.x — duplicated/missing rows). Concatenate forces a
+        # fresh result buffer and costs the same copy.
+        tail = lambda a, h: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
+        x = tail(x, xh)
+        elem = tail(elem, eh)
+        done = tail(done, dh)
+        exited = tail(exited, exh)
+
+        if nxt:
+            # Stable sort on the done mask: survivors (done=False) move
+            # to the front, preserving relative order → deterministic.
+            # Only rows [:w] can be active, so sorting the window alone
+            # suffices and the sort shrinks with the cascade.
+            perm = jnp.argsort(dh, stable=True)
+            upd = lambda a: jnp.concatenate([a[:w][perm], a[w:]], axis=0)  # noqa: E731
+            x = upd(x)
+            elem = upd(elem)
+            dest = upd(dest)
+            flying = upd(flying)
+            weight = upd(weight)
+            done = upd(done)
+            exited = upd(exited)
+            idx = upd(idx)
+
+    # Undo the accumulated permutation: row i holds original slot idx[i].
+    inv = jnp.argsort(idx, stable=True)
+    return WalkResult(
+        x=x[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
+        flux=flux, iters=it,
     )
-    return WalkResult(x=x, elem=elem, done=done, exited=exited, flux=flux, iters=it)

@@ -1,0 +1,107 @@
+"""Microbenchmark the walk kernel's building blocks on the current backend.
+
+Usage: python tools/profile_walk.py [N] [DIV]
+
+Times, per walk iteration equivalent: the [E,4,3] face gather, the
+einsum, the scatter-add tally, a fused single iteration, and the full
+walk — to show where TPU time goes and what a Pallas kernel must beat.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import build_box
+from pumiumtally_tpu.api.tally import _move_step, _localize_step
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+
+def bench(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    mesh = build_box(1.0, 1.0, 1.0, DIV, DIV, DIV)
+    E = mesh.nelems
+    print(f"backend={jax.default_backend()} N={N} E={E} dtype={mesh.coords.dtype}")
+    rng = np.random.default_rng(0)
+    elem = jnp.asarray(rng.integers(0, E, N), jnp.int32)
+    x = jnp.asarray(rng.uniform(0.05, 0.95, (N, 3)), mesh.coords.dtype)
+    d = jnp.asarray(rng.normal(size=(N, 3)) * 0.1, mesh.coords.dtype)
+    w = jnp.ones((N,), mesh.coords.dtype)
+    flux = jnp.zeros((E,), mesh.coords.dtype)
+
+    t = bench(jax.jit(lambda e: (mesh.face_normals[e], mesh.face_offsets[e], mesh.face_adj[e])), elem)
+    print(f"gather fn/fo/adj      : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    if mesh.walk_table is not None:
+        packed = mesh.walk_table
+        t = bench(jax.jit(lambda e: packed[e]), elem)
+        print(f"gather packed [E,20]  : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+    else:
+        print("gather packed [E,20]  : (walk_table unavailable at this E/dtype)")
+
+    fn_ = mesh.face_normals[elem]
+    fo_ = mesh.face_offsets[elem]
+
+    def geom(fn, fo, x, d):
+        denom = jnp.einsum("nfc,nc->nf", fn, d)
+        numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
+        crossing = denom > 1e-6
+        tt = jnp.where(crossing, numer / jnp.where(crossing, denom, 1.0), jnp.inf)
+        tt = jnp.maximum(tt, 0.0)
+        return jnp.min(tt, axis=1), jnp.argmin(tt, axis=1)
+
+    t = bench(jax.jit(geom), fn_, fo_, x, d)
+    print(f"einsum+exit select    : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    t = bench(jax.jit(lambda f, e, c: f.at[e].add(c, mode="drop")), flux, elem, w)
+    print(f"scatter-add flux      : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    # sort-based segment-sum alternative
+    def seg(f, e, c):
+        order = jnp.argsort(e)
+        return f + jax.ops.segment_sum(c[order], e[order], num_segments=E)
+    t = bench(jax.jit(seg), flux, elem, w)
+    print(f"sort+segment_sum      : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    # full localize walk (no tally)
+    dest = jnp.clip(x + d, 0.0, 1.0)
+    f = lambda: _localize_step(mesh, x, elem, dest, tol=1e-6, max_iters=4096)
+    out = f(); jax.block_until_ready(out)
+    t = bench(lambda: f()[0], iters=5, warmup=1)
+    print(f"localize walk         : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    # full two-phase move
+    g = lambda: _move_step(mesh, x, elem, x, dest, jnp.ones((N,), jnp.int8), w,
+                           flux, tol=1e-6, max_iters=4096)
+    out = g(); jax.block_until_ready(out)
+    t = bench(lambda: g()[0], iters=5, warmup=1)
+    print(f"two-phase move        : {t*1e3:8.3f} ms  ({N/t/1e6:8.1f} Mptcl/s)")
+
+    # how many lock-step iterations does the walk actually take?
+    from pumiumtally_tpu.ops.walk import walk
+    r = walk(mesh, x, elem, dest, jnp.ones((N,), jnp.int8), w, flux,
+             tally=True, tol=1e-6, max_iters=4096)
+    print(f"walk iterations       : {int(r.iters)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+N = 10_000
+mesh = build_box(1, 1, 1, 10, 10, 10)
+t = PumiTally(mesh, N)
+rng = np.random.default_rng(42)
+src = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(src.reshape(-1).copy())
+assert (t.elem_ids >= 0).all()
+
+# Move 1: some destinations OUTSIDE the box → clamp + partial lengths.
+dest = rng.uniform(-0.2, 1.2, (N, 3))
+t.MoveToNextLocation(src.reshape(-1).copy(), dest.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+# analytic in-box length per ray (box [0,1]^3), via slab clipping:
+d = dest - src
+with np.errstate(divide="ignore", invalid="ignore"):
+    t_lo = np.where(d != 0, (0.0 - src) / d, -np.inf)
+    t_hi = np.where(d != 0, (1.0 - src) / d, np.inf)
+tmin = np.minimum(t_lo, t_hi).max(axis=1).clip(0, 1)
+tmax = np.maximum(t_lo, t_hi).min(axis=1).clip(0, 1)
+expect = np.linalg.norm(d, axis=1) * np.maximum(tmax - tmin, 0)
+got = float(np.asarray(t.flux).sum())
+rel = abs(got - expect.sum()) / expect.sum()
+print(f"conservation: got={got:.4f} expect={expect.sum():.4f} rel={rel:.2e}")
+assert rel < 1e-4, "track-length conservation failed"
+
+# clamp check: exited particles sit on a box face
+pos = t.positions
+out = (dest < 0) | (dest > 1)
+exited = out.any(axis=1)
+onface = (np.abs(pos) < 1e-4) | (np.abs(pos - 1) < 1e-4)
+assert onface[exited].any(axis=1).all(), "exited particles not clamped to face"
+
+# Move 2: dest == origin → zero new flux
+f0 = np.asarray(t.flux).copy()
+p = t.positions.astype(np.float64)
+t.MoveToNextLocation(p.reshape(-1).copy(), p.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+assert np.allclose(np.asarray(t.flux), f0, atol=1e-4), "dest==origin added flux"
+
+# max_iters=2 → warning, no hang
+t2 = PumiTally(mesh, 100, TallyConfig(max_iters=2))
+s2 = rng.uniform(0.05, 0.95, (100, 3))
+t2.CopyInitialPosition(s2.reshape(-1).copy())
+print("max_iters=2 probe done (expect warning above)")
+
+# read-only flying → warning not crash
+t3 = PumiTally(mesh, 100)
+s3 = rng.uniform(0.05, 0.95, (100, 3))
+t3.CopyInitialPosition(s3.reshape(-1).copy())
+fly_ro = np.ones(100, np.int8); fly_ro.setflags(write=False)
+import warnings
+with warnings.catch_warnings(record=True) as wlist:
+    warnings.simplefilter("always")
+    t3.MoveToNextLocation(s3.reshape(-1).copy(), s3.reshape(-1).copy(),
+                          fly_ro, np.ones(100))
+assert any("read-only" in str(w.message) for w in wlist)
+print("read-only flying probe ok")
+
+t.WriteTallyResults("/tmp/fluxresult.vtk")
+print("VTK head:", open("/tmp/fluxresult.vtk").readline().strip())
+print("VERIFY DRIVE OK")
