@@ -100,6 +100,23 @@ def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
     return r.x, r.elem, r.done, r.exited
 
 
+def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol, max_iters):
+    """Phase-B-only move: transport from the COMMITTED state straight to
+    the destinations, tallying. Semantically identical to ``move_step``
+    when the caller's origins equal the committed positions — the common
+    case for continuing particles (the reference's phase A then walks
+    zero distance, PumiTallyImpl.cpp:88-109). Skipping it halves the
+    device work and the host→device staging; a TPU-native extension, not
+    part of the reference's 3-call protocol."""
+    is_flying = flying[:, None] == 1
+    dest_b = jnp.where(is_flying, dests, x)  # stopped → hold (cpp:100-103)
+    rb = walk(
+        mesh, x, elem, dest_b, flying, weights, flux,
+        tally=True, tol=tol, max_iters=max_iters,
+    )
+    return rb.x, rb.elem, rb.flux, jnp.all(rb.done)
+
+
 def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_iters):
     """One full MoveToNextLocation: phase A (relocate, no tally) then
     phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149.
@@ -117,17 +134,18 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_
         mesh, x, elem, dest_a, in_flight, zero_w, flux,
         tally=False, tol=tol, max_iters=max_iters,
     )
-    # Phase B: flying → walk to destination with tallying; stopped → hold.
-    dest_b = jnp.where(is_flying, dests, ra.x)
-    rb = walk(
-        mesh, ra.x, ra.elem, dest_b, in_flight, weights, ra.flux,
-        tally=True, tol=tol, max_iters=max_iters,
+    # Phase B is exactly the continue-mode move from the relocated state.
+    x2, elem2, flux2, ok_b = move_step_continue(
+        mesh, ra.x, ra.elem, dests, flying, weights, ra.flux,
+        tol=tol, max_iters=max_iters,
     )
-    found_all = jnp.all(ra.done) & jnp.all(rb.done)
-    return rb.x, rb.elem, rb.flux, found_all
+    return x2, elem2, flux2, jnp.all(ra.done) & ok_b
 
 
 _move_step = partial(jax.jit, static_argnames=("tol", "max_iters"))(move_step)
+_move_step_continue = partial(
+    jax.jit, static_argnames=("tol", "max_iters")
+)(move_step_continue)
 
 
 class PumiTally:
@@ -200,7 +218,12 @@ class PumiTally:
                 f"{3 * self.num_particles}"
             )
         a = a[: 3 * self.num_particles]
-        return jnp.asarray(a.reshape(self.num_particles, 3), dtype=self.dtype)
+        # Cast on the host with numpy BEFORE handing to jax: letting
+        # jnp.asarray do the f64→f32 conversion goes through a slow
+        # backend path (measured ~100× slower than a numpy pre-cast
+        # followed by a plain transfer).
+        host = np.asarray(a.reshape(self.num_particles, 3), dtype=np.dtype(self.dtype))
+        return jnp.asarray(host)
 
     def _pad_particles(self, a: jnp.ndarray, fill) -> jnp.ndarray:
         """Extend [n,...] staged data to the internal [cap,...] capacity."""
@@ -251,13 +274,25 @@ class PumiTally:
         self.tally_times.initialization_time += time.perf_counter() - t0
 
     def MoveToNextLocation(
-        self, particle_origin, particle_destinations, flying, weights,
+        self, particle_origin, particle_destinations, flying=None, weights=None,
         size: Optional[int] = None,
     ):
         """Two-phase tracked move (reference PumiTally.h:87-89).
 
         ``flying`` is zeroed in place after staging, matching the
         reference's host-side side effect (PumiTallyImpl.cpp:169-172).
+
+        TPU-native extensions beyond the reference protocol (each skips
+        host→device staging, the scarce resource when the physics app
+        drives the tally from a remote host):
+
+        - ``particle_origin=None``: continue from the committed
+          positions — valid whenever no particle was resampled since the
+          last move (then the reference's phase A walks zero distance,
+          PumiTallyImpl.cpp:88-109); phase A is skipped entirely.
+        - ``flying=None``: every particle is in flight; no host-side
+          zeroing side effect is performed (there is no buffer to zero).
+        - ``weights=None``: unit weights.
         """
         if not self.is_initialized:
             raise RuntimeError(
@@ -265,27 +300,40 @@ class PumiTally:
                 "(reference invariant, PumiTallyImpl.cpp:437-438)"
             )
         t0 = time.perf_counter()
-        origins = self._as_positions(particle_origin, size)
+        origins = (
+            None
+            if particle_origin is None
+            else self._as_positions(particle_origin, size)
+        )
         dests = self._as_positions(particle_destinations, size)
         n = self.num_particles
-        flying_np = np.asarray(flying)
-        if flying_np.size < n:
-            raise ValueError(
-                f"flying buffer has {flying_np.size} values, need {n}"
+        if flying is None:
+            fly = jnp.ones((n,), jnp.int8)
+        else:
+            flying_np = np.asarray(flying)
+            if flying_np.size < n:
+                raise ValueError(
+                    f"flying buffer has {flying_np.size} values, need {n}"
+                )
+            # Copy BEFORE staging: jnp.asarray on the CPU backend may
+            # alias the caller's buffer zero-copy, and we are about to
+            # zero that buffer in place below — without the copy the
+            # staged flags would be zeroed too and no particle would fly.
+            fly = jnp.asarray(
+                np.array(flying_np.reshape(-1)[:n], dtype=np.int8, copy=True)
             )
-        weights_np = np.asarray(weights, dtype=np.float64).reshape(-1)
-        if weights_np.size < n:
-            raise ValueError(
-                f"weights buffer has {weights_np.size} values, need {n}"
+        if weights is None:
+            w = jnp.ones((n,), self.dtype)
+        else:
+            weights_np = np.asarray(weights, dtype=np.float64).reshape(-1)
+            if weights_np.size < n:
+                raise ValueError(
+                    f"weights buffer has {weights_np.size} values, need {n}"
+                )
+            # numpy pre-cast before transfer — see _as_positions.
+            w = jnp.asarray(
+                np.asarray(weights_np[:n], dtype=np.dtype(self.dtype))
             )
-        # Copy BEFORE staging: jnp.asarray on the CPU backend may alias
-        # the caller's buffer zero-copy, and we are about to zero that
-        # buffer in place below — without the copy the staged flags
-        # would be zeroed too and no particle would fly.
-        fly = jnp.asarray(
-            np.array(flying_np.reshape(-1)[:n], dtype=np.int8, copy=True)
-        )
-        w = jnp.asarray(weights_np[:n].copy(), dtype=self.dtype)
         # Reference zeroes the caller's flying array after copy
         # (PumiTallyImpl.cpp:169-172) — OpenMC relies on this side
         # effect. ndarray.flat writes through to the original storage
@@ -302,7 +350,7 @@ class PumiTally:
                 )
         elif isinstance(flying, list):
             flying[:n] = [0] * min(n, len(flying))
-        else:
+        elif flying is not None:
             try:
                 for i in range(min(n, len(flying))):
                     flying[i] = 0
@@ -313,23 +361,38 @@ class PumiTally:
                     "specifies"
                 )
 
-        origins = self._pad_particles(origins, self.x)
         dests = self._pad_particles(dests, self.x)
         fly = self._pad_particles(fly, jnp.zeros((self._cap,), jnp.int8))
         w = self._pad_particles(w, jnp.zeros((self._cap,), self.dtype))
+        if origins is not None:
+            origins = self._pad_particles(origins, self.x)
         if self.device_mesh is not None:
-            from pumiumtally_tpu.parallel.sharded import sharded_move_step
+            from pumiumtally_tpu.parallel.sharded import (
+                sharded_move_step,
+                sharded_move_step_continue,
+            )
 
-            self.x, self.elem, self.flux, found_all = sharded_move_step(
-                self.device_mesh, self.mesh, self.x, self.elem,
-                origins, dests, fly, w, self.flux,
-                tol=self._tol, max_iters=self._max_iters,
+            if origins is None:
+                step = partial(
+                    sharded_move_step_continue, self.device_mesh, self.mesh,
+                    self.x, self.elem, dests,
+                )
+            else:
+                step = partial(
+                    sharded_move_step, self.device_mesh, self.mesh,
+                    self.x, self.elem, origins, dests,
+                )
+        elif origins is None:
+            step = partial(
+                _move_step_continue, self.mesh, self.x, self.elem, dests
             )
         else:
-            self.x, self.elem, self.flux, found_all = _move_step(
-                self.mesh, self.x, self.elem, origins, dests, fly, w,
-                self.flux, tol=self._tol, max_iters=self._max_iters,
+            step = partial(
+                _move_step, self.mesh, self.x, self.elem, origins, dests
             )
+        self.x, self.elem, self.flux, found_all = step(
+            fly, w, self.flux, tol=self._tol, max_iters=self._max_iters
+        )
         self.iter_count += 1
         if self.config.check_found_all and not bool(found_all):
             print("ERROR: Not all particles are found. May need more loops in search")

@@ -100,6 +100,40 @@ def sharded_localize_step(
     return step(mesh, x, elem, dest)
 
 
+def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux, tol, max_iters):
+    """Common shard_map scaffold for the tallied move variants.
+
+    ``particle_args`` are sharded over the particle axis; the tet mesh
+    and the flux array are replicated. Each chip runs ``step_fn`` (a
+    single-chip move from api.tally) on its shard, accumulating a local
+    flux delta from a varying zero; deltas are ``psum``'d over ICI, so
+    the returned flux is identical (and bitwise deterministic) on every
+    chip. ``found_all`` is the all-chips AND of per-shard convergence.
+    """
+    ax = _axis_name(device_mesh)
+    pp = P(ax)
+
+    @partial(
+        shard_map,
+        mesh=device_mesh,
+        in_specs=(P(),) + (pp,) * len(particle_args) + (P(),),
+        out_specs=(pp, pp, P(), P()),
+    )
+    def step(mesh_, *rest):
+        *pargs, flux_ = rest
+        zero_flux = _pvary(jnp.zeros_like(flux_), ax)
+        x2, elem2, dflux, local_ok = step_fn(
+            mesh_, *pargs, zero_flux, tol=tol, max_iters=max_iters
+        )
+        flux_out = flux_ + lax.psum(dflux, ax)
+        found_all = (
+            lax.psum(local_ok.astype(jnp.int32), ax) == device_mesh.shape[ax]
+        )
+        return x2, elem2, flux_out, found_all
+
+    return step(mesh, *particle_args, flux)
+
+
 @partial(
     jax.jit,
     static_argnames=("device_mesh", "tol", "max_iters"),
@@ -118,37 +152,38 @@ def sharded_move_step(
     tol: float,
     max_iters: int,
 ):
-    """One two-phase MoveToNextLocation over the device mesh.
+    """One two-phase MoveToNextLocation over the device mesh."""
+    from pumiumtally_tpu.api.tally import move_step
 
-    Particle arrays are sharded over ``dp``; the tet mesh and the flux
-    array are replicated. Each chip accumulates a local flux delta from
-    zero and the deltas are ``psum``'d over ICI, so the returned flux is
-    identical (and bitwise deterministic) on every chip.
-    """
-    ax = _axis_name(device_mesh)
-    pp = P(ax)
-
-    @partial(
-        shard_map,
-        mesh=device_mesh,
-        in_specs=(P(), pp, pp, pp, pp, pp, pp, P()),
-        out_specs=(pp, pp, P(), P()),
+    return _sharded_tally_step(
+        device_mesh, move_step, mesh,
+        (x, elem, origins, dests, flying, weights), flux, tol, max_iters,
     )
-    def step(mesh_, x_, elem_, origins_, dests_, fly_, w_, flux_):
-        from pumiumtally_tpu.api.tally import move_step
 
-        # Each shard runs the SAME two-phase move as the single-chip
-        # path, accumulating its local flux delta from a varying zero;
-        # the replicated input flux is added after the psum.
-        zero_flux = _pvary(jnp.zeros_like(flux_), ax)
-        x2, elem2, dflux, local_ok = move_step(
-            mesh_, x_, elem_, origins_, dests_, fly_, w_, zero_flux,
-            tol=tol, max_iters=max_iters,
-        )
-        flux_out = flux_ + lax.psum(dflux, ax)
-        found_all = (
-            lax.psum(local_ok.astype(jnp.int32), ax) == device_mesh.shape[ax]
-        )
-        return x2, elem2, flux_out, found_all
 
-    return step(mesh, x, elem, origins, dests, flying, weights, flux)
+@partial(
+    jax.jit,
+    static_argnames=("device_mesh", "tol", "max_iters"),
+)
+def sharded_move_step_continue(
+    device_mesh: Mesh,
+    mesh: TetMesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    dests: jnp.ndarray,
+    flying: jnp.ndarray,
+    weights: jnp.ndarray,
+    flux: jnp.ndarray,
+    *,
+    tol: float,
+    max_iters: int,
+):
+    """Phase-B-only sharded move: transport straight from the committed
+    (sharded) state — the ``origins=None`` fast path of the API (see
+    ``api.tally.move_step_continue``)."""
+    from pumiumtally_tpu.api.tally import move_step_continue
+
+    return _sharded_tally_step(
+        device_mesh, move_step_continue, mesh,
+        (x, elem, dests, flying, weights), flux, tol, max_iters,
+    )

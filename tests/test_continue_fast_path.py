@@ -1,0 +1,74 @@
+"""origins=None / flying=None / weights=None fast paths ≡ explicit args.
+
+The continue-mode move (a TPU-native extension; see api/tally.py) must
+produce exactly the state the full two-phase move produces when the
+caller's origins equal the committed positions.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.parallel import make_device_mesh
+
+N = 2000
+
+
+def _mk(device_mesh=None):
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    cfg = TallyConfig(device_mesh=device_mesh)
+    t = PumiTally(mesh, N, cfg)
+    rng = np.random.default_rng(7)
+    src = rng.uniform(0.05, 0.95, (N, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    return t, rng
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_continue_matches_explicit_origins(sharded):
+    dm = make_device_mesh(8) if sharded else None
+    ta, rng_a = _mk(dm)
+    tb, rng_b = _mk(dm)
+    dest = rng_a.uniform(0.05, 0.95, (N, 3))
+    rng_b.uniform(0.05, 0.95, (N, 3))  # keep rngs aligned
+    fly = np.ones(N, np.int8)
+    w = rng_a.uniform(0.5, 2.0, N)
+    rng_b.uniform(0.5, 2.0, N)
+
+    # explicit: origins == committed positions
+    pos = ta.positions.astype(np.float64)
+    ta.MoveToNextLocation(pos.reshape(-1).copy(), dest.reshape(-1).copy(),
+                          fly.copy(), w)
+    # fast path
+    tb.MoveToNextLocation(None, dest.reshape(-1).copy(), fly.copy(), w)
+
+    np.testing.assert_allclose(ta.positions, tb.positions, atol=1e-13)
+    np.testing.assert_array_equal(ta.elem_ids, tb.elem_ids)
+    np.testing.assert_allclose(
+        np.asarray(ta.flux), np.asarray(tb.flux), rtol=1e-12, atol=1e-13
+    )
+
+
+def test_none_flying_and_weights_mean_all_fly_unit_weight():
+    ta, rng_a = _mk()
+    tb, rng_b = _mk()
+    dest = rng_a.uniform(0.05, 0.95, (N, 3))
+    rng_b.uniform(0.05, 0.95, (N, 3))
+    pos = ta.positions.astype(np.float64)
+    ta.MoveToNextLocation(pos.reshape(-1).copy(), dest.reshape(-1).copy(),
+                          np.ones(N, np.int8), np.ones(N))
+    tb.MoveToNextLocation(None, dest.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(ta.flux), np.asarray(tb.flux), rtol=1e-12, atol=1e-13
+    )
+    np.testing.assert_array_equal(ta.elem_ids, tb.elem_ids)
+
+
+def test_continue_holds_nonflying_particles():
+    t, rng = _mk()
+    pos0 = t.positions.copy()
+    dest = rng.uniform(0.05, 0.95, (N, 3))
+    fly = np.zeros(N, np.int8)
+    t.MoveToNextLocation(None, dest.reshape(-1).copy(), fly, np.ones(N))
+    np.testing.assert_allclose(t.positions, pos0, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(t.flux), 0.0, atol=1e-14)

@@ -1,0 +1,32 @@
+"""Can device_put overlap with device compute on this backend?"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+
+N = 500_000
+rng = np.random.default_rng(0)
+bufs = [rng.uniform(size=(N, 3)).astype(np.float32) for _ in range(4)]
+
+@jax.jit
+def burn(x, iters=200):
+    def body(i, s):
+        return s @ jnp.eye(3, dtype=s.dtype) * 0.999 + 1e-6
+    return jax.lax.fori_loop(0, iters, body, x)
+
+x0 = jax.device_put(bufs[0]); jax.block_until_ready(x0)
+r = burn(x0); jax.block_until_ready(r)
+
+# compute alone
+t0 = time.perf_counter(); r = burn(x0); jax.block_until_ready(r)
+t_compute = time.perf_counter() - t0
+# transfer alone
+t0 = time.perf_counter(); y = jax.device_put(bufs[1]); jax.block_until_ready(y)
+t_xfer = time.perf_counter() - t0
+# interleaved: start compute, then transfer while it runs
+t0 = time.perf_counter()
+r = burn(x0)                      # async dispatch
+z = jax.device_put(bufs[2])       # transfer during compute?
+jax.block_until_ready((r, z))
+t_both = time.perf_counter() - t0
+print(f"compute={t_compute*1e3:.0f}ms xfer={t_xfer*1e3:.0f}ms "
+      f"interleaved={t_both*1e3:.0f}ms (sum={1e3*(t_compute+t_xfer):.0f})")

@@ -1,0 +1,43 @@
+"""Overlap test with fresh buffers + real compute."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+
+N = 500_000
+rng = np.random.default_rng(0)
+fresh = [rng.uniform(size=(N, 3)).astype(np.float32) for _ in range(10)]
+
+@jax.jit
+def burn(x):
+    def body(i, s):
+        return jnp.sin(s) * 1.0001
+    return jax.lax.fori_loop(0, 300, body, x)
+
+x0 = jax.device_put(fresh[0]); jax.block_until_ready(x0)
+r = burn(x0); jax.block_until_ready(r)
+
+t0 = time.perf_counter(); r = burn(x0); jax.block_until_ready(r)
+t_c = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+y = jax.device_put(fresh[1]); jax.block_until_ready(y)
+t_x1 = time.perf_counter() - t0
+t0 = time.perf_counter()
+y2 = jax.device_put(fresh[2]); jax.block_until_ready(y2)
+t_x2 = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+r = burn(x0)
+z = jax.device_put(fresh[3])
+jax.block_until_ready((r, z))
+t_b = time.perf_counter() - t0
+print(f"compute={t_c*1e3:.0f}ms xfer_fresh1={t_x1*1e3:.0f}ms xfer_fresh2={t_x2*1e3:.0f}ms "
+      f"interleaved={t_b*1e3:.0f}ms sum={1e3*(t_c+t_x1):.0f}ms")
+
+# and: does jnp.asarray(f64, dtype=f32) ship f64?
+a64 = rng.uniform(size=(N, 3))
+t0 = time.perf_counter(); q = jnp.asarray(a64, dtype=jnp.float32); jax.block_until_ready(q)
+print(f"jnp.asarray f64->f32 fresh: {1e3*(time.perf_counter()-t0):.0f}ms")
+a64b = rng.uniform(size=(N, 3))
+t0 = time.perf_counter(); q2 = jnp.asarray(a64b.astype(np.float32)); jax.block_until_ready(q2)
+print(f"pre-cast f32 then asarray fresh: {1e3*(time.perf_counter()-t0):.0f}ms")

@@ -1,0 +1,57 @@
+"""Overlap: upload fresh 6MB while the real walk computes (~450ms)."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.api.tally import _move_step
+
+N, DIV, MEAN_STEP = 500_000, 20, 0.25
+mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+t = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+rng = np.random.default_rng(0)
+pos = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(pos.reshape(-1).copy())
+d0 = np.clip(pos + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+t.MoveToNextLocation(pos.reshape(-1).copy(), d0.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+x, elem, flux = t.x, t.elem, t.flux
+fly = jnp.ones((N,), jnp.int8); w = jnp.ones((N,), x.dtype)
+fresh = [rng.uniform(0.05, 0.95, (N, 3)).astype(np.float32) for _ in range(6)]
+
+def run_move(x, elem, flux, dest_dev):
+    return _move_step(mesh, x, elem, x, dest_dev, fly, w, flux,
+                      tol=t._tol, max_iters=t._max_iters)
+
+d_dev = jax.device_put(fresh[0])
+x, elem, flux, _ = run_move(x, elem, flux, d_dev); jax.block_until_ready(flux)
+
+# serial: upload then compute
+t0 = time.perf_counter()
+d_dev = jax.device_put(fresh[1]); jax.block_until_ready(d_dev)
+x, elem, flux, _ = run_move(x, elem, flux, d_dev); jax.block_until_ready(flux)
+t_serial = time.perf_counter() - t0
+
+# pipelined: dispatch compute with PREVIOUSLY staged dest, upload next during it
+d_next = jax.device_put(fresh[2]); jax.block_until_ready(d_next)
+t0 = time.perf_counter()
+for i in (3, 4, 5):
+    x, elem, flux, _ = run_move(x, elem, flux, d_next)  # async dispatch
+    d_next = jax.device_put(fresh[i])                   # upload while computing
+jax.block_until_ready((flux, d_next))
+t_pipe = (time.perf_counter() - t0) / 3
+print(f"serial={t_serial*1e3:.0f}ms  pipelined-per-move={t_pipe*1e3:.0f}ms")
+
+# force a REAL sync by fetching one scalar
+t0 = time.perf_counter()
+x, elem, flux, _ = run_move(x, elem, flux, d_next)
+s = float(jnp.sum(flux))
+t_real = time.perf_counter() - t0
+print(f"move + scalar fetch = {t_real*1e3:.0f}ms (sum={s:.1f})")
+t0 = time.perf_counter()
+x, elem, flux, _ = run_move(x, elem, flux, d_next)
+jax.block_until_ready(flux)
+t_b = time.perf_counter() - t0
+t0 = time.perf_counter()
+s = float(jnp.sum(flux))
+t_f = time.perf_counter() - t0
+print(f"move+block={t_b*1e3:.0f}ms then fetch={t_f*1e3:.0f}ms")

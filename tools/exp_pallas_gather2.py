@@ -1,0 +1,41 @@
+"""Probe 2: take_along_axis-based dynamic gather in Mosaic."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+E, W, T = 48000, 32, 8192
+
+def kern(tab_ref, idx_ref, out_ref):
+    idx2 = jnp.broadcast_to(idx_ref[:][:, None], (T, W))
+    out_ref[:] = jnp.take_along_axis(tab_ref[:], idx2, axis=0)
+
+@jax.jit
+def gather(tab, idx):
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((T, W), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(tab, idx)
+
+rng = np.random.default_rng(0)
+tab = jnp.asarray(rng.normal(size=(E, W)), jnp.float32)
+idx = jnp.asarray(rng.integers(0, E, T), jnp.int32)
+try:
+    out = gather(tab, idx)
+    ok = np.allclose(np.asarray(out), np.asarray(tab)[np.asarray(idx)])
+    print("take_along_axis gather works:", ok)
+    for _ in range(3):
+        out = gather(tab, idx)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(50):
+        out = gather(tab, idx)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 50
+    print(f"gather {T} rows x {W} f32: {dt*1e6:.1f} us -> {T/dt/1e6:.1f} Mrows/s")
+except Exception as e:
+    print("FAILED:", type(e).__name__, str(e)[:800])

@@ -1,0 +1,39 @@
+"""Time each section of MoveToNextLocation."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.api.tally import _move_step
+
+N, DIV, MEAN_STEP = 500_000, 20, 0.25
+mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+t = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+rng = np.random.default_rng(0)
+pos = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(pos.reshape(-1).copy())
+d0 = np.clip(pos + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+t.MoveToNextLocation(pos.reshape(-1).copy(), d0.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+pos = t.positions.astype(np.float64)
+
+for trial in range(3):
+    d = np.clip(pos + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+    po, pd = pos.reshape(-1).copy(), d.reshape(-1).copy()
+    fly, w = np.ones(N, np.int8), np.ones(N)
+    t0 = time.perf_counter()
+    origins = t._as_positions(po, None); dests = t._as_positions(pd, None)
+    flyj = jnp.asarray(np.array(fly, dtype=np.int8, copy=True))
+    wj = jnp.asarray(w.copy(), dtype=t.dtype)
+    jax.block_until_ready((origins, dests, flyj, wj))
+    t1 = time.perf_counter()
+    x, elem, flux, ok = _move_step(t.mesh, t.x, t.elem, origins, dests,
+                                   flyj, wj, t.flux,
+                                   tol=t._tol, max_iters=t._max_iters)
+    t2 = time.perf_counter()  # dispatch returned (async)
+    jax.block_until_ready(flux)
+    t3 = time.perf_counter()
+    t.x, t.elem, t.flux = x, elem, flux
+    pos = np.asarray(t.x, np.float64)
+    t4 = time.perf_counter()
+    print(f"stage: {1e3*(t1-t0):6.1f} | dispatch: {1e3*(t2-t1):6.1f} | "
+          f"device: {1e3*(t3-t2):6.1f} | readback: {1e3*(t4-t3):6.1f} ms")

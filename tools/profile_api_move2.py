@@ -1,0 +1,25 @@
+"""Where does the API move spend time now?"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+N, DIV, MEAN_STEP = 500_000, 20, 0.25
+mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+t = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+rng = np.random.default_rng(0)
+pos = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(pos.reshape(-1).copy())
+d0 = np.clip(pos + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+t.MoveToNextLocation(pos.reshape(-1).copy(), d0.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+pos = t.positions.astype(np.float64)
+for _ in range(3):
+    d = np.clip(pos + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+    t0 = time.perf_counter()
+    t.MoveToNextLocation(pos.reshape(-1).copy(), d.reshape(-1).copy(),
+                         np.ones(N, np.int8), np.ones(N))
+    t1 = time.perf_counter()
+    pos = t.positions.astype(np.float64)
+    t2 = time.perf_counter()
+    print(f"move: {1e3*(t1-t0):6.1f} ms | positions readback: {1e3*(t2-t1):6.1f} ms")

@@ -1,0 +1,37 @@
+"""Stage-level look at the cascade on the bench workload."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from functools import partial
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.ops.walk import walk
+
+N, DIV, MEAN_STEP = 500_000, 20, 0.25
+mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+t = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+rng = np.random.default_rng(0)
+pos = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(pos.reshape(-1).copy())
+x, elem = t.x, t.elem
+d = jnp.asarray(np.clip(np.asarray(x, np.float64) +
+    rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1), x.dtype)
+fly = jnp.ones((N,), jnp.int8); w = jnp.ones((N,), x.dtype)
+flux = jnp.zeros((mesh.nelems,), x.dtype)
+
+wk = jax.jit(partial(walk, tally=True, tol=1e-6, max_iters=48064))
+wk_nc = jax.jit(partial(walk, tally=True, tol=1e-6, max_iters=48064, compact=False))
+
+for tag, f in [("compact", wk), ("plain  ", wk_nc)]:
+    r = f(mesh, x, elem, d, fly, w, flux); jax.block_until_ready(r.flux)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        r = f(mesh, x, elem, d, fly, w, flux)
+    jax.block_until_ready(r.flux)
+    print(f"{tag} walk: {(time.perf_counter()-t0)/3*1e3:7.1f} ms  iters={int(r.iters)}")
+
+# active-count decay: how many particles still active after k iterations?
+from pumiumtally_tpu.ops.walk import walk as walk_fn
+for k in [1, 2, 4, 8, 16, 32, 64]:
+    r = walk_fn(mesh, x, elem, d, fly, w, flux, tally=False, tol=1e-6,
+                max_iters=k, compact=False)
+    print(f"active after {k:3d} iters: {int(jnp.sum(~r.done))}")

@@ -1,0 +1,48 @@
+"""Breakdown of a realistic bench move: device step vs host staging."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.api.tally import _move_step
+
+N, DIV, MEAN_STEP = 500_000, 20, 0.25
+mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+cfg = TallyConfig(check_found_all=False)
+t = PumiTally(mesh, N, cfg)
+rng = np.random.default_rng(0)
+pos = rng.uniform(0.05, 0.95, (N, 3))
+t.CopyInitialPosition(pos.reshape(-1).copy())
+
+def next_dest(p):
+    return np.clip(p + rng.normal(scale=MEAN_STEP/np.sqrt(3), size=(N,3)), 0, 1)
+
+# one full API move (compile)
+d = next_dest(pos)
+t.MoveToNextLocation(pos.reshape(-1).copy(), d.reshape(-1).copy(),
+                     np.ones(N, np.int8), np.ones(N))
+pos = t.positions.astype(np.float64)
+
+# device-only: jitted move_step with on-device arrays, origins = committed x
+x, elem, flux = t.x, t.elem, t.flux
+dts = []
+for _ in range(6):
+    d = jnp.asarray(next_dest(np.asarray(x, np.float64)), x.dtype)
+    fly = jnp.ones((N,), jnp.int8); w = jnp.ones((N,), x.dtype)
+    jax.block_until_ready((d, x))
+    t0 = time.perf_counter()
+    x, elem, flux, ok = _move_step(mesh, x, elem, x, d, fly, w, flux,
+                                   tol=t._tol, max_iters=t._max_iters)
+    jax.block_until_ready(flux)
+    dts.append(time.perf_counter() - t0)
+print("device-only move_step ms:", [f"{x*1e3:.0f}" for x in dts])
+
+# full API move timing
+dts2 = []
+for _ in range(4):
+    d = next_dest(pos)
+    t0 = time.perf_counter()
+    t.MoveToNextLocation(pos.reshape(-1).copy(), d.reshape(-1).copy(),
+                         np.ones(N, np.int8), np.ones(N))
+    dts2.append(time.perf_counter() - t0)
+    pos = t.positions.astype(np.float64)
+print("full API move ms     :", [f"{x*1e3:.0f}" for x in dts2])

@@ -1,0 +1,21 @@
+"""How much of the API move is host->device transfer over the tunnel?"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+
+N = 500_000
+rng = np.random.default_rng(0)
+a64 = rng.uniform(size=(N, 3))
+
+def t(f, n=5):
+    f(); t0 = time.perf_counter()
+    for _ in range(n): out = f()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+print(f"host f64->f32 convert : {t(lambda: a64.astype(np.float32))*1e3:7.1f} ms")
+a32 = a64.astype(np.float32)
+print(f"device_put 6MB f32    : {t(lambda: jax.device_put(a32))*1e3:7.1f} ms")
+print(f"device_put 12MB f64   : {t(lambda: jax.device_put(a64))*1e3:7.1f} ms")
+x = jax.device_put(a32)
+print(f"device->host 6MB      : {t(lambda: np.asarray(x))*1e3:7.1f} ms")
