@@ -24,6 +24,7 @@ from pumiumtally_tpu.config import TallyConfig
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.mesh.box import build_box
 from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
+from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 
 __version__ = "0.1.0"
 
@@ -32,5 +33,6 @@ __all__ = [
     "TetMesh",
     "build_box",
     "PumiTally",
+    "PartitionedPumiTally",
     "TallyTimes",
 ]

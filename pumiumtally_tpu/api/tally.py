@@ -167,17 +167,7 @@ class PumiTally:
         config: Optional[TallyConfig] = None,
     ):
         t0 = time.perf_counter()
-        self.config = config or TallyConfig()
-        self.device_mesh = self.config.device_mesh
-        self.dtype = self.config.resolved_dtype()
-        if isinstance(mesh, str):
-            from pumiumtally_tpu.io.load import load_mesh
-
-            mesh = load_mesh(mesh, dtype=self.dtype)
-        self.mesh = mesh
-        self.num_particles = int(num_particles)
-        self._tol = self.config.resolved_tolerance()
-        self._max_iters = self.config.resolved_max_iters(mesh.nelems)
+        mesh = self._init_common(mesh, num_particles, config)
         n = self.num_particles
         # Internal capacity: padded up to a multiple of the device-mesh
         # size so the particle axis shards evenly; padded slots always
@@ -199,11 +189,33 @@ class PumiTally:
         self.x = jnp.broadcast_to(c0, (self._cap, 3))
         self.elem = jnp.zeros((self._cap,), jnp.int32)
         self.flux = jnp.zeros((mesh.nelems,), self.dtype)
+        jax.block_until_ready(self.x)
+        self.tally_times.initialization_time += time.perf_counter() - t0
+
+    def _init_common(self, mesh, num_particles, config) -> TetMesh:
+        """Shared construction: config resolution, mesh load, counters."""
+        self.config = config or TallyConfig()
+        self.device_mesh = self.config.device_mesh
+        self.dtype = self.config.resolved_dtype()
+        if isinstance(mesh, str):
+            from pumiumtally_tpu.io.load import load_mesh
+
+            mesh = load_mesh(mesh, dtype=self.dtype)
+        elif self.config.dtype is None:
+            # A prebuilt TetMesh fixes the working dtype unless the
+            # config asked for one explicitly — mixing dtypes between
+            # the mesh tables and particle state breaks jit carries.
+            self.dtype = mesh.coords.dtype
+        elif mesh.coords.dtype != self.dtype:
+            mesh = mesh.astype(self.dtype)
+        self.mesh = mesh
+        self.num_particles = int(num_particles)
+        self._tol = self.config.resolved_tolerance(self.dtype)
+        self._max_iters = self.config.resolved_max_iters(mesh.nelems)
         self.iter_count = 0
         self.is_initialized = False
         self.tally_times = TallyTimes()
-        jax.block_until_ready(self.x)
-        self.tally_times.initialization_time += time.perf_counter() - t0
+        return mesh
 
     # -- staging helpers -------------------------------------------------
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
@@ -237,28 +249,15 @@ class PumiTally:
         (reference PumiTally.h:66-67; non-tallying initial search,
         PumiTallyImpl.cpp:54-64)."""
         t0 = time.perf_counter()
-        dest = self._pad_particles(
-            self._as_positions(init_particle_positions, size), self.x
-        )
-        if self.device_mesh is not None:
-            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
-
-            self.x, self.elem, done, exited = sharded_localize_step(
-                self.device_mesh, self.mesh, self.x, self.elem, dest,
-                tol=self._tol, max_iters=self._max_iters,
-            )
-        else:
-            self.x, self.elem, done, exited = _localize_step(
-                self.mesh, self.x, self.elem, dest,
-                tol=self._tol, max_iters=self._max_iters,
-            )
+        dest = self._as_positions(init_particle_positions, size)
+        found_all, n_exited = self._dispatch_localize(dest)
         if self.config.check_found_all:
-            if not bool(jnp.all(done)):
+            if not bool(found_all):
                 print(
                     "ERROR: Not all particles are found. May need more loops "
                     "in search"
                 )
-            nex = int(jnp.sum(exited))
+            nex = int(n_exited)
             if nex:
                 # The straight walk from element 0's centroid left the
                 # domain before reaching the source point — happens only on
@@ -272,6 +271,25 @@ class PumiTally:
         self.is_initialized = True
         jax.block_until_ready(self.x)
         self.tally_times.initialization_time += time.perf_counter() - t0
+
+    def _dispatch_localize(self, dest: jnp.ndarray):
+        """Run the non-tallying localization walk on [n]-shaped staged
+        destinations. Returns (found_all, n_exited) — lazily evaluated
+        scalars (only fetched when check_found_all is on)."""
+        dest = self._pad_particles(dest, self.x)
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
+
+            self.x, self.elem, done, exited = sharded_localize_step(
+                self.device_mesh, self.mesh, self.x, self.elem, dest,
+                tol=self._tol, max_iters=self._max_iters,
+            )
+        else:
+            self.x, self.elem, done, exited = _localize_step(
+                self.mesh, self.x, self.elem, dest,
+                tol=self._tol, max_iters=self._max_iters,
+            )
+        return jnp.all(done), jnp.sum(exited)
 
     def MoveToNextLocation(
         self, particle_origin, particle_destinations, flying=None, weights=None,
@@ -361,6 +379,16 @@ class PumiTally:
                     "specifies"
                 )
 
+        found_all = self._dispatch_move(origins, dests, fly, w)
+        self.iter_count += 1
+        if self.config.check_found_all and not bool(found_all):
+            print("ERROR: Not all particles are found. May need more loops in search")
+        jax.block_until_ready(self.flux)
+        self.tally_times.total_time_to_tally += time.perf_counter() - t0
+
+    def _dispatch_move(self, origins, dests, fly, w):
+        """Run one tallied move from [n]-shaped staged inputs
+        (origins may be None: continue mode). Returns found_all (lazy)."""
         dests = self._pad_particles(dests, self.x)
         fly = self._pad_particles(fly, jnp.zeros((self._cap,), jnp.int8))
         w = self._pad_particles(w, jnp.zeros((self._cap,), self.dtype))
@@ -393,11 +421,7 @@ class PumiTally:
         self.x, self.elem, self.flux, found_all = step(
             fly, w, self.flux, tol=self._tol, max_iters=self._max_iters
         )
-        self.iter_count += 1
-        if self.config.check_found_all and not bool(found_all):
-            print("ERROR: Not all particles are found. May need more loops in search")
-        jax.block_until_ready(self.flux)
-        self.tally_times.total_time_to_tally += time.perf_counter() - t0
+        return found_all
 
     def WriteTallyResults(self, filename: Optional[str] = None) -> None:
         """Normalize flux by element volume and write VTK

@@ -38,13 +38,24 @@ class TallyConfig:
       check_found_all: if True, device→host sync after each search to
         warn when particles did not converge (costs a sync; disable for
         max throughput).
-      migrate_every: particle-migration period in *moves* for the
-        partitioned-mesh mode (reference: ``iter_count % 100 == 0``,
-        PumiTallyImpl.cpp:111).
+      migrate_every: reference-parity knob only (``iter_count % 100``,
+        PumiTallyImpl.cpp:111). The TPU partitioned engine does NOT use
+        a cadence: a particle migrates exactly when it pauses at a
+        partition face, because an un-migrated paused particle would
+        idle its slot for the rest of the round anyway (MPI ranks can
+        keep walking other particles; lock-step SPMD chips cannot).
       device_mesh: optional ``jax.sharding.Mesh`` with a ``dp`` axis.
         When set, particle batches are sharded over it and per-element
         flux is psum-reduced across it (the TPU-native replacement for
         the reference's MPI rank parallelism, SURVEY.md §2.3).
+      capacity_factor: partitioned mode only — per-chip particle-slot
+        over-provisioning relative to a perfectly balanced load, so
+        migration bursts do not overflow a chip (the analogue of
+        PUMIPic's capacity() ≥ nPtcls() slack).
+      max_migration_rounds: partitioned mode only — bound on
+        walk/migrate rounds per phase (reference bounds its search loop
+        the same way and prints "Not all particles are found",
+        PumiTallyImpl.cpp:455-458).
       output_filename: default VTK output path (reference hard-codes
         "fluxresult.vtk", PumiTallyImpl.cpp:153).
     """
@@ -55,15 +66,22 @@ class TallyConfig:
     check_found_all: bool = True
     migrate_every: int = 100
     device_mesh: Optional[jax.sharding.Mesh] = None
+    capacity_factor: float = 1.5
+    max_migration_rounds: int = 64
     output_filename: str = "fluxresult.vtk"
 
     def resolved_dtype(self) -> Any:
         return self.dtype if self.dtype is not None else default_float_dtype()
 
-    def resolved_tolerance(self) -> float:
+    def resolved_tolerance(self, dtype: Any = None) -> float:
+        """Geometric tolerance; keyed to the WORKING dtype (pass the
+        adopted dtype when a prebuilt mesh fixed it — an f32 walk must
+        not run with the 1e-8 f64 threshold, f32 noise is ~1e-7)."""
         if self.tolerance is not None:
             return float(self.tolerance)
-        return 1e-8 if self.resolved_dtype() == jnp.float64 else 1e-6
+        if dtype is None:
+            dtype = self.resolved_dtype()
+        return 1e-8 if jnp.dtype(dtype) == jnp.float64 else 1e-6
 
     def resolved_max_iters(self, nelems: int) -> int:
         if self.max_iters is not None:

@@ -1,0 +1,190 @@
+"""Partitioned-mesh mode ≡ single-chip engine, including migrations.
+
+The ownership-restricted walk + migration (parallel/partition.py) is a
+pure parallelization strategy: fluxes, final positions, and element ids
+must match the replicated single-chip engine up to FP summation order.
+Runs on the 8-virtual-CPU-device mesh (tests/conftest.py).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh
+from pumiumtally_tpu.parallel.partition import build_partition, rcb_partition
+
+N = 3000
+
+
+def test_rcb_partition_balanced():
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    cent = np.asarray(mesh.coords)[np.asarray(mesh.tet2vert)].mean(axis=1)
+    for nparts in (2, 3, 8):
+        owner = rcb_partition(cent, nparts)
+        counts = np.bincount(owner, minlength=nparts)
+        assert counts.sum() == mesh.nelems
+        assert counts.max() - counts.min() <= max(2, mesh.nelems // nparts // 10)
+
+
+def test_partition_adjacency_roundtrip():
+    """Every local adjacency entry maps back to the correct original
+    neighbor (local id, remote glid, or boundary)."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    part = build_partition(mesh, 8)
+    table = np.asarray(part.table)
+    adj_local = table[:, 16:20].astype(np.int64)
+    orig_of_glid = np.asarray(part.orig_of_glid)
+    glid_of_orig = np.asarray(part.glid_of_orig)
+    face_adj = np.asarray(mesh.face_adj)
+    owner = part.owner
+    L = part.L
+    for e in range(mesh.nelems):
+        g = glid_of_orig[e]
+        chip = g // L
+        for f in range(4):
+            enc = adj_local[g, f]
+            nb = face_adj[e, f]
+            if nb == -1:
+                assert enc == -1
+            elif owner[nb] == owner[e]:
+                assert 0 <= enc < L
+                assert orig_of_glid[chip * L + enc] == nb
+            else:
+                assert enc <= -2
+                assert orig_of_glid[-enc - 2] == nb
+
+
+@pytest.mark.parametrize("continue_mode", [False, True])
+def test_partitioned_matches_single_chip(continue_mode):
+    mesh = build_box(1, 1, 1, 5, 5, 5)  # 750 tets over 8 chips
+    dm = make_device_mesh(8)
+    rng = np.random.default_rng(3)
+    src = rng.uniform(0.05, 0.95, (N, 3))
+    # long steps → many particles cross partition boundaries
+    dest1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)), 0.02, 0.98)
+    dest2 = np.clip(dest1 + rng.normal(scale=0.3, size=(N, 3)), 0.02, 0.98)
+    fly = (rng.uniform(size=N) > 0.1).astype(np.int8)
+    w = rng.uniform(0.5, 2.0, N)
+
+    ref = PumiTally(mesh, N, TallyConfig())
+    par = PartitionedPumiTally(mesh, N, TallyConfig(device_mesh=dm))
+
+    for t in (ref, par):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+    np.testing.assert_array_equal(ref.elem_ids, par.elem_ids)
+    np.testing.assert_allclose(ref.positions, par.positions, atol=1e-13)
+
+    for t in (ref, par):
+        if continue_mode:
+            t.MoveToNextLocation(None, dest1.reshape(-1).copy(),
+                                 fly.copy(), w)
+        else:
+            pos = t.positions.astype(np.float64)
+            t.MoveToNextLocation(pos.reshape(-1).copy(),
+                                 dest1.reshape(-1).copy(), fly.copy(), w)
+    np.testing.assert_array_equal(ref.elem_ids, par.elem_ids)
+    np.testing.assert_allclose(ref.positions, par.positions, atol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(ref.flux), np.asarray(par.flux), rtol=1e-11, atol=1e-12
+    )
+
+    # second move accumulates
+    for t in (ref, par):
+        t.MoveToNextLocation(None, dest2.reshape(-1).copy(),
+                             np.ones(N, np.int8), w)
+    np.testing.assert_allclose(
+        np.asarray(ref.flux), np.asarray(par.flux), rtol=1e-11, atol=1e-12
+    )
+
+
+def test_partitioned_phase_a_migration_keeps_weights_aligned():
+    """Resampled origins far from committed positions force phase-A
+    migrations that permute slots; phase B must still tally each
+    particle with ITS OWN weight (regression: stale slot-order restore)."""
+    mesh = build_box(1, 1, 1, 5, 5, 5)
+    dm = make_device_mesh(8)
+    rng = np.random.default_rng(11)
+    n = 800
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    # resample EVERY particle to a far corner region → all migrate in
+    # phase A; then short tallied hops with per-particle weights
+    origins = rng.uniform(0.05, 0.95, (n, 3))[::-1].copy()
+    dests = np.clip(origins + rng.normal(scale=0.1, size=(n, 3)), 0.02, 0.98)
+    w = rng.uniform(0.1, 4.0, n)
+
+    ref = PumiTally(mesh, n, TallyConfig())
+    par = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=4.0)
+    )
+    for t in (ref, par):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(origins.reshape(-1).copy(),
+                             dests.reshape(-1).copy(),
+                             np.ones(n, np.int8), w)
+    np.testing.assert_array_equal(ref.elem_ids, par.elem_ids)
+    np.testing.assert_allclose(
+        np.asarray(ref.flux), np.asarray(par.flux), rtol=1e-11, atol=1e-12
+    )
+
+
+def test_partitioned_oracle_6tet_cube():
+    """The reference's exact flux oracle (BASELINE.md) through the
+    partitioned engine: 6 tets spread over 8 chips, rays crossing
+    elements 2→3→4 — every crossing is a migration."""
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    dm = make_device_mesh(8)
+    # all 5 particles pile into single-element chips → needs capacity
+    # for full concentration (the documented capacity_factor trade-off)
+    t = PartitionedPumiTally(
+        mesh, 5, TallyConfig(device_mesh=dm, capacity_factor=8.0)
+    )
+    init = np.tile([0.1, 0.4, 0.5], (5, 1))
+    t.CopyInitialPosition(init.reshape(-1).copy())
+    np.testing.assert_array_equal(t.elem_ids, np.full(5, 2))
+
+    dests = np.tile([1.2, 0.4, 0.5], (5, 1))
+    t.MoveToNextLocation(init.reshape(-1).copy(), dests.reshape(-1).copy(),
+                         np.ones(5, np.int8), np.ones(5))
+    np.testing.assert_array_equal(t.elem_ids, np.full(5, 4))
+    np.testing.assert_allclose(
+        t.positions, np.tile([1.0, 0.4, 0.5], (5, 1)), atol=1e-8
+    )
+    np.testing.assert_allclose(
+        np.asarray(t.flux),
+        np.array([0.0, 0.0, 1.5, 0.5, 2.5, 0.0]),
+        atol=1e-8,
+    )
+
+
+def test_partitioned_exit_and_hold_semantics():
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    dm = make_device_mesh(4)
+    # the exit move sweeps every particle onto the +x face, owned by a
+    # subset of chips → allow full concentration
+    t = PartitionedPumiTally(
+        mesh, 100, TallyConfig(device_mesh=dm, capacity_factor=4.0)
+    )
+    rng = np.random.default_rng(0)
+    src = rng.uniform(0.2, 0.8, (100, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    # holds: nobody flies
+    t.MoveToNextLocation(None, rng.uniform(0, 1, (100, 3)).reshape(-1),
+                         np.zeros(100, np.int8), np.ones(100))
+    np.testing.assert_allclose(t.positions, src, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(t.flux), 0.0, atol=1e-14)
+    # exits: everyone leaves through +x; clamp to the face
+    far = src.copy()
+    far[:, 0] = 2.0
+    t.MoveToNextLocation(None, far.reshape(-1).copy())
+    assert np.allclose(t.positions[:, 0], 1.0, atol=1e-7)
+    total = float(np.asarray(t.flux).sum())
+    expect = float(np.linalg.norm(
+        np.column_stack([1.0 - src[:, 0], np.zeros(100), np.zeros(100)]),
+        axis=1).sum())
+    np.testing.assert_allclose(total, expect, rtol=1e-9)
