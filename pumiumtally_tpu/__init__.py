@@ -25,6 +25,7 @@ from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.mesh.box import build_box
 from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
+from pumiumtally_tpu.api.streaming import StreamingTally
 
 __version__ = "0.1.0"
 
@@ -34,5 +35,6 @@ __all__ = [
     "build_box",
     "PumiTally",
     "PartitionedPumiTally",
+    "StreamingTally",
     "TallyTimes",
 ]

@@ -87,6 +87,47 @@ class TallyTimes:
         print(f"[TIME] Total PUMI-Tally time   : {total:f} seconds")
 
 
+def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
+    """Validate a caller position buffer → flat [3n] float64 host array
+    (shared by the monolithic and streaming facades)."""
+    a = np.asarray(buf, dtype=np.float64).reshape(-1)
+    if size is not None and size != 3 * n:
+        raise ValueError(f"size {size} != 3*num_particles {3 * n}")
+    if a.shape[0] < 3 * n:
+        raise ValueError(
+            f"position buffer has {a.shape[0]} values, need {3 * n}"
+        )
+    return a[: 3 * n]
+
+
+def zero_flying_side_effect(flying, n: int) -> None:
+    """Zero the caller's flying buffer in place after staging — the
+    reference's documented host side effect OpenMC relies on
+    (PumiTallyImpl.cpp:169-172). ndarray.flat writes through even for
+    non-contiguous arrays; other mutable buffers are zeroed by item
+    assignment; unwritable buffers get a warning, never a silent skip."""
+    if isinstance(flying, np.ndarray):
+        if flying.flags.writeable:
+            flying.flat[:n] = 0
+        else:
+            warnings.warn(
+                "flying array is read-only: skipping the in-place "
+                "zeroing side effect the host protocol specifies"
+            )
+    elif isinstance(flying, list):
+        flying[:n] = [0] * min(n, len(flying))
+    elif flying is not None:
+        try:
+            for i in range(min(n, len(flying))):
+                flying[i] = 0
+        except (TypeError, ValueError):
+            warnings.warn(
+                "flying buffer is not writeable: skipping the "
+                "in-place zeroing side effect the host protocol "
+                "specifies"
+            )
+
+
 @partial(jax.jit, static_argnames=("tol", "max_iters"))
 def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
     n = x.shape[0]
@@ -219,17 +260,7 @@ class PumiTally:
 
     # -- staging helpers -------------------------------------------------
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
-        a = np.asarray(buf, dtype=np.float64).reshape(-1)
-        if size is not None and size != 3 * self.num_particles:
-            raise ValueError(
-                f"size {size} != 3*num_particles {3 * self.num_particles}"
-            )
-        if a.shape[0] < 3 * self.num_particles:
-            raise ValueError(
-                f"position buffer has {a.shape[0]} values, need "
-                f"{3 * self.num_particles}"
-            )
-        a = a[: 3 * self.num_particles]
+        a = host_positions(buf, size, self.num_particles)
         # Cast on the host with numpy BEFORE handing to jax: letting
         # jnp.asarray do the f64→f32 conversion goes through a slow
         # backend path (measured ~100× slower than a numpy pre-cast
@@ -352,32 +383,7 @@ class PumiTally:
             w = jnp.asarray(
                 np.asarray(weights_np[:n], dtype=np.dtype(self.dtype))
             )
-        # Reference zeroes the caller's flying array after copy
-        # (PumiTallyImpl.cpp:169-172) — OpenMC relies on this side
-        # effect. ndarray.flat writes through to the original storage
-        # even when the array is non-contiguous; other mutable buffers
-        # are zeroed by slice/item assignment; buffers we cannot write
-        # get a warning rather than silent skipping.
-        if isinstance(flying, np.ndarray):
-            if flying.flags.writeable:
-                flying.flat[:n] = 0
-            else:
-                warnings.warn(
-                    "flying array is read-only: skipping the in-place "
-                    "zeroing side effect the host protocol specifies"
-                )
-        elif isinstance(flying, list):
-            flying[:n] = [0] * min(n, len(flying))
-        elif flying is not None:
-            try:
-                for i in range(min(n, len(flying))):
-                    flying[i] = 0
-            except (TypeError, ValueError):
-                warnings.warn(
-                    "flying buffer is not writeable: skipping the "
-                    "in-place zeroing side effect the host protocol "
-                    "specifies"
-                )
+        zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)
         self.iter_count += 1

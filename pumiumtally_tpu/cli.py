@@ -1,0 +1,90 @@
+"""Mesh tool CLI — the framework's ``msh2osh`` / ``describe`` / ``scale``.
+
+The reference's mesh pipeline uses Omega_h's command-line tools
+(reference README.md:115-125):
+
+    msh2osh input.msh output.osh      # convert Gmsh -> .osh
+    describe output.osh               # print coordinate min/max
+    scale output.osh scaled.osh 10    # scale coordinates
+
+Here the same three verbs live behind ``python -m pumiumtally_tpu.cli``
+(or the ``pumiumtally`` console script), operating on Gmsh ``.msh`` and
+this package's ``.osh`` directories (io/osh.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _load(path: str):
+    p = path.rstrip("/")
+    if p.endswith(".msh"):
+        from pumiumtally_tpu.io.gmsh import read_gmsh
+
+        return read_gmsh(p)
+    if p.endswith(".osh"):
+        from pumiumtally_tpu.io.osh import read_osh
+
+        return read_osh(p)
+    raise SystemExit(f"unsupported mesh format: {path!r} (.msh or .osh)")
+
+
+def cmd_msh2osh(args) -> None:
+    from pumiumtally_tpu.io.osh import write_osh
+
+    coords, tets = _load(args.input)
+    write_osh(args.output, coords, tets)
+    print(f"wrote {args.output}: {coords.shape[0]} vertices, "
+          f"{tets.shape[0]} tets")
+
+
+def cmd_describe(args) -> None:
+    coords, tets = _load(args.mesh)
+    lo, hi = coords.min(axis=0), coords.max(axis=0)
+    print(f"vertices : {coords.shape[0]}")
+    print(f"tets     : {tets.shape[0]}")
+    print(f"x range  : [{lo[0]:.6g}, {hi[0]:.6g}]")
+    print(f"y range  : [{lo[1]:.6g}, {hi[1]:.6g}]")
+    print(f"z range  : [{lo[2]:.6g}, {hi[2]:.6g}]")
+
+
+def cmd_scale(args) -> None:
+    from pumiumtally_tpu.io.osh import write_osh
+
+    coords, tets = _load(args.input)
+    write_osh(args.output, coords * args.factor, tets)
+    print(f"wrote {args.output}: scaled by {args.factor}")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="pumiumtally",
+        description="mesh tools (Gmsh .msh / pumiumtally .osh)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("msh2osh", help="convert Gmsh .msh to .osh directory")
+    c.add_argument("input")
+    c.add_argument("output")
+    c.set_defaults(fn=cmd_msh2osh)
+
+    c = sub.add_parser("describe", help="print mesh size and coordinate range")
+    c.add_argument("mesh")
+    c.set_defaults(fn=cmd_describe)
+
+    c = sub.add_parser("scale", help="scale mesh coordinates by a factor")
+    c.add_argument("input")
+    c.add_argument("output")
+    c.add_argument("factor", type=float)
+    c.set_defaults(fn=cmd_scale)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,20 +1,140 @@
-"""Omega_h ``.osh`` binary directory reader.
+"""Omega_h-style ``.osh`` binary directory read/write.
 
-The reference constructor takes this format (``Omega_h::binary::read``,
-reference PumiTallyImpl.cpp:562). Planned: parse the directory-of-arrays
-layout (zlib-compressed) for coords and REGION→VERT connectivity.
-Until then this raises with a clear workaround (the ``.msh`` path).
+The reference constructor takes an ``.osh`` directory
+(``Omega_h::binary::read``, reference PumiTallyImpl.cpp:562), produced
+from Gmsh meshes by its ``msh2osh`` tool (reference README.md:115-125).
+This module provides the same role for this framework: a compact binary
+mesh directory our ``msh2osh`` CLI emits and the ``PumiTally``
+constructor reads.
+
+Layout (mirrors the structure of Omega_h's format — per-rank stream
+files plus small ASCII metadata files in a directory — but is written
+and versioned by THIS package; byte-exact decoding of files produced by
+Omega_h itself cannot be validated in this environment, which has no
+Omega_h build, so the reader detects them and directs the user to
+re-convert from the Gmsh source):
+
+    mesh.osh/
+      nparts      ASCII int  — number of rank files (only 1 supported)
+      format      ASCII      — "pumiumtally-osh <version>"
+      0.osh       binary stream:
+        magic     2 bytes    0xa1 0x1a  (as in Omega_h streams)
+        endian    1 byte     0x01 little / 0x00 big
+        version   int32
+        dim       int32      must be 3
+        nverts    int64
+        ntets     int64
+        coords    array      float64 [nverts*3]
+        tets      array      int32   [ntets*4]
+
+    array := dtype_code int8, count int64, compressed int8,
+             payload_bytes int64, payload (zlib if compressed)
 """
 
 from __future__ import annotations
 
+import os
+import struct
+import zlib
 from typing import Tuple
 
 import numpy as np
 
+_MAGIC = b"\xa1\x1a"
+_VERSION = 1
+_DTYPE_CODES = {np.dtype(np.float64): 0, np.dtype(np.int32): 1}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def _write_array(f, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    code = _DTYPE_CODES[arr.dtype]
+    raw = arr.tobytes()
+    comp = zlib.compress(raw, level=6)
+    use_comp = len(comp) < len(raw)
+    payload = comp if use_comp else raw
+    f.write(struct.pack("<bqbq", code, arr.size, int(use_comp), len(payload)))
+    f.write(payload)
+
+
+def _read_array(f) -> np.ndarray:
+    hdr = f.read(struct.calcsize("<bqbq"))
+    code, count, compressed, nbytes = struct.unpack("<bqbq", hdr)
+    if code not in _CODE_DTYPES:
+        raise ValueError(
+            "unrecognized array dtype code in .osh stream — this file "
+            "appears to be written by Omega_h itself; re-convert the "
+            "Gmsh source with `python -m pumiumtally_tpu.cli msh2osh`"
+        )
+    dtype = _CODE_DTYPES[code]
+    payload = f.read(nbytes)
+    raw = zlib.decompress(payload) if compressed else payload
+    a = np.frombuffer(raw, dtype=dtype)
+    if a.size != count:
+        raise ValueError(f"corrupt .osh array: {a.size} values, expected {count}")
+    return a
+
+
+def write_osh(path: str, coords: np.ndarray, tet2vert: np.ndarray) -> None:
+    """Write a single-part ``.osh`` directory."""
+    coords = np.asarray(coords, np.float64)
+    tet2vert = np.asarray(tet2vert, np.int32)
+    if coords.ndim != 2 or coords.shape[1] != 3:
+        raise ValueError(f"coords must be [V,3], got {coords.shape}")
+    if tet2vert.ndim != 2 or tet2vert.shape[1] != 4:
+        raise ValueError(f"tet2vert must be [E,4], got {tet2vert.shape}")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "nparts"), "w") as f:
+        f.write("1\n")
+    with open(os.path.join(path, "format"), "w") as f:
+        f.write(f"pumiumtally-osh {_VERSION}\n")
+    with open(os.path.join(path, "0.osh"), "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<biiqq", 1, _VERSION, 3,
+                            coords.shape[0], tet2vert.shape[0]))
+        _write_array(f, coords.reshape(-1))
+        _write_array(f, tet2vert.reshape(-1))
+
 
 def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    raise NotImplementedError(
-        f".osh reading not implemented yet ({path!r}); pass the Gmsh .msh "
-        "source mesh instead, or convert with meshio"
-    )
+    """Read a ``.osh`` directory → (coords[V,3] f64, tet2vert[E,4] i32)."""
+    if not os.path.isdir(path):
+        raise ValueError(
+            f"{path!r}: an .osh mesh is a DIRECTORY (as with Omega_h); "
+            "got a non-directory path"
+        )
+    nparts_file = os.path.join(path, "nparts")
+    if os.path.exists(nparts_file):
+        with open(nparts_file) as f:
+            nparts = int(f.read().strip())
+        if nparts != 1:
+            raise NotImplementedError(
+                f"{path!r}: multi-part .osh ({nparts} parts) not supported; "
+                "write a single-part mesh"
+            )
+    stream = os.path.join(path, "0.osh")
+    if not os.path.exists(stream):
+        raise ValueError(f"{path!r}: missing rank stream file 0.osh")
+    with open(stream, "rb") as f:
+        if f.read(2) != _MAGIC:
+            raise ValueError(f"{path!r}: bad magic in 0.osh")
+        fmt_file = os.path.join(path, "format")
+        if not os.path.exists(fmt_file):
+            raise ValueError(
+                f"{path!r}: no `format` metadata — this looks like a file "
+                "written by Omega_h itself, whose byte-level encoding this "
+                "reader does not decode; re-convert the Gmsh source with "
+                "`python -m pumiumtally_tpu.cli msh2osh`"
+            )
+        endian, version, dim, nverts, ntets = struct.unpack(
+            "<biiqq", f.read(struct.calcsize("<biiqq"))
+        )
+        if endian != 1:
+            raise NotImplementedError("big-endian .osh streams not supported")
+        if version > _VERSION:
+            raise ValueError(f"{path!r}: .osh version {version} too new")
+        if dim != 3:
+            raise ValueError(f"{path!r}: expected a 3D mesh, got dim={dim}")
+        coords = _read_array(f).reshape(nverts, 3)
+        tets = _read_array(f).reshape(ntets, 4)
+    return np.asarray(coords, np.float64), np.asarray(tets, np.int32)

@@ -60,9 +60,67 @@ def test_pumitally_from_msh_path(tmp_path):
     np.testing.assert_array_equal(t.elem_ids, np.full(5, 2))
 
 
+def test_osh_round_trip(tmp_path):
+    from pumiumtally_tpu.io.osh import read_osh, write_osh
+
+    coords, tets = box_arrays(2, 1, 1, 3, 2, 2)
+    path = str(tmp_path / "m.osh")
+    write_osh(path, coords, tets)
+    c2, t2 = read_osh(path)
+    np.testing.assert_array_equal(c2, coords)
+    np.testing.assert_array_equal(t2, tets)
+    # and through the full dispatch + engine
+    mesh = load_mesh(path)
+    np.testing.assert_allclose(np.asarray(mesh.volumes).sum(), 2.0, atol=1e-12)
+
+
+def test_pumitally_from_osh_path(tmp_path):
+    from pumiumtally_tpu.io.osh import write_osh
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    path = str(tmp_path / "cube.osh")
+    write_osh(path, coords, tets)
+    t = PumiTally(path, 5)
+    init = np.tile([0.1, 0.4, 0.5], (5, 1)).reshape(-1)
+    t.CopyInitialPosition(init.copy())
+    np.testing.assert_array_equal(t.elem_ids, np.full(5, 2))
+
+
+def test_cli_msh2osh_describe_scale(tmp_path, capsys):
+    from pumiumtally_tpu.cli import main
+    from pumiumtally_tpu.io.osh import read_osh
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    msh = str(tmp_path / "m.msh")
+    _write_msh_v2(msh, coords, tets)
+    osh = str(tmp_path / "m.osh")
+    main(["msh2osh", msh, osh])
+    main(["describe", osh])
+    out = capsys.readouterr().out
+    assert "48 tets" in out and "x range  : [0, 1]" in out
+
+    scaled = str(tmp_path / "s.osh")
+    main(["scale", osh, scaled, "10"])
+    c2, _ = read_osh(scaled)
+    np.testing.assert_allclose(c2, coords * 10, atol=1e-12)
+
+
 def test_osh_clear_error(tmp_path):
-    with pytest.raises((NotImplementedError, FileNotFoundError)):
+    with pytest.raises((ValueError, NotImplementedError, FileNotFoundError)):
         load_mesh(str(tmp_path / "missing.osh"))
+
+
+def test_osh_foreign_file_detected(tmp_path):
+    """A directory that looks like a real Omega_h output (magic but no
+    `format` metadata) gets a clear re-convert message, not garbage."""
+    import os
+
+    d = tmp_path / "omega.osh"
+    os.makedirs(d)
+    (d / "nparts").write_text("1\n")
+    (d / "0.osh").write_bytes(b"\xa1\x1a" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="msh2osh"):
+        load_mesh(str(d))
 
 
 def test_unknown_format():
