@@ -10,14 +10,30 @@ PumiTallyImpl.cpp:530-539, generalized to every chip), and reduce the
 per-element flux with ``psum`` over ICI.
 """
 
-from pumiumtally_tpu.parallel.device import make_device_mesh
+from pumiumtally_tpu.parallel.device import (
+    initialize_distributed,
+    make_device_mesh,
+)
 from pumiumtally_tpu.parallel.sharded import (
     sharded_localize_step,
     sharded_move_step,
+    sharded_move_step_continue,
+)
+from pumiumtally_tpu.parallel.partition import (
+    MeshPartition,
+    PartitionedEngine,
+    build_partition,
+    rcb_partition,
 )
 
 __all__ = [
+    "initialize_distributed",
     "make_device_mesh",
     "sharded_localize_step",
     "sharded_move_step",
+    "sharded_move_step_continue",
+    "MeshPartition",
+    "PartitionedEngine",
+    "build_partition",
+    "rcb_partition",
 ]

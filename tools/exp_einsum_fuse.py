@@ -1,0 +1,33 @@
+"""Is one [N,4,3]x[N,3,2] einsum cheaper than two [N,4,3]x[N,3]?"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+
+N = 500_000
+rng = np.random.default_rng(0)
+fn = jnp.asarray(rng.normal(size=(N, 4, 3)), jnp.float32)
+x = jnp.asarray(rng.normal(size=(N, 3)), jnp.float32)
+d = jnp.asarray(rng.normal(size=(N, 3)), jnp.float32)
+fo = jnp.asarray(rng.normal(size=(N, 4)), jnp.float32)
+
+@jax.jit
+def two(fn, fo, x, d):
+    denom = jnp.einsum("nfc,nc->nf", fn, d)
+    numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
+    return denom, numer
+
+@jax.jit
+def one(fn, fo, x, d):
+    xd = jnp.stack([d, x], axis=-1)          # [N,3,2]
+    both = jnp.einsum("nfc,nck->nfk", fn, xd)  # [N,4,2]
+    return both[..., 0], fo - both[..., 1]
+
+def t(f):
+    o = f(fn, fo, x, d); jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for _ in range(30): o = f(fn, fo, x, d)
+    s = float(jnp.sum(o[0]) + jnp.sum(o[1]))  # real sync
+    return (time.perf_counter() - t0) / 30, s
+
+ta, sa = t(two); tb, sb = t(one)
+print(f"two einsums: {ta*1e3:.2f} ms   fused: {tb*1e3:.2f} ms   (checks {sa:.1f} {sb:.1f})")
