@@ -38,6 +38,15 @@ class PumiTally {
           "MoveToNextLocation");
   }
 
+  /* Continue-mode fast path (TPU-native extension): transport from the
+   * committed positions; flying/weights may be nullptr (all fly / unit
+   * weights). */
+  void MoveContinue(const double* destinations, int8_t* flying,
+                    const double* weights, int32_t size) {
+    check(pumiumtally_move_continue(h_, destinations, flying, weights, size),
+          "MoveContinue");
+  }
+
   /* reference PumiTally.h:94-95 */
   void WriteTallyResults(const char* filename = nullptr) {
     check(pumiumtally_write_tally_results(h_, filename), "WriteTallyResults");
@@ -45,6 +54,14 @@ class PumiTally {
 
   int64_t GetFlux(double* out, int64_t capacity) {
     return pumiumtally_get_flux(h_, out, capacity);
+  }
+
+  int64_t GetPositions(double* out, int64_t capacity) {
+    return pumiumtally_get_positions(h_, out, capacity);
+  }
+
+  int64_t GetElemIds(int32_t* out, int64_t capacity) {
+    return pumiumtally_get_elem_ids(h_, out, capacity);
   }
 
  private:

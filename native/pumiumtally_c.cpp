@@ -120,23 +120,32 @@ int pumiumtally_copy_initial_position(pumiumtally_handle* h,
   return 0;
 }
 
-int pumiumtally_move_to_next_location(pumiumtally_handle* h,
-                                      const double* origins,
-                                      const double* destinations,
-                                      int8_t* flying,
-                                      const double* weights,
-                                      int32_t size) {
+namespace {
+
+/* Shared body of the two move entry points. origins may be NULL
+ * (continue mode); flying may be NULL (all fly; no zeroing side
+ * effect); weights may be NULL (unit weights). A NULL pointer becomes
+ * Python None, which the engine's MoveToNextLocation interprets the
+ * same way (api/tally.py). */
+int do_move(pumiumtally_handle* h, const double* origins,
+            const double* destinations, int8_t* flying, const double* weights,
+            int32_t size) {
   if (!h) return -1;
   GilGuard gil;
-  PyObject* o =
-      np_view_1d(const_cast<double*>(origins), size, NPY_DOUBLE, false);
+  PyObject* o = origins
+                    ? np_view_1d(const_cast<double*>(origins), size,
+                                 NPY_DOUBLE, false)
+                    : (Py_INCREF(Py_None), Py_None);
   PyObject* d =
       np_view_1d(const_cast<double*>(destinations), size, NPY_DOUBLE, false);
   /* flying is writeable: the Python layer zeroes it in place (the
    * reference's documented side effect, PumiTallyImpl.cpp:169-172). */
-  PyObject* f = np_view_1d(flying, h->num_particles, NPY_INT8, true);
-  PyObject* w = np_view_1d(const_cast<double*>(weights), h->num_particles,
-                           NPY_DOUBLE, false);
+  PyObject* f = flying ? np_view_1d(flying, h->num_particles, NPY_INT8, true)
+                       : (Py_INCREF(Py_None), Py_None);
+  PyObject* w = weights
+                    ? np_view_1d(const_cast<double*>(weights),
+                                 h->num_particles, NPY_DOUBLE, false)
+                    : (Py_INCREF(Py_None), Py_None);
   if (!o || !d || !f || !w) {
     Py_XDECREF(o);
     Py_XDECREF(d);
@@ -153,6 +162,70 @@ int pumiumtally_move_to_next_location(pumiumtally_handle* h,
   if (!r) return fail_py("MoveToNextLocation");
   Py_DECREF(r);
   return 0;
+}
+
+}  // namespace
+
+int pumiumtally_move_to_next_location(pumiumtally_handle* h,
+                                      const double* origins,
+                                      const double* destinations,
+                                      int8_t* flying,
+                                      const double* weights,
+                                      int32_t size) {
+  return do_move(h, origins, destinations, flying, weights, size);
+}
+
+int pumiumtally_move_continue(pumiumtally_handle* h,
+                              const double* destinations,
+                              int8_t* flying,
+                              const double* weights,
+                              int32_t size) {
+  /* origins=NULL selects the continue-mode fast path (api/tally.py). */
+  return do_move(h, nullptr, destinations, flying, weights, size);
+}
+
+namespace {
+
+/* Copy a 1-D numpy-convertible attribute of the tally into out. */
+int64_t copy_attr(pumiumtally_handle* h, const char* attr, const char* npdtype,
+                  void* out, int64_t capacity, size_t elem_size) {
+  GilGuard gil;
+  PyObject* val = PyObject_GetAttrString(h->tally, attr);
+  if (!val) return fail_py(attr);
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    Py_DECREF(val);
+    return fail_py("import numpy");
+  }
+  PyObject* dtype = PyObject_GetAttrString(np, npdtype);
+  PyObject* asarr =
+      dtype ? PyObject_CallMethod(np, "ascontiguousarray", "OO", val, dtype)
+            : nullptr;
+  Py_XDECREF(dtype);
+  Py_DECREF(np);
+  Py_DECREF(val);
+  if (!asarr) return fail_py("ascontiguousarray");
+  auto* a = reinterpret_cast<PyArrayObject*>(asarr);
+  int64_t n = (int64_t)PyArray_SIZE(a);
+  if (out && capacity >= n) {
+    std::memcpy(out, PyArray_DATA(a), (size_t)n * elem_size);
+  }
+  Py_DECREF(asarr);
+  return n;
+}
+
+}  // namespace
+
+int64_t pumiumtally_get_positions(pumiumtally_handle* h, double* out,
+                                  int64_t capacity) {
+  if (!h) return -1;
+  return copy_attr(h, "positions", "float64", out, capacity, sizeof(double));
+}
+
+int64_t pumiumtally_get_elem_ids(pumiumtally_handle* h, int32_t* out,
+                                 int64_t capacity) {
+  if (!h) return -1;
+  return copy_attr(h, "elem_ids", "int32", out, capacity, sizeof(int32_t));
 }
 
 int pumiumtally_write_tally_results(pumiumtally_handle* h,
@@ -173,28 +246,7 @@ int pumiumtally_write_tally_results(pumiumtally_handle* h,
 int64_t pumiumtally_get_flux(pumiumtally_handle* h, double* out,
                              int64_t capacity) {
   if (!h) return -1;
-  GilGuard gil;
-  PyObject* flux = PyObject_GetAttrString(h->tally, "flux");
-  if (!flux) return fail_py("flux attr");
-  PyObject* np = PyImport_ImportModule("numpy");
-  if (!np) {
-    Py_DECREF(flux);
-    return fail_py("import numpy");
-  }
-  PyObject* dtype = PyObject_GetAttrString(np, "float64");
-  PyObject* asarr =
-      dtype ? PyObject_CallMethod(np, "asarray", "OO", flux, dtype) : nullptr;
-  Py_XDECREF(dtype);
-  Py_DECREF(np);
-  Py_DECREF(flux);
-  if (!asarr) return fail_py("flux asarray");
-  auto* a = reinterpret_cast<PyArrayObject*>(asarr);
-  int64_t n = (int64_t)PyArray_SIZE(a);
-  if (out && capacity >= n) {
-    std::memcpy(out, PyArray_DATA(a), (size_t)n * sizeof(double));
-  }
-  Py_DECREF(asarr);
-  return n;
+  return copy_attr(h, "flux", "float64", out, capacity, sizeof(double));
 }
 
 void pumiumtally_destroy(pumiumtally_handle* h) {

@@ -46,6 +46,18 @@ int pumiumtally_move_to_next_location(pumiumtally_handle* h,
                                       const double* weights,
                                       int32_t size);
 
+/* Continue-mode move (TPU-native extension): transport straight from
+ * the committed positions — valid whenever no particle was resampled
+ * since the last move. Halves staging and device work versus the
+ * two-phase call. flying may be NULL (everyone flies; no zeroing side
+ * effect) and weights may be NULL (unit weights). Returns 0 on
+ * success. */
+int pumiumtally_move_continue(pumiumtally_handle* h,
+                              const double* destinations,
+                              int8_t* flying,
+                              const double* weights,
+                              int32_t size);
+
 /* Normalize by element volume and write the VTK file (reference
  * PumiTally.h:94-95; hard-default name fluxresult.vtk). Pass NULL for
  * the default filename. Returns 0 on success. */
@@ -57,6 +69,16 @@ int pumiumtally_write_tally_results(pumiumtally_handle* h,
  * out=NULL first. */
 int64_t pumiumtally_get_flux(pumiumtally_handle* h, double* out,
                              int64_t capacity);
+
+/* Copy the committed particle positions into out[3*num_particles];
+ * returns the value count 3*num_particles (or <0 on error). */
+int64_t pumiumtally_get_positions(pumiumtally_handle* h, double* out,
+                                  int64_t capacity);
+
+/* Copy the current element id of each particle into
+ * out[num_particles]; returns num_particles (or <0 on error). */
+int64_t pumiumtally_get_elem_ids(pumiumtally_handle* h, int32_t* out,
+                                 int64_t capacity);
 
 void pumiumtally_destroy(pumiumtally_handle* h);
 

@@ -258,6 +258,7 @@ def walk_local(
 # Global migration (jit-level; XLA inserts the collectives)
 # ---------------------------------------------------------------------------
 
+@partial(jax.jit, static_argnames=("part_L", "ndev", "cap_per_chip"))
 def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     """Ship paused particles (pending >= 0) to the chip owning their
     target element; everything else stays in its chip's slot range.
@@ -266,6 +267,12 @@ def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     particle (x, lelem, pending, done, exited, alive, pid, dest, fly, w).
     Returns (new_state, overflowed) — overflow means some chip received
     more particles than its slot capacity.
+
+    Jitted as ONE program: the sort/scatter over device-sharded arrays
+    lowers to a single XLA module (one set of collectives), which both
+    performs better and avoids flooding the runtime with per-op
+    rendezvous (observed to trip XLA:CPU's 40s collective timeout when
+    issued eagerly op-by-op on 8 virtual devices).
     """
     cap = state["pid"].shape[0]
     slot_chip = (jnp.cumsum(jnp.ones_like(state["pid"])) - 1) // cap_per_chip
@@ -410,7 +417,8 @@ class PartitionedEngine:
         st["done"] = ~st["alive"]
         st["exited"] = jnp.zeros((self.cap,), bool)
         self.state, overflow = migrate(
-            self.part.L, self.ndev, self.cap_per_chip, st
+            part_L=self.part.L, ndev=self.ndev,
+            cap_per_chip=self.cap_per_chip, state=st,
         )
         self._check_overflow(overflow)
         # Mark the phase finished for all particles.
@@ -424,20 +432,26 @@ class PartitionedEngine:
         if tally in self._round_fns:
             return self._round_fns[tally]
         pp = P(self.axis)
+        ax = self.axis
 
         @jax.jit
         @partial(
             shard_map,
             mesh=self.device_mesh,
             in_specs=(pp, pp, pp, pp, pp, pp, pp, pp, pp),
-            out_specs=(pp, pp, pp, pp, pp, pp),
+            out_specs=(pp, pp, pp, pp, pp, pp, P(), P()),
         )
         def round_fn(table, x, lelem, dest, fly, w, done, exited, flux):
             x, lelem, done, exited, pending, flux, _ = walk_local(
                 table, x, lelem, dest, fly, w, done, exited, flux,
                 tally=tally, tol=self.tol, max_iters=self.max_iters,
             )
-            return x, lelem, done, exited, pending, flux
+            # Global round status computed in-program (one psum) so the
+            # host does a single scalar fetch per round instead of
+            # issuing eager cross-device reductions.
+            n_pending = lax.psum(jnp.sum(pending >= 0), ax)
+            n_not_done = lax.psum(jnp.sum(~done), ax)
+            return x, lelem, done, exited, pending, flux, n_pending, n_not_done
 
         self._round_fns[tally] = round_fn
         return round_fn
@@ -451,20 +465,22 @@ class PartitionedEngine:
         st["dest"] = jnp.where((st["fly"] == 1)[:, None], st["dest"], st["x"])
         round_fn = self._sharded_walk_round(tally)
         for _ in range(self.max_rounds):
-            x, lelem, done, exited, pending, flux = round_fn(
-                self.part.table, st["x"], st["lelem"], st["dest"],
-                st["fly"], st["w"], st["done"], st["exited"],
-                self.flux_padded,
+            x, lelem, done, exited, pending, flux, n_pending, n_not_done = (
+                round_fn(
+                    self.part.table, st["x"], st["lelem"], st["dest"],
+                    st["fly"], st["w"], st["done"], st["exited"],
+                    self.flux_padded,
+                )
             )
             st.update(x=x, lelem=lelem, done=done, exited=exited,
                       pending=pending)
             self.flux_padded = flux
-            n_pending = int(jnp.sum(pending >= 0))
-            if n_pending == 0:
+            if int(n_pending) == 0:
                 self.state = st
-                return bool(jnp.all(done))
+                return int(n_not_done) == 0
             st, overflow = migrate(
-                self.part.L, self.ndev, self.cap_per_chip, st
+                part_L=self.part.L, ndev=self.ndev,
+                cap_per_chip=self.cap_per_chip, state=st,
             )
             self._check_overflow(overflow)
         self.state = st

@@ -62,6 +62,17 @@ def native_lib():
     lib.pumiumtally_get_flux.restype = ctypes.c_int64
     lib.pumiumtally_get_flux.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+    lib.pumiumtally_move_continue.restype = ctypes.c_int
+    lib.pumiumtally_move_continue.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int32]
+    lib.pumiumtally_get_positions.restype = ctypes.c_int64
+    lib.pumiumtally_get_positions.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+    lib.pumiumtally_get_elem_ids.restype = ctypes.c_int64
+    lib.pumiumtally_get_elem_ids.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
     lib.pumiumtally_destroy.restype = None
     lib.pumiumtally_destroy.argtypes = [ctypes.c_void_p]
     return lib
@@ -107,6 +118,40 @@ def test_c_abi_oracle_sequence(native_lib, tmp_path):
         rc = lib.pumiumtally_write_tally_results(h, out.encode())
         assert rc == 0
         assert os.path.getsize(out) > 0
+    finally:
+        lib.pumiumtally_destroy(h)
+
+
+def test_c_abi_continue_and_accessors(native_lib, tmp_path):
+    """Continue-mode move (NULL flying/weights) + state accessors."""
+    lib = native_lib
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    n = 4
+    h = lib.pumiumtally_create(msh.encode(), n)
+    assert h
+    try:
+        init = np.tile([0.2, 0.4, 0.5], (n, 1)).reshape(-1)
+        assert lib.pumiumtally_copy_initial_position(h, _dp(init), 3 * n) == 0
+
+        dests = np.tile([0.4, 0.4, 0.5], (n, 1)).reshape(-1)
+        nullp8 = ctypes.POINTER(ctypes.c_int8)()
+        nullpd = ctypes.POINTER(ctypes.c_double)()
+        rc = lib.pumiumtally_move_continue(h, _dp(dests), nullp8, nullpd, 3 * n)
+        assert rc == 0
+
+        pos = np.zeros(3 * n)
+        assert lib.pumiumtally_get_positions(h, _dp(pos), 3 * n) == 3 * n
+        np.testing.assert_allclose(pos, dests, atol=1e-8)
+        eids = np.zeros(n, dtype=np.int32)
+        got = lib.pumiumtally_get_elem_ids(
+            h, eids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n
+        )
+        assert got == n
+        np.testing.assert_array_equal(eids, np.full(n, 2))
+        flux = np.zeros(6)
+        lib.pumiumtally_get_flux(h, _dp(flux), 6)
+        np.testing.assert_allclose(flux.sum(), 0.2 * n, atol=1e-8)
     finally:
         lib.pumiumtally_destroy(h)
 
