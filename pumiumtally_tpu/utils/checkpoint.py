@@ -12,60 +12,166 @@ from __future__ import annotations
 
 import numpy as np
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+
+
+def _engine_kind(tally) -> str:
+    # Local imports: utils must not import the api package at module
+    # load (api imports utils).
+    from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
+    from pumiumtally_tpu.api.streaming import StreamingTally
+
+    if isinstance(tally, PartitionedPumiTally):
+        return "partitioned"
+    if isinstance(tally, StreamingTally):
+        return "streaming"
+    return "monolithic"
 
 
 def save_tally_state(tally, path: str) -> None:
-    """Write the full engine state of a ``PumiTally`` to ``path``."""
+    """Write the full engine state of any tally facade to ``path``.
+
+    Monolithic, streaming, and partitioned engines are all supported;
+    the caller-visible canonical form (positions/element ids in particle
+    order, flux in original element order) is what is stored, so a
+    checkpoint can be restored into a DIFFERENT engine configuration
+    over the same mesh (e.g. saved partitioned, resumed monolithic) —
+    the reference has no checkpointing at all (SURVEY.md §5).
+    """
+    kind = _engine_kind(tally)
+    if kind == "monolithic":
+        x = np.asarray(tally.x)
+        elem = np.asarray(tally.elem)
+    else:
+        # Canonical caller order; engines re-derive their layout.
+        x = np.asarray(tally.positions)
+        elem = np.asarray(tally.elem_ids)
     np.savez_compressed(
         path,
         format_version=np.int64(_FORMAT_VERSION),
+        kind=np.str_(kind),
         flux=np.asarray(tally.flux),
-        x=np.asarray(tally.x),
-        elem=np.asarray(tally.elem),
+        x=x,
+        elem=elem,
         iter_count=np.int64(tally.iter_count),
         num_particles=np.int64(tally.num_particles),
-        capacity=np.int64(tally.x.shape[0]),
+        capacity=np.int64(x.shape[0]),
         nelems=np.int64(tally.mesh.nelems),
         is_initialized=np.bool_(tally.is_initialized),
     )
 
 
+def _check_header(z, tally) -> None:
+    if int(z["format_version"]) > _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {int(z['format_version'])} newer than "
+            f"{_FORMAT_VERSION}"
+        )
+    if int(z["nelems"]) != tally.mesh.nelems:
+        raise ValueError(
+            f"checkpoint mesh has {int(z['nelems'])} elements, "
+            f"target has {tally.mesh.nelems}"
+        )
+    if int(z["num_particles"]) != tally.num_particles:
+        raise ValueError(
+            f"checkpoint has {int(z['num_particles'])} particles, "
+            f"target has {tally.num_particles}"
+        )
+
+
 def load_tally_state(tally, path: str) -> None:
     """Restore state saved by ``save_tally_state`` into ``tally``.
 
-    The target must be built over the same mesh and particle capacity;
-    mismatches raise rather than silently corrupt the tally.
+    The target must be built over the same mesh and particle count;
+    mismatches raise rather than silently corrupt the tally. The saved
+    state is canonical (caller particle order, original element order),
+    so the target's engine kind need not match the saver's.
     """
     import jax.numpy as jnp
 
+    kind = _engine_kind(tally)
     with np.load(path) as z:
-        if int(z["format_version"]) != _FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format {int(z['format_version'])} != "
-                f"{_FORMAT_VERSION}"
+        _check_header(z, tally)
+        n = tally.num_particles
+        flux = np.asarray(z["flux"], dtype=np.float64)
+        x = np.asarray(z["x"], dtype=np.float64)[:n]
+        elem = np.asarray(z["elem"], dtype=np.int32)[:n]
+        saved_kind = str(z["kind"]) if "kind" in z else "monolithic"
+        if saved_kind == "monolithic" and kind == "monolithic":
+            # v1-compatible direct restore (capacity layout preserved
+            # only when both sides are monolithic with equal capacity).
+            if int(z["capacity"]) == tally._cap:
+                tally.flux = jnp.asarray(z["flux"], dtype=tally.dtype)
+                tally.x = jnp.asarray(z["x"], dtype=tally.dtype)
+                tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
+                tally.iter_count = int(z["iter_count"])
+                tally.is_initialized = bool(z["is_initialized"])
+                return
+        _restore_canonical(tally, kind, x, elem, flux, z)
+
+
+def _restore_canonical(tally, kind, x, elem, flux, z) -> None:
+    import jax.numpy as jnp
+
+    n = tally.num_particles
+    if kind == "monolithic":
+        cap = tally._cap
+        xf = np.zeros((cap, 3), np.float64)
+        ef = np.zeros((cap,), np.int32)
+        xf[:n] = x[:n]
+        ef[:n] = elem[:n]
+        if cap > n:  # padded slots: park at slot n-1's state (inactive)
+            xf[n:] = x[n - 1]
+            ef[n:] = elem[n - 1]
+        tally.x = jnp.asarray(xf, dtype=tally.dtype)
+        tally.elem = jnp.asarray(ef)
+        tally.flux = jnp.asarray(flux, dtype=tally.dtype)
+    elif kind == "streaming":
+        # Reuse the engine's own staging helpers so the chunk layout
+        # and padding convention (repeat the last row) cannot diverge
+        # from what the walk path expects; only the final chunk pads,
+        # so elem's scalar fill matches x's last-row pad.
+        xflat = np.ascontiguousarray(x.reshape(-1))
+        for k in range(tally.nchunks):
+            tally._x[k] = tally._stage_chunk_positions(xflat, k)
+            tally._elem[k] = tally._stage_chunk_vec(
+                elem, k, np.int32, int(elem[n - 1])
             )
-        if int(z["nelems"]) != tally.mesh.nelems:
-            raise ValueError(
-                f"checkpoint mesh has {int(z['nelems'])} elements, "
-                f"target has {tally.mesh.nelems}"
-            )
-        if int(z["num_particles"]) != tally.num_particles:
-            raise ValueError(
-                f"checkpoint has {int(z['num_particles'])} particles, "
-                f"target has {tally.num_particles}"
-            )
-        # The internal capacity differs across device-mesh configs
-        # (padding to a multiple of the mesh size); restoring across
-        # them would corrupt array shapes.
-        if int(z["capacity"]) != tally._cap:
-            raise ValueError(
-                f"checkpoint particle capacity {int(z['capacity'])} != "
-                f"target capacity {tally._cap} (was it saved under a "
-                "different device_mesh configuration?)"
-            )
-        tally.flux = jnp.asarray(z["flux"], dtype=tally.dtype)
-        tally.x = jnp.asarray(z["x"], dtype=tally.dtype)
-        tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
-        tally.iter_count = int(z["iter_count"])
-        tally.is_initialized = bool(z["is_initialized"])
+        tally._flux = [jnp.asarray(flux, dtype=tally.dtype)] + [
+            jnp.zeros_like(tally._flux[0]) for _ in range(tally.nchunks - 1)
+        ]
+    elif kind == "partitioned":
+        eng = tally.engine
+        glid = np.asarray(eng.part.glid_of_orig)[elem]
+        st = dict(eng.state)
+        # Rebuild the slot layout from scratch: particle pid in slot pid,
+        # then one migration distributes to owners.
+        pid = np.full(eng.cap, -1, np.int32)
+        pid[:n] = np.arange(n, dtype=np.int32)
+        alive = pid >= 0
+        xf = np.zeros((eng.cap, 3), np.float64)
+        xf[:n] = x
+        pend = np.full(eng.cap, -1, np.int32)
+        pend[:n] = glid
+        st["x"] = jnp.asarray(xf, dtype=tally.dtype)
+        st["pid"] = jnp.asarray(pid)
+        st["alive"] = jnp.asarray(alive)
+        st["pending"] = jnp.asarray(pend)
+        st["lelem"] = jnp.zeros((eng.cap,), jnp.int32)
+        st["done"] = jnp.asarray(~alive)
+        st["exited"] = jnp.zeros((eng.cap,), bool)
+        from pumiumtally_tpu.parallel.partition import migrate
+
+        eng.state, overflow = migrate(
+            part_L=eng.part.L, ndev=eng.ndev,
+            cap_per_chip=eng.cap_per_chip, state=st,
+        )
+        eng._check_overflow(overflow)
+        eng.state["done"] = jnp.ones((eng.cap,), bool)
+        eng.state["pending"] = jnp.full((eng.cap,), -1, jnp.int32)
+        # Owned flux layout: original order -> padded glid slots.
+        fpad = np.zeros((eng.ndev * eng.part.L,), np.float64)
+        fpad[np.asarray(eng.part.glid_of_orig)] = flux
+        eng.flux_padded = jnp.asarray(fpad, dtype=tally.dtype)
+    tally.iter_count = int(z["iter_count"])
+    tally.is_initialized = bool(z["is_initialized"])

@@ -52,6 +52,59 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(t2.flux), np.asarray(t.flux))
 
 
+def test_checkpoint_cross_engine_roundtrip(tmp_path):
+    """A checkpoint is canonical: save from one engine kind, resume in
+    another, and the continued tally matches exactly."""
+    from pumiumtally_tpu import PartitionedPumiTally, StreamingTally
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    n = 600
+    mesh_args = (1, 1, 1, 4, 4, 4)
+    rng = np.random.default_rng(9)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), 0.02, 0.98)
+
+    t = PumiTally(build_box(*mesh_args), n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    ckpt = str(tmp_path / "c.npz")
+    save_tally_state(t, ckpt)
+
+    targets = {
+        "stream": StreamingTally(build_box(*mesh_args), n, chunk_size=250),
+        "part": PartitionedPumiTally(
+            build_box(*mesh_args), n,
+            TallyConfig(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+        ),
+    }
+    dst2 = np.clip(dst - 0.15, 0.02, 0.98)
+    t.MoveToNextLocation(None, dst2.reshape(-1).copy())
+    for name, t2 in targets.items():
+        load_tally_state(t2, ckpt)
+        np.testing.assert_allclose(
+            np.asarray(t2.flux), np.load(ckpt)["flux"], atol=1e-14,
+            err_msg=name,
+        )
+        np.testing.assert_array_equal(t2.elem_ids, np.load(ckpt)["elem"][:n])
+        # resumed engine continues identically to the original
+        t2.MoveToNextLocation(None, dst2.reshape(-1).copy())
+        np.testing.assert_allclose(
+            np.asarray(t2.flux), np.asarray(t.flux), rtol=1e-11,
+            atol=1e-12, err_msg=name,
+        )
+        np.testing.assert_array_equal(t2.elem_ids, t.elem_ids, err_msg=name)
+
+    # and the reverse: save from partitioned, resume monolithic
+    ckpt2 = str(tmp_path / "c2.npz")
+    save_tally_state(targets["part"], ckpt2)
+    t3 = PumiTally(build_box(*mesh_args), n)
+    load_tally_state(t3, ckpt2)
+    np.testing.assert_allclose(
+        np.asarray(t3.flux), np.asarray(targets["part"].flux), atol=1e-14
+    )
+    np.testing.assert_array_equal(t3.elem_ids, targets["part"].elem_ids)
+
+
 def test_checkpoint_mismatch_raises(tmp_path):
     t = _driven_tally()
     ckpt = str(tmp_path / "state.npz")

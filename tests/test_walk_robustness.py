@@ -1,0 +1,93 @@
+"""Geometric edge cases for the walk kernel.
+
+The verify playbook's probes: source points exactly on vertices/edges/
+faces, rays along face planes, zero-length flights, destinations
+exactly on the domain boundary. None of these may hang, lose a
+particle (elem = -1), or tally a wrong total length.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+TOL = 1e-9
+
+
+def _drive(points, dests, div=3):
+    n = points.shape[0]
+    mesh = build_box(1, 1, 1, div, div, div)
+    t = PumiTally(mesh, n, TallyConfig())
+    t.CopyInitialPosition(points.reshape(-1).copy())
+    assert (t.elem_ids >= 0).all()
+    assert (t.elem_ids < mesh.nelems).all()
+    t.MoveToNextLocation(None, dests.reshape(-1).copy())
+    return t
+
+
+def test_sources_on_vertices_edges_faces():
+    pts = np.array([
+        [0.0, 0.0, 0.0],          # domain corner vertex
+        [1 / 3, 1 / 3, 1 / 3],    # interior grid vertex
+        [0.5, 1 / 3, 1 / 3],      # interior grid edge
+        [0.5, 0.5, 1 / 3],        # interior cell-face point
+        [0.5, 0.5, 0.0],          # boundary face point
+        [1.0, 1.0, 1.0],          # far corner
+    ])
+    dests = np.full_like(pts, 0.51)
+    t = _drive(pts, dests)
+    np.testing.assert_allclose(t.positions, dests, atol=TOL)
+    total = float(np.asarray(t.flux).sum())
+    expect = float(np.linalg.norm(dests - pts, axis=1).sum())
+    np.testing.assert_allclose(total, expect, rtol=1e-9)
+
+
+def test_ray_along_grid_planes():
+    """Flight exactly inside a mesh face plane (degenerate but legal)."""
+    n = 3
+    pts = np.array([
+        [0.1, 1 / 3, 0.2],   # travels inside the y=1/3 plane
+        [1 / 3, 0.1, 0.9],   # inside x=1/3 plane
+        [0.2, 0.2, 0.5],
+    ])
+    dests = pts.copy()
+    dests[0, 0] = 0.9
+    dests[1, 1] = 0.9
+    dests[2] = [0.8, 0.8, 0.5]
+    t = _drive(pts, dests)
+    np.testing.assert_allclose(t.positions, dests, atol=1e-7)
+    total = float(np.asarray(t.flux).sum())
+    expect = float(np.linalg.norm(dests - pts, axis=1).sum())
+    np.testing.assert_allclose(total, expect, rtol=1e-7)
+
+
+def test_zero_length_flights_tally_nothing():
+    pts = np.random.default_rng(0).uniform(0.05, 0.95, (50, 3))
+    t = _drive(pts, pts.copy())
+    np.testing.assert_allclose(np.asarray(t.flux), 0.0, atol=1e-15)
+    np.testing.assert_allclose(t.positions, pts, atol=TOL)
+
+
+def test_destination_exactly_on_boundary():
+    pts = np.tile([0.4, 0.5, 0.5], (4, 1))
+    dests = np.array([
+        [1.0, 0.5, 0.5],   # +x face
+        [0.0, 0.5, 0.5],   # -x face
+        [0.4, 1.0, 0.5],   # +y face
+        [0.4, 0.5, 0.0],   # -z face
+    ])
+    t = _drive(pts, dests)
+    np.testing.assert_allclose(t.positions, dests, atol=1e-7)
+    total = float(np.asarray(t.flux).sum())
+    expect = float(np.linalg.norm(dests - pts, axis=1).sum())
+    np.testing.assert_allclose(total, expect, rtol=1e-9)
+
+
+def test_corner_to_corner_diagonal():
+    """The worst ray: full body diagonal grazing many edges/vertices."""
+    pts = np.array([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]])
+    dests = np.array([[1.0, 1.0, 1.0], [0.0, 1.0, 1.0]])
+    t = _drive(pts, dests, div=5)
+    np.testing.assert_allclose(t.positions, dests, atol=1e-6)
+    total = float(np.asarray(t.flux).sum())
+    np.testing.assert_allclose(total, 2 * np.sqrt(3.0), rtol=1e-7)
