@@ -132,8 +132,10 @@ def walk(
         active = ~done
         d = dest - x  # remaining segment
         fn, fo, adj = _gather_walk_row(mesh, elem)
-        denom = jnp.einsum("nfc,nc->nf", fn, d)
-        numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
+        # One pass over the gathered normals for both projections.
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d, x], axis=-1))
+        denom = both[..., 0]
+        numer = fo - both[..., 1]
         crossing = denom > tol
         t = jnp.where(crossing, numer / jnp.where(crossing, denom, one), jnp.inf)
         # x may sit epsilon-outside a face after a previous step; don't
