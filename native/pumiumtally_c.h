@@ -22,8 +22,9 @@ extern "C" {
 
 typedef struct pumiumtally_handle pumiumtally_handle;
 
-/* Create an engine bound to a mesh file (.msh Gmsh ASCII or .npz mesh
- * bundle; the reference ctor takes its .osh path, PumiTally.h:50).
+/* Create an engine bound to a mesh file (.msh Gmsh ASCII or .osh
+ * Omega_h directory; the reference ctor takes its .osh path,
+ * PumiTally.h:50).
  * Returns NULL on failure (error printed to stderr). */
 pumiumtally_handle* pumiumtally_create(const char* mesh_filename,
                                        int32_t num_particles);

@@ -38,12 +38,6 @@ class TallyConfig:
       check_found_all: if True, device→host sync after each search to
         warn when particles did not converge (costs a sync; disable for
         max throughput).
-      migrate_every: reference-parity knob only (``iter_count % 100``,
-        PumiTallyImpl.cpp:111). The TPU partitioned engine does NOT use
-        a cadence: a particle migrates exactly when it pauses at a
-        partition face, because an un-migrated paused particle would
-        idle its slot for the rest of the round anyway (MPI ranks can
-        keep walking other particles; lock-step SPMD chips cannot).
       device_mesh: optional ``jax.sharding.Mesh`` with a ``dp`` axis.
         When set, particle batches are sharded over it and per-element
         flux is psum-reduced across it (the TPU-native replacement for
@@ -64,7 +58,12 @@ class TallyConfig:
     max_iters: Optional[int] = None
     dtype: Any = None
     check_found_all: bool = True
-    migrate_every: int = 100
+    # NOTE: the reference's migration cadence (``iter_count % 100``,
+    # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU
+    # partitioned engine migrates a particle exactly when it pauses at a
+    # partition face, because an un-migrated paused particle would idle
+    # its slot for the rest of the round anyway (MPI ranks can keep
+    # walking other particles; lock-step SPMD chips cannot).
     device_mesh: Optional[jax.sharding.Mesh] = None
     capacity_factor: float = 1.5
     max_migration_rounds: int = 64
