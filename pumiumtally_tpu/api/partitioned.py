@@ -54,6 +54,7 @@ class PartitionedPumiTally(PumiTally):
             tol=self._tol,
             max_iters=self._max_iters,
             max_rounds=self.config.max_migration_rounds,
+            check_found_all=self.config.check_found_all,
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

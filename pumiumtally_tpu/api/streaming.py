@@ -51,9 +51,11 @@ class StreamingTally(PumiTally):
       mesh: TetMesh or mesh file path.
       num_particles: TOTAL batch size (e.g. 10_000_000).
       chunk_size: particles staged/walked per pipeline step.
-      config: engine knobs (device_mesh is not supported here yet —
-        combine chunks with the replicated sharded mode by passing a
-        sharded chunk engine once needed).
+      config: engine knobs. With ``config.device_mesh`` set, every
+        chunk's walk is the replicated-mesh sharded step
+        (``parallel.sharded``): the chunk is sharded over the ``dp``
+        axis and its flux delta psum'd over ICI — BASELINE configs 3+5
+        (multi-chip × 10M-particle streaming) compose.
     """
 
     def __init__(
@@ -65,12 +67,14 @@ class StreamingTally(PumiTally):
     ):
         t0 = time.perf_counter()
         mesh = self._init_common(mesh, num_particles, config)
-        if self.device_mesh is not None:
-            raise NotImplementedError(
-                "StreamingTally is single-chip for now; use PumiTally with "
-                "device_mesh for sharded batches"
-            )
         self.chunk_size = int(min(chunk_size, self.num_particles))
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import axis_name
+
+            axis_name(self.device_mesh)  # fail fast: must be 1-D
+            ndev = self.device_mesh.devices.size
+            # Chunks shard evenly over the mesh; pad slots never fly.
+            self.chunk_size = -(-self.chunk_size // ndev) * ndev
         self.nchunks = -(-self.num_particles // self.chunk_size)
         c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0).astype(self.dtype)
         self._x = [
@@ -125,10 +129,20 @@ class StreamingTally(PumiTally):
         dones = []
         for k in range(self.nchunks):
             dest = self._stage_chunk_positions(host, k)
-            self._x[k], self._elem[k], done, _ = _localize_step(
-                self.mesh, self._x[k], self._elem[k], dest,
-                tol=self._tol, max_iters=self._max_iters,
-            )
+            if self.device_mesh is not None:
+                from pumiumtally_tpu.parallel.sharded import (
+                    sharded_localize_step,
+                )
+
+                self._x[k], self._elem[k], done, _ = sharded_localize_step(
+                    self.device_mesh, self.mesh, self._x[k], self._elem[k],
+                    dest, tol=self._tol, max_iters=self._max_iters,
+                )
+            else:
+                self._x[k], self._elem[k], done, _ = _localize_step(
+                    self.mesh, self._x[k], self._elem[k], dest,
+                    tol=self._tol, max_iters=self._max_iters,
+                )
             dones.append(done)
         if self.config.check_found_all and not all(
             bool(jnp.all(d)) for d in dones
@@ -181,7 +195,30 @@ class StreamingTally(PumiTally):
                 mask = np.zeros(self.chunk_size, np.int8)
                 mask[: hi - lo] = 1
                 fly = fly * jnp.asarray(mask)
-            if origins_h is None:
+            if self.device_mesh is not None:
+                from pumiumtally_tpu.parallel.sharded import (
+                    sharded_move_step,
+                    sharded_move_step_continue,
+                )
+
+                if origins_h is None:
+                    (
+                        self._x[k], self._elem[k], self._flux[k], ok,
+                    ) = sharded_move_step_continue(
+                        self.device_mesh, self.mesh, self._x[k],
+                        self._elem[k], dest, fly, w, self._flux[k],
+                        tol=self._tol, max_iters=self._max_iters,
+                    )
+                else:
+                    orig = self._stage_chunk_positions(origins_h, k)
+                    (
+                        self._x[k], self._elem[k], self._flux[k], ok,
+                    ) = sharded_move_step(
+                        self.device_mesh, self.mesh, self._x[k],
+                        self._elem[k], orig, dest, fly, w, self._flux[k],
+                        tol=self._tol, max_iters=self._max_iters,
+                    )
+            elif origins_h is None:
                 self._x[k], self._elem[k], self._flux[k], ok = _move_step_continue(
                     self.mesh, self._x[k], self._elem[k], dest, fly, w,
                     self._flux[k], tol=self._tol, max_iters=self._max_iters,

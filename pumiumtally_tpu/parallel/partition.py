@@ -30,13 +30,18 @@ Here:
   cross-chip reduction is needed at all (the ICI traffic is particle
   migration) and the result is deterministic by construction.
 
-The first localization (CopyInitialPosition) walks particles over the
-full replicated mesh — all particles start in element 0 (reference
-semantics, PumiTallyImpl.cpp:492-528), which one chip owns, so an
-ownership-restricted first walk would funnel the whole batch through
-one chip. After localization, one migration distributes particles to
-their owners and the replicated table is no longer used by the move
-path.
+Localization (CopyInitialPosition) is SHARDED point location, not a
+replicated walk: the reference walks every particle from element 0's
+centroid to its source point only because it has no search structure
+(PumiTallyImpl.cpp:492-528) — the observable contract is just "each
+particle ends in the element containing its source point, zero flux".
+Here every chip tests all source points against its OWN elements' four
+face planes — one [C,3]×[3,4L] matmul per point chunk, MXU-shaped —
+and claims the points it contains; claims are combined with a single
+``pmin`` over the mesh axis (ties on shared faces resolve to the lowest
+padded global id, deterministically). No [E]-sized replicated array is
+touched, and an all-particles-in-one-element start cannot overflow a
+single chip's slots the way a literal walk-from-element-0 would.
 """
 
 from __future__ import annotations
@@ -107,6 +112,12 @@ class MeshPartition:
     glid_of_orig: Any  # [E] int32, original elem -> padded global id
     orig_of_glid: Any  # [ndev*L] int32, padded global id -> orig elem (-1 pad)
     table: Any  # [ndev*L, 20] local walk rows (adj local-encoded)
+    # Non-None when the padded id range exceeds what the float dtype
+    # represents exactly (f32 past 2^24): adjacency then lives in its
+    # own int32 array and the table's adj lanes are unused. Costs the
+    # walk a second (4-int) gather per iteration but removes the mesh
+    # size ceiling — a ~2M-tet f32 mesh on 8 chips builds fine.
+    adj_int: Any = None  # [ndev*L, 4] int32 local-encoded adjacency
 
     def flux_to_original(self, flux_padded: jnp.ndarray) -> jnp.ndarray:
         """Reorder an owned [ndev*L] flux into original element order."""
@@ -114,9 +125,17 @@ class MeshPartition:
 
 
 def build_partition(
-    mesh: TetMesh, ndev: int, dtype: Optional[Any] = None
+    mesh: TetMesh,
+    ndev: int,
+    dtype: Optional[Any] = None,
+    force_split_adj: bool = False,
 ) -> MeshPartition:
-    """Partition ``mesh`` into ``ndev`` contiguous padded element blocks."""
+    """Partition ``mesh`` into ``ndev`` contiguous padded element blocks.
+
+    ``force_split_adj`` stores adjacency as int32 out-of-row even when
+    the float dtype could hold it exactly (the automatic fallback for
+    big f32 meshes, forced for testing).
+    """
     if dtype is None:
         dtype = mesh.coords.dtype
     coords = np.asarray(mesh.coords, dtype=np.float64)
@@ -131,12 +150,11 @@ def build_partition(
     counts = np.bincount(owner, minlength=ndev)
     L = int(counts.max())
     # Remote faces encode -(glid+2) with glid < ndev*L, so THAT is the
-    # magnitude that must survive the float walk-table round-trip.
-    if ndev * L + 2 >= 2 ** (np.finfo(np.dtype(dtype)).nmant + 1):
-        raise ValueError(
-            f"padded global id range {ndev * L + 2} not exactly "
-            f"representable in {np.dtype(dtype).name} walk-table ids"
-        )
+    # magnitude that must survive a float walk-table round-trip; past
+    # the exact-id limit adjacency moves to a separate int32 array.
+    split_adj = force_split_adj or (
+        ndev * L + 2 >= 2 ** (np.finfo(np.dtype(dtype)).nmant + 1)
+    )
 
     # Renumber: elements of chip d occupy glids [d*L, d*L+counts[d]).
     order = np.argsort(owner, kind="stable")  # orig elems grouped by owner
@@ -164,8 +182,13 @@ def build_partition(
     table = np.zeros((ndev * L, 20), dtype=np.float64)
     table[glid_of_orig, WALK_TABLE_NORMALS] = normals.reshape(ne, 12)
     table[glid_of_orig, WALK_TABLE_OFFSETS] = offsets
-    table[glid_of_orig, WALK_TABLE_ADJ] = local_adj
-    table[:, WALK_TABLE_ADJ][orig_of_glid < 0] = -1.0
+    adj_full = np.full((ndev * L, 4), -1.0)
+    adj_full[glid_of_orig] = local_adj
+    adj_int = None
+    if split_adj:
+        adj_int = jnp.asarray(adj_full.astype(np.int32))
+    else:
+        table[:, WALK_TABLE_ADJ] = adj_full
 
     return MeshPartition(
         ndev=ndev,
@@ -175,6 +198,7 @@ def build_partition(
         glid_of_orig=jnp.asarray(glid_of_orig, jnp.int32),
         orig_of_glid=jnp.asarray(orig_of_glid),
         table=jnp.asarray(table, dtype=dtype),
+        adj_int=adj_int,
     )
 
 
@@ -196,6 +220,7 @@ def walk_local(
     tally: bool,
     tol: float,
     max_iters: int,
+    adj_int: Optional[jnp.ndarray] = None,  # [L,4] when ids don't fit the float
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
@@ -219,7 +244,10 @@ def walk_local(
         n = row.shape[0]
         fn = row[:, WALK_TABLE_NORMALS].reshape(n, 4, 3)
         fo = row[:, WALK_TABLE_OFFSETS]
-        adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
+        if adj_int is not None:
+            adj = adj_int[lelem]
+        else:
+            adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
         denom = jnp.einsum("nfc,nc->nf", fn, d)
         numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
         crossing = denom > tol
@@ -258,22 +286,10 @@ def walk_local(
 # Global migration (jit-level; XLA inserts the collectives)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("part_L", "ndev", "cap_per_chip"))
-def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
-    """Ship paused particles (pending >= 0) to the chip owning their
-    target element; everything else stays in its chip's slot range.
-
-    ``state`` is a dict of [cap]-shaped arrays that must travel with the
-    particle (x, lelem, pending, done, exited, alive, pid, dest, fly, w).
-    Returns (new_state, overflowed) — overflow means some chip received
-    more particles than its slot capacity.
-
-    Jitted as ONE program: the sort/scatter over device-sharded arrays
-    lowers to a single XLA module (one set of collectives), which both
-    performs better and avoids flooding the runtime with per-op
-    rendezvous (observed to trip XLA:CPU's 40s collective timeout when
-    issued eagerly op-by-op on 8 virtual devices).
-    """
+def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict):
+    """Trace-level body of ``migrate`` (see below) — also inlined into
+    the jitted phase round loop so walk+migrate rounds compile as ONE
+    program with no per-round host sync."""
     cap = state["pid"].shape[0]
     slot_chip = (jnp.cumsum(jnp.ones_like(state["pid"])) - 1) // cap_per_chip
     pending = state["pending"]
@@ -308,6 +324,25 @@ def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     return new_state, overflow
 
 
+@partial(jax.jit, static_argnames=("part_L", "ndev", "cap_per_chip"))
+def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
+    """Ship paused particles (pending >= 0) to the chip owning their
+    target element; everything else stays in its chip's slot range.
+
+    ``state`` is a dict of [cap]-shaped arrays that must travel with the
+    particle (x, lelem, pending, done, exited, alive, pid, dest, fly, w).
+    Returns (new_state, overflowed) — overflow means some chip received
+    more particles than its slot capacity.
+
+    Jitted as ONE program: the sort/scatter over device-sharded arrays
+    lowers to a single XLA module (one set of collectives), which both
+    performs better and avoids flooding the runtime with per-op
+    rendezvous (observed to trip XLA:CPU's 40s collective timeout when
+    issued eagerly op-by-op on 8 virtual devices).
+    """
+    return _migrate_impl(part_L, ndev, cap_per_chip, state)
+
+
 def _default_state(cap: int, like: dict) -> dict:
     d = {}
     for k, v in like.items():
@@ -320,6 +355,35 @@ def _default_state(cap: int, like: dict) -> dict:
         else:
             d[k] = jnp.zeros((cap,) + v.shape[1:], v.dtype)
     return d
+
+
+# ---------------------------------------------------------------------------
+# Sharded point location (localization without a replicated mesh)
+# ---------------------------------------------------------------------------
+
+def _locate_chunk(
+    table: jnp.ndarray,  # [L,20] this chip's walk rows
+    valid: jnp.ndarray,  # [L] bool, False on padding rows
+    pts: jnp.ndarray,  # [C,3]
+    tol: float,
+) -> jnp.ndarray:
+    """Local element containing each point, or -1.
+
+    A point is inside a tet iff it is on the inner side of all four
+    face planes. The test over every local element is one [C,3]×[3,4L]
+    matmul — MXU-shaped, no gather — followed by a compare-and-reduce.
+    Ties (points within tol of a shared face) go to the lowest local id
+    via argmax-of-first-True: deterministic.
+    """
+    L = table.shape[0]
+    nmat = table[:, WALK_TABLE_NORMALS].reshape(L * 4, 3)
+    fo = table[:, WALK_TABLE_OFFSETS]  # [L,4]
+    proj = pts @ nmat.T  # [C, 4L]
+    ok = (proj.reshape(pts.shape[0], L, 4) <= fo[None] + tol).all(axis=2)
+    ok = ok & valid[None, :]
+    found = ok.any(axis=1)
+    le = jnp.argmax(ok, axis=1).astype(jnp.int32)
+    return jnp.where(found, le, -1)
 
 
 # ---------------------------------------------------------------------------
@@ -345,12 +409,16 @@ class PartitionedEngine:
         tol: float,
         max_iters: int,
         max_rounds: int = 64,
+        check_found_all: bool = True,
     ):
-        self.mesh = mesh
+        self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
         self.ndev = int(device_mesh.devices.size)
         self.n = int(num_particles)
+        # The full TetMesh is consumed here once and NOT retained: after
+        # build_partition every engine path (localization included)
+        # touches only per-chip sharded tables.
         self.part = build_partition(mesh, self.ndev)
         self.cap_per_chip = int(
             -(-self.n // self.ndev) * capacity_factor + 1
@@ -367,7 +435,10 @@ class PartitionedEngine:
         pid = np.full(self.cap, -1, np.int32)
         pid[: self.n] = np.arange(self.n, dtype=np.int32)
         alive = pid >= 0
-        self._round_fns: dict = {}
+        self._phase_fns: dict = {}
+        self._locate_fn = None
+        self._n_lost = 0
+        self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
             "x": jnp.zeros((self.cap, 3), dtype),
             "lelem": jnp.zeros((self.cap,), jnp.int32),
@@ -376,6 +447,11 @@ class PartitionedEngine:
             "alive": jnp.asarray(alive),
             "done": jnp.asarray(~alive),
             "exited": jnp.zeros((self.cap,), bool),
+            # Localization failures (source point in no element): such
+            # particles are excluded from every walk (fly forced 0) so
+            # they can never tally phantom track length from an
+            # undefined element.
+            "lost": jnp.zeros((self.cap,), bool),
             "dest": jnp.zeros((self.cap, 3), dtype),
             "fly": jnp.zeros((self.cap,), jnp.int8),
             "w": jnp.zeros((self.cap,), dtype),
@@ -393,27 +469,87 @@ class PartitionedEngine:
         return jnp.where(mask, v, fill)
 
     # -- phases ----------------------------------------------------------
-    def localize(self, dest_n: jnp.ndarray) -> Tuple[bool, bool]:
-        """CopyInitialPosition: walk over the FULL mesh from element 0's
-        centroid (reference cpp:492-528), then distribute to owners.
-        Returns (found_all, any_exited)."""
-        from pumiumtally_tpu.api.tally import _localize_step
+    def _locate_program(self):
+        """Cached jitted sharded point-location: [M,3] replicated points
+        → [M] padded global element id (``ndev*L`` = not found)."""
+        if self._locate_fn is not None:
+            return self._locate_fn
+        pp = P(self.axis)
+        ax = self.axis
+        L = self.part.L
+        sentinel = jnp.asarray(self.ndev * L, jnp.int32)
+        tol = self.tol
+        C = self._locate_chunk_size
 
-        c0 = jnp.mean(
-            self.mesh.coords[self.mesh.tet2vert[0]], axis=0
-        ).astype(self.mesh.coords.dtype)
-        x0 = jnp.broadcast_to(c0, (self.n, 3))
-        e0 = jnp.zeros((self.n,), jnp.int32)
-        x1, elem1, done, exited = _localize_step(
-            self.mesh, x0, e0, dest_n, tol=self.tol, max_iters=self.max_iters
+        @jax.jit
+        @partial(
+            shard_map,
+            mesh=self.device_mesh,
+            in_specs=(pp, pp, P()),
+            out_specs=P(),
         )
-        glid = self.part.glid_of_orig[elem1]
-        st = self.state
-        st = dict(st)
-        st["x"] = self._by_pid(x1, jnp.zeros((), x1.dtype))
-        st["pending"] = jnp.where(
-            st["alive"], self._by_pid(glid, -1), st["pending"]
-        ).astype(jnp.int32)
+        def locate(table, valid, pts):
+            le = lax.map(
+                lambda p: _locate_chunk(table, valid, p, tol),
+                pts.reshape(-1, C, 3),
+            ).reshape(-1)
+            d = lax.axis_index(ax).astype(jnp.int32)
+            glid = jnp.where(le >= 0, d * L + le, sentinel)
+            # Lowest claiming glid wins (deterministic tie-break on
+            # shared partition faces).
+            return lax.pmin(glid, ax)
+
+        self._locate_fn = locate
+        return locate
+
+    @property
+    def _locate_chunk_size(self) -> int:
+        # Bound the [C, 4L] matmul intermediate to ~32M floats per chip
+        # (128 MB f32) so point location cannot OOM on meshes whose L
+        # reaches hundreds of thousands of elements.
+        cap = max(8, (1 << 23) // max(self.part.L, 1))
+        return min(2048, cap, self.n)
+
+    def _locate_points(self, pts_n: jnp.ndarray) -> jnp.ndarray:
+        """[n] padded global element id per point (``ndev*L`` = in no
+        element), via the cached sharded point-location program."""
+        locate = self._locate_program()
+        C = self._locate_chunk_size
+        m = -(-self.n // C) * C
+        pts = pts_n
+        if m > self.n:
+            # Far-away pad points: outside every tet, claimed by no one.
+            pts = jnp.concatenate(
+                [pts, jnp.full((m - self.n, 3), 2e30, pts_n.dtype)]
+            )
+        return locate(self.part.table, self._valid, pts)[: self.n]
+
+    def localize(self, dest_n: jnp.ndarray) -> Tuple[Any, int]:
+        """CopyInitialPosition: sharded point location (module docstring)
+        instead of the reference's walk-from-element-0 — same observable
+        contract (particle lands in the element containing its source
+        point, zero flux). Returns (found_all, n_exited=0).
+
+        Divergence from the single-chip engine, by design: a source
+        point inside NO element (out-of-hull, or a non-convex gap) makes
+        its particle ``lost`` — excluded from transport, elem id −1 —
+        where the single-chip walk clamps it to the hull boundary and
+        keeps transporting it. A later two-phase move with a valid
+        resampled origin revives the particle (see ``move``); the
+        reference requires convex geometry with interior sources
+        (reference README.md:112-113), so located particles never hit
+        this path.
+        """
+        glid = self._locate_points(dest_n)
+        sentinel = self.ndev * self.part.L
+        found = glid < sentinel
+        st = dict(self.state)
+        st["x"] = self._by_pid(dest_n, jnp.zeros((), dest_n.dtype))
+        pend = self._by_pid(jnp.where(found, glid, -1), -1)
+        st["pending"] = jnp.where(st["alive"], pend, st["pending"]).astype(
+            jnp.int32
+        )
+        st["lost"] = st["alive"] & (st["pending"] < 0)
         st["done"] = ~st["alive"]
         st["exited"] = jnp.zeros((self.cap,), bool)
         self.state, overflow = migrate(
@@ -424,67 +560,123 @@ class PartitionedEngine:
         # Mark the phase finished for all particles.
         self.state["done"] = jnp.ones((self.cap,), bool)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
-        return bool(jnp.all(done)), int(jnp.sum(exited))
+        # One host sync per localization (not per move): the revival
+        # path in move() only engages while lost particles exist.
+        self._n_lost = int(jnp.sum(~found))
+        if self._n_lost and self.check_found_all:
+            print(
+                f"[WARNING] {self._n_lost} source points lie in no mesh "
+                "element; their particles are excluded from transport"
+            )
+        return jnp.all(found), 0
 
-    def _sharded_walk_round(self, tally: bool):
-        """One shard_map'd local-walk pass over all chips (cached per
-        tally flag so each is traced/compiled once per engine)."""
-        if tally in self._round_fns:
-            return self._round_fns[tally]
+    def _phase_program(self, tally: bool):
+        """Cached jitted FULL phase: initial walk round plus as many
+        migrate→walk rounds as needed, all inside one ``lax.while_loop``
+        — zero per-round host syncs (the reference's search loop pays an
+        MPI rendezvous per migration instead)."""
+        if tally in self._phase_fns:
+            return self._phase_fns[tally]
         pp = P(self.axis)
         ax = self.axis
+        part_L, ndev, cpc = self.part.L, self.ndev, self.cap_per_chip
+        tol, max_iters = self.tol, self.max_iters
+        max_rounds = self.max_rounds
+        has_adj = self.part.adj_int is not None
 
-        @jax.jit
-        @partial(
-            shard_map,
-            mesh=self.device_mesh,
-            in_specs=(pp, pp, pp, pp, pp, pp, pp, pp, pp),
-            out_specs=(pp, pp, pp, pp, pp, pp, P(), P()),
-        )
-        def round_fn(table, x, lelem, dest, fly, w, done, exited, flux):
+        def round_kernel(table, *rest):
+            if has_adj:
+                adj, x, lelem, dest, fly, w, done, exited, flux = rest
+            else:
+                adj = None
+                x, lelem, dest, fly, w, done, exited, flux = rest
             x, lelem, done, exited, pending, flux, _ = walk_local(
                 table, x, lelem, dest, fly, w, done, exited, flux,
-                tally=tally, tol=self.tol, max_iters=self.max_iters,
+                tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
             )
-            # Global round status computed in-program (one psum) so the
-            # host does a single scalar fetch per round instead of
-            # issuing eager cross-device reductions.
+            # Global round status computed in-program (one psum each) so
+            # the while_loop can branch on them without leaving the
+            # device.
             n_pending = lax.psum(jnp.sum(pending >= 0), ax)
             n_not_done = lax.psum(jnp.sum(~done), ax)
             return x, lelem, done, exited, pending, flux, n_pending, n_not_done
 
-        self._round_fns[tally] = round_fn
-        return round_fn
+        n_in = 10 if has_adj else 9
+        round_sm = shard_map(
+            round_kernel,
+            mesh=self.device_mesh,
+            in_specs=(pp,) * n_in,
+            out_specs=(pp,) * 6 + (P(), P()),
+        )
+
+        @jax.jit
+        def phase(table, adj, state, flux):
+            st = dict(state)
+            st["done"] = ~st["alive"] | (st["fly"] == 0)
+            # Non-flying particles hold position: dest <- x.
+            st["dest"] = jnp.where(
+                (st["fly"] == 1)[:, None], st["dest"], st["x"]
+            )
+
+            def call_round(st, fx):
+                args = (table,) + ((adj,) if has_adj else ()) + (
+                    st["x"], st["lelem"], st["dest"], st["fly"], st["w"],
+                    st["done"], st["exited"], fx,
+                )
+                x, lelem, done, exited, pending, fx, n_p, n_nd = round_sm(
+                    *args
+                )
+                return (
+                    dict(st, x=x, lelem=lelem, done=done, exited=exited,
+                         pending=pending),
+                    fx, n_p, n_nd,
+                )
+
+            st, fx, n_p, n_nd = call_round(st, flux)
+
+            def cond(c):
+                it, _st, _fx, n_p, _n_nd, ovf = c
+                return (n_p > 0) & (it < max_rounds) & ~ovf
+
+            def body(c):
+                it, st, fx, n_p, n_nd, ovf = c
+                st2, ovf2 = _migrate_impl(part_L, ndev, cpc, st)
+                # An overflowing migrate scatters colliding slots: do
+                # NOT walk (and tally) from that corrupted state — the
+                # loop cond exits on ovf and the host raises.
+                st3, fx3, n_p3, n_nd3 = lax.cond(
+                    ovf2,
+                    lambda op: (op[0], op[1], n_p, n_nd),
+                    lambda op: call_round(*op),
+                    (st2, fx),
+                )
+                return it + 1, st3, fx3, n_p3, n_nd3, ovf | ovf2
+
+            it, st, fx, n_p, n_nd, ovf = lax.while_loop(
+                cond, body,
+                (jnp.asarray(1, jnp.int32), st, fx, n_p, n_nd,
+                 jnp.asarray(False)),
+            )
+            found_all = (n_nd == 0) & (n_p == 0)
+            return st, fx, found_all, ovf
+
+        self._phase_fns[tally] = phase
+        return phase
 
     def _run_phase(self, tally: bool) -> bool:
-        """Walk+migrate rounds until no particle is active or pending.
+        """One jitted walk+migrate phase; a single host sync at the end.
         Returns found_all (False if the round budget ran out)."""
-        st = self.state
-        st["done"] = ~st["alive"] | (st["fly"] == 0)
-        # Non-flying particles hold position: dest <- x.
-        st["dest"] = jnp.where((st["fly"] == 1)[:, None], st["dest"], st["x"])
-        round_fn = self._sharded_walk_round(tally)
-        for _ in range(self.max_rounds):
-            x, lelem, done, exited, pending, flux, n_pending, n_not_done = (
-                round_fn(
-                    self.part.table, st["x"], st["lelem"], st["dest"],
-                    st["fly"], st["w"], st["done"], st["exited"],
-                    self.flux_padded,
-                )
-            )
-            st.update(x=x, lelem=lelem, done=done, exited=exited,
-                      pending=pending)
-            self.flux_padded = flux
-            if int(n_pending) == 0:
-                self.state = st
-                return int(n_not_done) == 0
-            st, overflow = migrate(
-                part_L=self.part.L, ndev=self.ndev,
-                cap_per_chip=self.cap_per_chip, state=st,
-            )
-            self._check_overflow(overflow)
+        phase = self._phase_program(tally)
+        st, fx, found_all, ovf = phase(
+            self.part.table, self.part.adj_int, self.state, self.flux_padded
+        )
+        ovf_v, found_v = jax.device_get((ovf, found_all))
+        # Raise BEFORE committing: on overflow the engine keeps its
+        # pre-phase state/flux instead of a corrupted post-overflow one.
+        self._check_overflow(ovf_v)
         self.state = st
-        return False
+        self.flux_padded = fx
+        return bool(found_v)
 
     def move(
         self,
@@ -494,8 +686,17 @@ class PartitionedEngine:
         w_n: jnp.ndarray,
     ) -> bool:
         """Full (or continue-mode) tallied move. Returns found_all."""
+        if origins_n is not None and self._n_lost:
+            # Revival: a resampled origin inside the mesh re-locates a
+            # lost particle (mirrors the single-chip engine, where
+            # phase A walks the reincarnated particle to its new
+            # origin, PumiTallyImpl.cpp:88-109).
+            self._revive_lost(origins_n)
         st = self.state
         st["fly"] = self._by_pid(fly_n, jnp.asarray(0, jnp.int8)).astype(jnp.int8)
+        # Lost particles (no containing element at localization) never
+        # fly: an undefined start element must not produce tallies.
+        st["fly"] = jnp.where(st["lost"], jnp.asarray(0, jnp.int8), st["fly"])
         st["w"] = self._by_pid(w_n, jnp.asarray(0.0, st["w"].dtype))
         ok_a = True
         if origins_n is not None:
@@ -513,6 +714,29 @@ class PartitionedEngine:
         self.state = st
         ok_b = self._run_phase(tally=True)
         return ok_a and ok_b
+
+    def _revive_lost(self, origins_n: jnp.ndarray) -> None:
+        """Re-locate lost particles whose resampled origin lies inside
+        the mesh; they rejoin transport from that origin."""
+        glid = self._locate_points(origins_n)
+        sentinel = self.ndev * self.part.L
+        st = dict(self.state)
+        pend = self._by_pid(jnp.where(glid < sentinel, glid, -1), -1)
+        revive = st["lost"] & (pend >= 0)
+        st["x"] = jnp.where(
+            revive[:, None],
+            self._by_pid(origins_n, jnp.zeros((), st["x"].dtype)),
+            st["x"],
+        )
+        st["pending"] = jnp.where(revive, pend, -1).astype(jnp.int32)
+        st["lost"] = st["lost"] & ~revive
+        self.state, overflow = migrate(
+            part_L=self.part.L, ndev=self.ndev,
+            cap_per_chip=self.cap_per_chip, state=st,
+        )
+        self._check_overflow(overflow)
+        self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
+        self._n_lost = int(jnp.sum(self.state["lost"]))
 
     # -- outputs ---------------------------------------------------------
     def _check_overflow(self, overflow) -> None:
@@ -532,13 +756,17 @@ class PartitionedEngine:
         return np.asarray(self.state["x"][self._order()])
 
     def elem_ids(self) -> np.ndarray:
-        """Original (caller-visible) element ids per particle."""
+        """Original (caller-visible) element ids per particle; −1 for
+        lost particles (no containing element — their slot's lelem is
+        meaningless and must not read as a real element)."""
         o = self._order()
         glid = (
             (jnp.cumsum(jnp.ones_like(self.state["pid"])) - 1)
             // self.cap_per_chip
         ) * self.part.L + self.state["lelem"]
-        return np.asarray(self.part.orig_of_glid[glid[o]])
+        ids = np.asarray(self.part.orig_of_glid[glid[o]]).copy()
+        ids[np.asarray(self.state["lost"][o])] = -1
+        return ids
 
     def flux_original(self) -> jnp.ndarray:
         return self.part.flux_to_original(self.flux_padded)

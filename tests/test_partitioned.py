@@ -162,6 +162,134 @@ def test_partitioned_oracle_6tet_cube():
     )
 
 
+def test_partitioned_split_adjacency_matches_packed():
+    """The int32 out-of-row adjacency fallback (f32 meshes past the
+    exact-id limit) must walk identically to the packed table."""
+    from pumiumtally_tpu.parallel.partition import PartitionedEngine
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    dm = make_device_mesh(8)
+    rng = np.random.default_rng(5)
+    n = 500
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dest = np.clip(src + rng.normal(scale=0.3, size=(n, 3)), 0.02, 0.98)
+
+    results = []
+    for split in (False, True):
+        eng = PartitionedEngine(
+            mesh, dm, n, capacity_factor=4.0, tol=1e-8, max_iters=500,
+        )
+        if split:
+            from pumiumtally_tpu.parallel.partition import build_partition
+
+            eng.part = build_partition(mesh, 8, force_split_adj=True)
+            assert eng.part.adj_int is not None
+        eng.localize(jnp.asarray(src))
+        eng.move(None, jnp.asarray(dest), jnp.ones(n, jnp.int8),
+                 jnp.ones(n))
+        results.append(
+            (eng.elem_ids(), np.asarray(eng.flux_original()))
+        )
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_allclose(results[0][1], results[1][1],
+                               rtol=1e-12, atol=1e-13)
+
+
+def test_partitioned_stress_forced_migrations():
+    """Load test: 8 chips, 100k particles, 6k tets, long steps forcing
+    heavy cross-partition traffic; conservation must hold exactly (no
+    particle exits) and flux must match the single-chip engine."""
+    mesh = build_box(1, 1, 1, 10, 10, 10)  # 6000 tets
+    dm = make_device_mesh(8)
+    n = 100_000
+    rng = np.random.default_rng(42)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dest = np.clip(src + rng.normal(scale=0.35, size=(n, 3)), 0.02, 0.98)
+
+    par = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=2.0)
+    )
+    par.CopyInitialPosition(src.reshape(-1).copy())
+    par.MoveToNextLocation(None, dest.reshape(-1).copy())
+    total = float(np.asarray(par.flux).sum())
+    expect = float(np.linalg.norm(dest - src, axis=1).sum())
+    np.testing.assert_allclose(total, expect, rtol=1e-10)
+
+    ref = PumiTally(mesh, n, TallyConfig())
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(None, dest.reshape(-1).copy())
+    np.testing.assert_array_equal(ref.elem_ids, par.elem_ids)
+    np.testing.assert_allclose(
+        np.asarray(ref.flux), np.asarray(par.flux), rtol=1e-11, atol=1e-12
+    )
+
+
+def test_partitioned_lost_source_points_never_tally(capsys):
+    """Source points outside every element (possible only on
+    non-convex/foreign geometry, or points outside the hull) must be
+    flagged, excluded from transport, and contribute zero flux."""
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    dm = make_device_mesh(4)
+    n = 64
+    t = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=4.0)
+    )
+    rng = np.random.default_rng(9)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    src[::4] += 5.0  # every 4th point far outside the unit box
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    out = capsys.readouterr().out
+    assert "lie in no mesh element" in out
+    assert "Not all particles are found" in out
+    # Lost particles report the -1 sentinel, never a phantom element.
+    ids = t.elem_ids
+    assert np.all(ids[::4] == -1)
+    assert np.all(ids[np.arange(n) % 4 != 0] >= 0)
+    dest = rng.uniform(0.1, 0.9, (n, 3))
+    t.MoveToNextLocation(None, dest.reshape(-1).copy())
+    total = float(np.asarray(t.flux).sum())
+    # Only the 48 located particles tally; lost ones contribute nothing.
+    inside = np.ones(n, bool)
+    inside[::4] = False
+    expect = float(
+        np.linalg.norm((dest - src)[inside], axis=1).sum()
+    )
+    np.testing.assert_allclose(total, expect, rtol=1e-10)
+
+    # Revival: a two-phase move with valid in-mesh origins re-locates
+    # the lost particles and they tally again (single-chip parity for
+    # reincarnated particles, reference PumiTallyImpl.cpp:88-109).
+    orig2 = rng.uniform(0.1, 0.9, (n, 3))
+    dest2 = np.clip(orig2 + 0.05, 0.02, 0.98)
+    t.MoveToNextLocation(orig2.reshape(-1).copy(), dest2.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    assert np.all(t.elem_ids >= 0)
+    total2 = float(np.asarray(t.flux).sum()) - total
+    expect2 = float(np.linalg.norm(dest2 - orig2, axis=1).sum())
+    np.testing.assert_allclose(total2, expect2, rtol=1e-10)
+
+
+def test_partitioned_overflow_near_capacity():
+    """Concentrating every particle into one chip's region with slot
+    capacity for barely 1/8th of the batch must raise the documented
+    overflow error, not silently drop particles."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    dm = make_device_mesh(8)
+    n = 2000
+    # capacity_factor 1.3 → cap_per_chip ≈ 1.3·n/8: enough slack for
+    # the (balanced) localization, nowhere near enough for an
+    # all-on-one-chip concentration.
+    t = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=1.3)
+    )
+    rng = np.random.default_rng(1)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    corner = np.tile([0.03, 0.03, 0.03], (n, 1))  # all to one chip
+    with pytest.raises(RuntimeError, match="capacity exceeded"):
+        t.MoveToNextLocation(None, corner.reshape(-1).copy())
+
+
 def test_partitioned_exit_and_hold_semantics():
     mesh = build_box(1, 1, 1, 3, 3, 3)
     dm = make_device_mesh(4)

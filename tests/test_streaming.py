@@ -43,6 +43,51 @@ def test_streaming_matches_monolithic(continue_mode):
     )
 
 
+@pytest.mark.parametrize("continue_mode", [False, True])
+def test_streaming_sharded_matches_single_device(continue_mode):
+    """BASELINE configs 3+5 composed: chunked batches where every chunk
+    walks as the 8-virtual-device sharded step; flux must match the
+    single-device streaming engine to the oracle tolerance."""
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    rng = np.random.default_rng(7)
+    src = rng.uniform(0.05, 0.95, (N, 3))
+    dest = np.clip(src + rng.normal(scale=0.2, size=(N, 3)), 0.02, 0.98)
+    fly = (rng.uniform(size=N) > 0.15).astype(np.int8)
+    w = rng.uniform(0.5, 2.0, N)
+
+    single = StreamingTally(mesh, N, chunk_size=600, config=TallyConfig())
+    dev_mesh = make_device_mesh(8)
+    sharded = StreamingTally(
+        mesh, N, chunk_size=600, config=TallyConfig(device_mesh=dev_mesh)
+    )
+    assert sharded.chunk_size % 8 == 0  # rounded up to shard evenly
+
+    for t in (single, sharded):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+    np.testing.assert_array_equal(
+        single.elem_ids[:N], sharded.elem_ids[:N]
+    )
+
+    for t in (single, sharded):
+        if continue_mode:
+            t.MoveToNextLocation(None, dest.reshape(-1).copy(), fly.copy(), w)
+        else:
+            pos = t.positions[:N].astype(np.float64)
+            t.MoveToNextLocation(
+                pos.reshape(-1).copy(), dest.reshape(-1).copy(), fly.copy(), w
+            )
+    np.testing.assert_array_equal(single.elem_ids[:N], sharded.elem_ids[:N])
+    np.testing.assert_allclose(
+        single.positions[:N], sharded.positions[:N], atol=1e-13
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.flux), np.asarray(sharded.flux),
+        rtol=1e-12, atol=1e-13,
+    )
+
+
 def test_streaming_accumulates_and_writes(tmp_path):
     mesh = build_box(1, 1, 1, 3, 3, 3)
     rng = np.random.default_rng(4)
