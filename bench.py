@@ -1,27 +1,42 @@
 """Headline benchmark: particle-move throughput of the tallied walk.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Workload (BASELINE.json configs[0] analogue): a 48k-tet box mesh —
 the scale of the OpenMC pincell's ~10k-tet Gmsh mesh, rounded up — with
 500k particles per batch doing tallied MoveToNextLocation steps
 (reference PumiTallyImpl.cpp:66-149) along a precomputed random-walk
-trajectory that stays strictly inside the mesh, so every move's origins
-equal the committed positions and the continue-mode fast path applies
-(origins=None, api/tally.py). The host stages each move's destination
-buffer (f64, per the reference's double* protocol) inside the timed
-region; moves dispatch asynchronously and the clock stops at a real
-value fetch of the final flux, which is also validated against the
-analytic total track length (exact: no particle ever exits).
+trajectory that stays strictly inside the mesh.
 
-``value`` is particle-moves/sec on the default backend (the real TPU
-chip under the driver).
+TWO protocols are measured, both reported:
 
-``vs_baseline``: the reference publishes no numbers in-tree
-(BASELINE.md), so the recorded baseline is a measured CPU run of OUR
-engine on the same workload (a stand-in for the reference's
-Kokkos-Serial path, which cannot be built here: its dependency stack
-needs network access). vs_baseline = tpu_rate / cpu_rate.
+- ``two_phase``: the reference's actual per-step workhorse — origins,
+  flying flags and weights staged host→device EVERY call (f64 buffers,
+  per the reference's ``double*`` protocol, PumiTally.h:87-89), then the
+  full phase-A relocate + phase-B tallied transport.
+- ``continue``: the TPU-native fast path (``origins=None``) valid when
+  no particle was resampled since the last move; phase A and the origin
+  upload are skipped.
+
+The headline ``value`` stays ``particle_moves_per_sec`` of the continue
+path (the metric recorded in BENCH_r01, so rounds compare
+like-for-like); ``two_phase_moves_per_sec`` and ``histories_per_sec``
+ride alongside. A "history" is one particle's full MOVES-segment
+trajectory: histories/sec = completed trajectories per second of the
+two-phase protocol — the number a physics host app experiences.
+
+``vs_baseline`` is apples-to-apples: the IDENTICAL two-phase workload
+(same mesh, same N, same moves, same staged buffers) run on the CPU
+backend of this same engine in a subprocess — a stand-in for the
+reference's Kokkos-Serial path, which cannot be built here (its
+dependency stack needs network access). vs_baseline =
+tpu_two_phase_rate / cpu_two_phase_rate.
+
+Self-check: sum(flux) must equal the analytic total track length
+(every segment stays inside the mesh, so conservation is exact in
+exact arithmetic). The comparison accumulates in f64 on the host and
+HARD-FAILS (exit 1) beyond 1e-6 relative — a silent tally corruption
+cannot report a perf number.
 """
 
 from __future__ import annotations
@@ -38,6 +53,7 @@ MESH_DIV = 20  # 20x20x20 cells → 48000 tets
 N = 500_000
 MOVES = 8
 MEAN_STEP = 0.25  # mean segment length: ~15 tet crossings per move
+CONSERVATION_RTOL = 1e-6
 
 
 def make_trajectory(rng, n: int, moves: int) -> list:
@@ -49,8 +65,29 @@ def make_trajectory(rng, n: int, moves: int) -> list:
     return pts
 
 
-def run_workload(n: int, moves: int) -> float:
-    """Particle-moves/sec for `moves` tallied move steps of n particles."""
+def check_conservation(total_flux: float, pts, first_move: int, last_move: int):
+    """sum(flux) vs analytic Σ‖dest−src‖ accumulated in f64; hard-fail."""
+    expect = 0.0
+    for m in range(first_move, last_move + 1):
+        d = pts[m].astype(np.float64) - pts[m - 1].astype(np.float64)
+        expect += float(np.linalg.norm(d, axis=1).sum())
+    rel = abs(total_flux - expect) / expect
+    if rel > CONSERVATION_RTOL:
+        print(
+            f"# FATAL: conservation off by {rel:.2e} "
+            f"(got {total_flux!r}, want {expect!r})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    return rel
+
+
+def run_workload(n: int, moves: int, mode: str) -> dict:
+    """Timed rates for `moves` tallied move steps of n particles.
+
+    mode: "two_phase" stages origins+flying+weights per call (the
+    reference protocol); "continue" uses the origins=None fast path.
+    """
     import jax.numpy as jnp
 
     from pumiumtally_tpu import PumiTally, TallyConfig, build_box
@@ -62,38 +99,52 @@ def run_workload(n: int, moves: int) -> float:
     pts = make_trajectory(rng, n, moves + 1)  # +1 warmup move
     t.CopyInitialPosition(pts[0].reshape(-1).copy())
 
-    # Warmup: compile the continue-mode move once; the scalar fetch is
-    # the real sync (block_until_ready is lazy on this backend).
-    t.MoveToNextLocation(None, pts[1].reshape(-1).copy())
-    flux_warm = float(jnp.sum(t.flux))
+    def drive(m: int) -> None:
+        dests = pts[m].reshape(-1).copy()
+        if mode == "two_phase":
+            # Full reference protocol: origins (= committed positions —
+            # the trajectory never exits, so committed == previous
+            # dests), flying and weights staged f64→device every call.
+            origins = pts[m - 1].reshape(-1).copy()
+            flying = np.ones(n, dtype=np.int8)
+            weights = np.ones(n, dtype=np.float64)
+            t.MoveToNextLocation(origins, dests, flying, weights)
+        else:
+            t.MoveToNextLocation(None, dests)
+
+    # Warmup: compile the move once; the scalar fetch is the real sync
+    # (block_until_ready is lazy on this backend).
+    drive(1)
+    float(jnp.sum(t.flux))
 
     t0 = time.perf_counter()
     for m in range(2, moves + 2):
-        t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
-    total_flux = float(jnp.sum(t.flux))  # forces the whole pipeline
+        drive(m)
+    total_flux = float(np.float64(jnp.sum(t.flux)))  # forces the pipeline
     dt = time.perf_counter() - t0
 
-    # Self-check: sum(flux) must equal the analytic total track length.
-    expect = flux_warm + sum(
-        float(np.linalg.norm(pts[m] - pts[m - 1], axis=1).sum())
-        for m in range(2, moves + 2)
-    )
-    rel = abs(total_flux - expect) / expect
-    if rel > 1e-3:
-        print(f"# WARNING: conservation off by {rel:.2e}", file=sys.stderr)
-    return n * moves / dt
+    # Flux accumulates from the warmup move on, so conservation covers
+    # moves 1..moves+1 inclusive.
+    rel = check_conservation(total_flux, pts, 1, moves + 1)
+    return {
+        "moves_per_sec": n * moves / dt,
+        "histories_per_sec": n / dt,
+        "conservation_rel_err": rel,
+    }
 
 
 def main() -> None:
     if os.environ.get("PUMIUMTALLY_BENCH_CPU") == "1":
-        # Subprocess mode: CPU stand-in baseline, smaller batch.
-        rate = run_workload(N // 10, 4)
-        print(json.dumps({"cpu_rate": rate * 1.0}))
+        # Subprocess mode: CPU baseline on the IDENTICAL workload.
+        res = run_workload(N, MOVES, "two_phase")
+        print(json.dumps({"cpu_two_phase_rate": res["moves_per_sec"]}))
         return
 
-    rate = run_workload(N, MOVES)
+    two = run_workload(N, MOVES, "two_phase")
+    cont = run_workload(N, MOVES, "continue")
 
     vs_baseline = None
+    cpu_rate = None
     try:
         env = dict(os.environ)
         env["PUMIUMTALLY_BENCH_CPU"] = "1"
@@ -103,19 +154,34 @@ def main() -> None:
         env.pop("PALLAS_AXON_POOL_IPS", None)
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, timeout=1200,
+            env=env, capture_output=True, text=True, timeout=3600,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        cpu_rate = json.loads(out.stdout.strip().splitlines()[-1])["cpu_rate"]
-        vs_baseline = rate / cpu_rate
+        cpu_rate = json.loads(out.stdout.strip().splitlines()[-1])[
+            "cpu_two_phase_rate"
+        ]
+        vs_baseline = two["moves_per_sec"] / cpu_rate
     except Exception as e:  # noqa: BLE001 — baseline is best-effort
         print(f"# cpu baseline failed: {e}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "particle_moves_per_sec",
-        "value": rate,
+        "value": cont["moves_per_sec"],
         "unit": "moves/s",
         "vs_baseline": vs_baseline,
+        "two_phase_moves_per_sec": two["moves_per_sec"],
+        "continue_moves_per_sec": cont["moves_per_sec"],
+        "histories_per_sec": two["histories_per_sec"],
+        "cpu_two_phase_moves_per_sec": cpu_rate,
+        "conservation_rel_err": max(
+            two["conservation_rel_err"], cont["conservation_rel_err"]
+        ),
+        "workload": {
+            "mesh_tets": 6 * MESH_DIV**3,
+            "particles": N,
+            "moves": MOVES,
+            "mean_step": MEAN_STEP,
+        },
     }))
 
 

@@ -1,0 +1,95 @@
+"""Round-2 perf experiments on the real chip.
+
+1. Component timings at bench scale (500k particles, 48k tets):
+   gather with random vs SORTED indices (is locality worth a sort key?),
+   scatter-add random vs sorted, argsort cost.
+2. Continue-move breakdown: full cascade vs compact=False.
+
+Run: python tools/exp_r2_profile.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import build_box
+
+N = 500_000
+DIV = 20  # 48k tets
+
+
+def timeit(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # real sync on lazy backends: fetch a scalar
+    _ = float(jnp.sum(out[0] if isinstance(out, tuple) else out.ravel()[:1][0]))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _ = float(jnp.sum(out[0] if isinstance(out, tuple) else out.ravel()[:1][0]))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+    E = mesh.nelems
+    table = mesh.walk_table
+    rng = np.random.default_rng(0)
+    idx_rand = jnp.asarray(rng.integers(0, E, N), jnp.int32)
+    idx_sorted = jnp.sort(idx_rand)
+    vals = jnp.asarray(rng.uniform(size=N), jnp.float32)
+
+    gather = jax.jit(lambda t, i: t[i])
+    t_rand = timeit(gather, table, idx_rand)
+    t_sort = timeit(gather, table, idx_sorted)
+    print(f"gather[{N}x20] random: {t_rand*1e3:.2f} ms  "
+          f"sorted: {t_sort*1e3:.2f} ms  ({t_rand/t_sort:.2f}x)")
+
+    scat = jax.jit(
+        lambda i, v: jnp.zeros((E,), jnp.float32).at[i].add(v, mode="drop")
+    )
+    s_rand = timeit(scat, idx_rand, vals)
+    s_sort = timeit(scat, idx_sorted, vals)
+    print(f"scatter[{N}->{E}] random: {s_rand*1e3:.2f} ms  "
+          f"sorted: {s_sort*1e3:.2f} ms  ({s_rand/s_sort:.2f}x)")
+
+    srt = jax.jit(lambda k: jnp.argsort(k, stable=True))
+    t_as = timeit(srt, idx_rand)
+    print(f"argsort[{N}] int32: {t_as*1e3:.2f} ms")
+
+    srt2 = jax.jit(lambda k: jnp.argsort(k, stable=True))
+    done = jnp.asarray(rng.uniform(size=N) < 0.5)
+    t_as2 = timeit(srt2, done)
+    print(f"argsort[{N}] bool: {t_as2*1e3:.2f} ms")
+
+    # permutation apply cost (8 arrays as in the cascade)
+    def apply_perm(p, x, e, d, f, w, dn, ex, i2):
+        return tuple(a[p] for a in (x, e, d, f, w, dn, ex, i2))
+    x = jnp.asarray(rng.uniform(size=(N, 3)), jnp.float32)
+    arrs = (x, idx_rand, x, vals.astype(jnp.int8), vals, done, done, idx_rand)
+    ap = jax.jit(apply_perm)
+    perm = jnp.argsort(done, stable=True)
+    t_ap = timeit(ap, perm, *arrs)
+    print(f"apply perm to 8 arrays: {t_ap*1e3:.2f} ms")
+
+    # cond reduction cost
+    red = jax.jit(lambda d: jnp.sum(~d))
+    t_red = timeit(red, done)
+    print(f"sum(~done)[{N}]: {t_red*1e3:.3f} ms")
+
+    # einsum cost (the 2-projection batched matmul)
+    fn_ = jnp.asarray(rng.uniform(size=(N, 4, 3)), jnp.float32)
+    dx = jnp.asarray(rng.uniform(size=(N, 3, 2)), jnp.float32)
+    ein = jax.jit(lambda a, b: jnp.einsum("nfc,nck->nfk", a, b))
+    t_ein = timeit(ein, fn_, dx)
+    print(f"einsum [N,4,3]x[N,3,2]: {t_ein*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
