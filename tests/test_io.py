@@ -68,10 +68,89 @@ def test_osh_round_trip(tmp_path):
     write_osh(path, coords, tets)
     c2, t2 = read_osh(path)
     np.testing.assert_array_equal(c2, coords)
-    np.testing.assert_array_equal(t2, tets)
+    # The Omega_h layout stores tet->tri->edge->vert adjacency chains;
+    # the reader recovers each tet's vertex SET (order is irrelevant —
+    # TetMesh re-orients by signed volume).
+    np.testing.assert_array_equal(np.sort(t2, axis=1), np.sort(tets, axis=1))
+    assert t2.shape == tets.shape
     # and through the full dispatch + engine
     mesh = load_mesh(path)
     np.testing.assert_allclose(np.asarray(mesh.volumes).sum(), 2.0, atol=1e-12)
+
+
+def test_osh_multipart_merge(tmp_path):
+    """A multi-part directory (per-rank streams + global id tags, the
+    structure Omega_h writes for distributed meshes) merges back to the
+    full mesh."""
+    from pumiumtally_tpu.io.osh import read_osh, write_osh
+
+    coords, tets = box_arrays(1, 1, 1, 3, 3, 3)
+    path = str(tmp_path / "multi.osh")
+    write_osh(path, coords, tets, nparts=4)
+    import os
+
+    assert sorted(os.listdir(path)) == [
+        "0.osh", "1.osh", "2.osh", "3.osh", "nparts", "version"
+    ]
+    c2, t2 = read_osh(path)
+    np.testing.assert_array_equal(c2, coords)
+    np.testing.assert_array_equal(np.sort(t2, axis=1), np.sort(tets, axis=1))
+    mesh = load_mesh(path)
+    np.testing.assert_allclose(np.asarray(mesh.volumes).sum(), 1.0, atol=1e-12)
+
+
+def test_osh_multipart_edge_cases(tmp_path):
+    """Orphan vertices survive the multi-part round trip, and more
+    parts than tets (empty rank streams) still read back."""
+    from pumiumtally_tpu.io.osh import read_osh, write_osh
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)  # 6 tets, 8 verts
+    coords = np.vstack([coords, [[5.0, 5.0, 5.0]]])  # orphan node
+    path = str(tmp_path / "edge.osh")
+    write_osh(path, coords, tets, nparts=4)
+    c2, t2 = read_osh(path)
+    np.testing.assert_array_equal(c2, coords)
+    np.testing.assert_array_equal(np.sort(t2, axis=1), np.sort(tets, axis=1))
+
+    tiny = str(tmp_path / "tiny.osh")
+    write_osh(tiny, coords, tets[:2], nparts=4)  # 2 tets over 4 parts
+    c3, t3 = read_osh(tiny)
+    np.testing.assert_array_equal(c3, coords)
+    np.testing.assert_array_equal(
+        np.sort(t3, axis=1), np.sort(tets[:2], axis=1)
+    )
+
+
+def test_osh_legacy_container_still_loads(tmp_path):
+    """Directories converted by the round-1 own-format writer keep
+    loading (back-compat)."""
+    import os
+    import struct
+    import zlib
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    d = tmp_path / "legacy.osh"
+    os.makedirs(d)
+    (d / "nparts").write_text("1\n")
+    (d / "format").write_text("pumiumtally-osh 1\n")
+
+    def arr(a, code):
+        raw = np.ascontiguousarray(a).tobytes()
+        z = zlib.compress(raw, 6)
+        use = len(z) < len(raw)
+        body = z if use else raw
+        return struct.pack("<bqbq", code, a.size, int(use), len(body)) + body
+
+    with open(d / "0.osh", "wb") as f:
+        f.write(b"\xa1\x1a")
+        f.write(struct.pack("<biiqq", 1, 1, 3, len(coords), len(tets)))
+        f.write(arr(np.asarray(coords, np.float64).reshape(-1), 0))
+        f.write(arr(np.asarray(tets, np.int32).reshape(-1), 1))
+    from pumiumtally_tpu.io.osh import read_osh
+
+    c2, t2 = read_osh(str(d))
+    np.testing.assert_array_equal(c2, coords)
+    np.testing.assert_array_equal(t2, tets)
 
 
 def test_pumitally_from_osh_path(tmp_path):
@@ -126,3 +205,45 @@ def test_osh_foreign_file_detected(tmp_path):
 def test_unknown_format():
     with pytest.raises(ValueError):
         load_mesh("mesh.stl")
+
+
+@pytest.mark.parametrize("mode", ["binary", "ascii", "vtu"])
+def test_vtk_cell_data_round_trip(tmp_path, mode):
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars, write_vtk
+
+    coords, tets = box_arrays(1, 1, 1, 3, 3, 3)
+    ne = len(tets)
+    rng = np.random.default_rng(0)
+    flux = rng.uniform(size=ne)
+    vol = rng.uniform(1, 2, size=ne)
+    out = str(tmp_path / ("f.vtu" if mode == "vtu" else "f.vtk"))
+    write_vtk(out, coords, tets, cell_data={"flux": flux, "volume": vol},
+              ascii=(mode == "ascii"))
+    np.testing.assert_allclose(read_vtk_cell_scalars(out, "flux"), flux,
+                               rtol=1e-15)
+    np.testing.assert_allclose(read_vtk_cell_scalars(out, "volume"), vol,
+                               rtol=1e-15)
+    if mode != "vtu":
+        with open(out, "rb") as f:
+            head = f.read(64).decode("ascii", "replace")
+        assert head.startswith("# vtk DataFile")
+        assert ("ASCII" in head) == (mode == "ascii")
+
+
+def test_vtk_binary_scales(tmp_path):
+    """Binary output must be byte-bounded (~raw array size) regardless
+    of the data values — the point of replacing savetxt for 1M-tet
+    meshes, where full-precision ASCII floats are ~3x the bytes and
+    orders of magnitude slower to format."""
+    import os
+
+    from pumiumtally_tpu.io.vtk import write_vtk
+
+    coords, tets = box_arrays(1, 1, 1, 8, 8, 8)  # 3072 tets
+    ne = len(tets)
+    rng = np.random.default_rng(0)
+    flux = rng.uniform(size=ne)  # full-precision values
+    b = str(tmp_path / "b.vtk")
+    write_vtk(b, coords, tets, cell_data={"flux": flux})
+    raw = coords.size * 8 + ne * 5 * 4 + ne * 4 + ne * 8
+    assert os.path.getsize(b) < raw + 4096  # headers only on top of raw

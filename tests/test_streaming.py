@@ -106,4 +106,4 @@ def test_streaming_accumulates_and_writes(tmp_path):
     np.testing.assert_allclose(got, expect, rtol=1e-10)
     out = str(tmp_path / "f.vtk")
     t.WriteTallyResults(out)
-    assert open(out).readline().startswith("# vtk")
+    assert open(out, "rb").readline().startswith(b"# vtk")

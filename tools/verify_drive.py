@@ -65,5 +65,5 @@ assert any("read-only" in str(w.message) for w in wlist)
 print("read-only flying probe ok")
 
 t.WriteTallyResults("/tmp/fluxresult.vtk")
-print("VTK head:", open("/tmp/fluxresult.vtk").readline().strip())
+print("VTK head:", open("/tmp/fluxresult.vtk", "rb").readline().strip())
 print("VERIFY DRIVE OK")
