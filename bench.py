@@ -56,13 +56,38 @@ MEAN_STEP = 0.25  # mean segment length: ~15 tet crossings per move
 CONSERVATION_RTOL = 1e-6
 
 
-def make_trajectory(rng, n: int, moves: int) -> list:
-    """src + `moves` destination arrays, all strictly inside the box."""
-    pts = [rng.uniform(0.05, 0.95, (n, 3))]
+def make_trajectory(rng, n: int, moves: int, box=None) -> list:
+    """src + `moves` destination arrays, all strictly inside the box
+    (unit cube by default; pass ``box=[lx,ly,lz]`` for other extents)."""
+    box = np.ones(3) if box is None else np.asarray(box, np.float64)
+    pts = [rng.uniform(0.05, 0.95, (n, 3)) * box]
     for _ in range(moves):
         step = rng.normal(scale=MEAN_STEP / np.sqrt(3.0), size=(n, 3))
-        pts.append(np.clip(pts[-1] + step, 0.02, 0.98))
+        pts.append(np.clip(pts[-1] + step, 0.02 * box, 0.98 * box))
     return pts
+
+
+def timed_moves(t, pts, moves: int, drive) -> dict:
+    """Shared timing scaffold: warmup move 1 (compiles; the scalar
+    fetch is the real sync — block_until_ready is lazy on this
+    backend), then time moves 2..moves+1 and hard-check conservation
+    over ALL moves (flux accumulates from the warmup on)."""
+    import jax.numpy as jnp
+
+    n = pts[0].shape[0]
+    drive(1)
+    float(jnp.sum(t.flux))
+    t0 = time.perf_counter()
+    for m in range(2, moves + 2):
+        drive(m)
+    total_flux = float(np.float64(jnp.sum(t.flux)))  # forces the pipeline
+    dt = time.perf_counter() - t0
+    rel = check_conservation(total_flux, pts, 1, moves + 1)
+    return {
+        "moves_per_sec": n * moves / dt,
+        "histories_per_sec": n / dt,
+        "conservation_rel_err": rel,
+    }
 
 
 def check_conservation(total_flux: float, pts, first_move: int, last_move: int):
@@ -88,8 +113,6 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
     mode: "two_phase" stages origins+flying+weights per call (the
     reference protocol); "continue" uses the origins=None fast path.
     """
-    import jax.numpy as jnp
-
     from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 
     mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
@@ -112,25 +135,30 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
         else:
             t.MoveToNextLocation(None, dests)
 
-    # Warmup: compile the move once; the scalar fetch is the real sync
-    # (block_until_ready is lazy on this backend).
-    drive(1)
-    float(jnp.sum(t.flux))
+    return timed_moves(t, pts, moves, drive)
 
-    t0 = time.perf_counter()
-    for m in range(2, moves + 2):
-        drive(m)
-    total_flux = float(np.float64(jnp.sum(t.flux)))  # forces the pipeline
-    dt = time.perf_counter() - t0
 
-    # Flux accumulates from the warmup move on, so conservation covers
-    # moves 1..moves+1 inclusive.
-    rel = check_conservation(total_flux, pts, 1, moves + 1)
-    return {
-        "moves_per_sec": n * moves / dt,
-        "histories_per_sec": n / dt,
-        "conservation_rel_err": rel,
-    }
+def run_pincell(n: int, moves: int) -> dict:
+    """Continue-mode rate on the pincell O-grid (~22k tets) — the
+    BASELINE configs[0-1] geometry: anisotropic tets, curved fuel
+    rings, a square cell boundary."""
+    from pumiumtally_tpu import PumiTally, TallyConfig
+    from pumiumtally_tpu.mesh.pincell import build_pincell
+
+    pitch, height = 1.26, 1.0
+    mesh, _ = build_pincell(
+        pitch=pitch, height=height, n_theta=32, n_rings_fuel=5,
+        n_rings_pad=5, nz=12,
+    )
+    t = PumiTally(mesh, n, TallyConfig(check_found_all=False))
+    rng = np.random.default_rng(1)
+    pts = make_trajectory(rng, n, moves + 1, box=[pitch, pitch, height])
+    t.CopyInitialPosition(pts[0].reshape(-1).copy())
+
+    def drive(m: int) -> None:
+        t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+
+    return timed_moves(t, pts, moves, drive)
 
 
 def main() -> None:
@@ -142,6 +170,7 @@ def main() -> None:
 
     two = run_workload(N, MOVES, "two_phase")
     cont = run_workload(N, MOVES, "continue")
+    pincell = run_pincell(N, 4)
 
     vs_baseline = None
     cpu_rate = None
@@ -171,10 +200,12 @@ def main() -> None:
         "vs_baseline": vs_baseline,
         "two_phase_moves_per_sec": two["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],
+        "pincell_moves_per_sec": pincell["moves_per_sec"],
         "histories_per_sec": two["histories_per_sec"],
         "cpu_two_phase_moves_per_sec": cpu_rate,
         "conservation_rel_err": max(
-            two["conservation_rel_err"], cont["conservation_rel_err"]
+            two["conservation_rel_err"], cont["conservation_rel_err"],
+            pincell["conservation_rel_err"],
         ),
         "workload": {
             "mesh_tets": 6 * MESH_DIV**3,

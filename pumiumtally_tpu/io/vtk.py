@@ -58,7 +58,13 @@ def write_vtk(
     mesh. ``ascii=True`` restores the all-text variant.
     """
     if path.endswith(".vtu"):
-        write_vtu(path, coords, tet2vert, cell_data, point_data)
+        if ascii:
+            raise ValueError(
+                ".vtu output is always raw-appended binary; use a .vtk "
+                "path for the ASCII legacy format"
+            )
+        write_vtu(path, coords, tet2vert, cell_data, point_data,
+                  title=title)
         return
     coords, tet2vert = _prep(path, coords, tet2vert)
     nv, ne = coords.shape[0], tet2vert.shape[0]
@@ -110,6 +116,7 @@ def write_vtu(
     tet2vert: np.ndarray,
     cell_data: Optional[Dict[str, np.ndarray]] = None,
     point_data: Optional[Dict[str, np.ndarray]] = None,
+    title: str = "pumiumtally_tpu flux result",
 ) -> None:
     """Write an XML ``.vtu`` UnstructuredGrid with raw appended binary
     data (the same file family Omega_h's vtk::write_parallel emits as
@@ -154,6 +161,8 @@ def write_vtu(
 
     xml: list = []
     xml.append('<?xml version="1.0"?>')
+    safe_title = title.replace("--", "- -")
+    xml.append(f"<!-- {safe_title} -->")
     xml.append(
         '<VTKFile type="UnstructuredGrid" version="1.0" '
         'byte_order="LittleEndian" header_type="UInt64">'

@@ -1,0 +1,165 @@
+"""Pincell mesh builder: a fuel cylinder inside a square pitch, extruded.
+
+The reference's headline workload is an OpenMC pincell tallied on an
+unstructured tet mesh (~10k tets, BASELINE.json configs[0-1]; the
+reference obtains such meshes from Gmsh via msh2osh, README.md:115-125).
+This builder produces that geometry natively: an O-grid — structured
+radial rings inside the fuel cylinder, transition rings morphing from
+the circle to the square cell boundary — extruded in z, every prism
+split into 3 tets with the smallest-global-vertex diagonal rule
+(Dompierre et al., "How to Subdivide Pyramids, Prisms and Hexahedra
+into Tetrahedra"), which makes diagonals on shared quad faces agree
+between neighboring prisms: the mesh is conforming by construction.
+
+Returns raw (coords, tet2vert, region) arrays plus a convenience
+``build_pincell`` that runs them through ``TetMesh.from_arrays`` (which
+re-orients and validates every tet). ``region`` is 0 inside the fuel
+radius and 1 outside (moderator) — the two-material split an OpenMC
+pincell tally cares about.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from pumiumtally_tpu.mesh.tetmesh import TetMesh
+
+
+def _square_point(theta: np.ndarray, half: float) -> np.ndarray:
+    """Point on the axis-aligned square of half-width ``half`` along
+    direction ``theta`` (the square's radial parametrization)."""
+    c, s = np.cos(theta), np.sin(theta)
+    m = np.maximum(np.abs(c), np.abs(s))
+    return half * np.stack([c / m, s / m], axis=-1)
+
+
+def pincell_arrays(
+    pitch: float = 1.26,
+    fuel_radius: float = 0.4095,
+    height: float = 1.0,
+    n_theta: int = 16,
+    n_rings_fuel: int = 3,
+    n_rings_pad: int = 3,
+    nz: int = 4,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(coords[V,3], tet2vert[E,4], region[E]) for a single pincell.
+
+    n_theta sectors around the pin (multiple of 8 keeps the square's
+    corners on sector boundaries), n_rings_fuel rings inside the fuel,
+    n_rings_pad transition rings from the fuel surface to the square
+    boundary, nz extruded layers. Tet count: 3*nz*n_theta*(2*(n_rings_
+    fuel+n_rings_pad) - 1).
+    """
+    if n_theta % 8:
+        # The square's corners sit at 45°+k·90°; sector boundaries land
+        # on them only when n_theta is a multiple of 8 — otherwise the
+        # outer ring polygon cuts the corners off and the mesh no
+        # longer fills the cell.
+        raise ValueError("n_theta must be a multiple of 8")
+    if 2 * fuel_radius >= pitch:
+        raise ValueError("fuel diameter must be smaller than the pitch")
+    half = pitch / 2.0
+    theta = np.arange(n_theta) * (2 * np.pi / n_theta)
+
+    # 2-D O-grid vertices: center, then rings.
+    pts2 = [np.zeros((1, 2))]
+    ring_r = np.linspace(0.0, fuel_radius, n_rings_fuel + 1)[1:]
+    for r in ring_r:
+        pts2.append(np.stack([r * np.cos(theta), r * np.sin(theta)], axis=-1))
+    sq = _square_point(theta, half)
+    circ = fuel_radius * np.stack([np.cos(theta), np.sin(theta)], axis=-1)
+    for s in np.linspace(0.0, 1.0, n_rings_pad + 1)[1:]:
+        pts2.append((1.0 - s) * circ + s * sq)
+    pts2 = np.concatenate(pts2, axis=0)
+    nv2 = pts2.shape[0]
+    nrings = n_rings_fuel + n_rings_pad
+
+    def ring_vert(j: int, k: int) -> int:
+        """2-D vertex index of ring j (1-based), sector k."""
+        return 1 + (j - 1) * n_theta + (k % n_theta)
+
+    # 2-D triangulation + per-triangle region (0 fuel / 1 moderator).
+    tris = []
+    tri_region = []
+    for k in range(n_theta):  # center fan
+        tris.append([0, ring_vert(1, k), ring_vert(1, k + 1)])
+        tri_region.append(0)
+    for j in range(1, nrings):
+        reg = 0 if j < n_rings_fuel else 1
+        for k in range(n_theta):
+            a, b = ring_vert(j, k), ring_vert(j, k + 1)
+            c, d = ring_vert(j + 1, k), ring_vert(j + 1, k + 1)
+            tris.append([a, b, d])
+            tris.append([a, d, c])
+            tri_region.extend([reg, reg])
+    tris = np.asarray(tris, np.int64)
+    tri_region = np.asarray(tri_region, np.int64)
+
+    # Extrude: layer l vertex = 2-D vertex + l*nv2.
+    zs = np.linspace(0.0, height, nz + 1)
+    coords = np.concatenate(
+        [
+            np.concatenate(
+                [pts2, np.full((nv2, 1), z)], axis=1
+            )
+            for z in zs
+        ],
+        axis=0,
+    )
+
+    # Prism → 3 tets, smallest-vertex diagonal rule (conforming).
+    tets = []
+    region = []
+    for layer in range(nz):
+        lo = layer * nv2
+        hi = (layer + 1) * nv2
+        for t, reg in zip(tris, tri_region):
+            v = np.array([lo + t[0], lo + t[1], lo + t[2],
+                          hi + t[0], hi + t[1], hi + t[2]], np.int64)
+            # Rotate so the globally smallest bottom/top pair is first.
+            rot = int(np.argmin([min(v[0], v[3]), min(v[1], v[4]),
+                                 min(v[2], v[5])]))
+            order = [rot, (rot + 1) % 3, (rot + 2) % 3]
+            v = v[order + [o + 3 for o in order]]
+            if min(v[1], v[5]) < min(v[2], v[4]):
+                new = [(v[0], v[1], v[2], v[5]),
+                       (v[0], v[1], v[5], v[4]),
+                       (v[0], v[4], v[5], v[3])]
+            else:
+                new = [(v[0], v[1], v[2], v[4]),
+                       (v[0], v[4], v[2], v[5]),
+                       (v[0], v[4], v[5], v[3])]
+            tets.extend(new)
+            region.extend([reg] * 3)
+    return (
+        np.asarray(coords, np.float64),
+        np.asarray(tets, np.int32),
+        np.asarray(region, np.int32),
+    )
+
+
+def build_pincell(
+    pitch: float = 1.26,
+    fuel_radius: float = 0.4095,
+    height: float = 1.0,
+    n_theta: int = 16,
+    n_rings_fuel: int = 3,
+    n_rings_pad: int = 3,
+    nz: int = 4,
+    dtype=None,
+) -> Tuple[TetMesh, np.ndarray]:
+    """(TetMesh, region[E]) — validated, walk-ready pincell mesh.
+
+    NOTE: ``TetMesh.from_arrays`` preserves element order, so the
+    region array indexes the mesh's elements directly.
+    """
+    coords, tets, region = pincell_arrays(
+        pitch, fuel_radius, height, n_theta, n_rings_fuel, n_rings_pad, nz
+    )
+    # Center the cell at the origin in x/y like an OpenMC pincell; shift
+    # so the box is [0,pitch]x[0,pitch]x[0,height] for walk convenience.
+    coords[:, 0] += pitch / 2.0
+    coords[:, 1] += pitch / 2.0
+    return TetMesh.from_arrays(coords, tets, dtype=dtype), region

@@ -219,11 +219,16 @@ def walk(
         exited = tail(exited, exh)
 
         if nxt:
-            # Stable sort on the done mask: survivors (done=False) move
-            # to the front, preserving relative order → deterministic.
+            # Stable sort on (done, current element): survivors move to
+            # the front AND are grouped by element, so the next stage's
+            # walk-table gathers and flux scatters hit near-contiguous
+            # rows (row-granularity HBM DMA is the measured per-
+            # iteration floor, docs/PERF_NOTES.md) — deterministic, and
+            # the sort was already being paid for the compaction.
             # Only rows [:w] can be active, so sorting the window alone
             # suffices and the sort shrinks with the cascade.
-            perm = jnp.argsort(dh, stable=True)
+            key = jnp.where(dh, jnp.iinfo(jnp.int32).max, eh)
+            perm = jnp.argsort(key, stable=True)
             upd = lambda a: jnp.concatenate([a[:w][perm], a[w:]], axis=0)  # noqa: E731
             x = upd(x)
             elem = upd(elem)

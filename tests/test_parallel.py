@@ -121,3 +121,28 @@ def test_sharded_conservation(dev_mesh):
     expected = np.sum(np.linalg.norm(dst - src, axis=1))
     np.testing.assert_allclose(float(np.sum(np.asarray(t.flux))), expected,
                                rtol=1e-12)
+
+
+def test_initialize_distributed_wires_the_pod_mesh(monkeypatch):
+    """`initialize_distributed` (the jax.distributed analogue of the
+    reference's pumipic::Library MPI_Init, PumiTallyImpl.cpp:238-241)
+    must join the distributed job THEN build the mesh over every device
+    in the pod. jax.distributed needs a real multi-host job, so the
+    join call is intercepted; everything else runs for real."""
+    import pumiumtally_tpu.parallel.device as device
+
+    calls = {}
+
+    def fake_initialize(coordinator_address=None, num_processes=None,
+                        process_id=None):
+        calls["args"] = (coordinator_address, num_processes, process_id)
+
+    monkeypatch.setattr(
+        device.jax.distributed, "initialize", fake_initialize
+    )
+    mesh = device.initialize_distributed(
+        coordinator_address="10.0.0.1:8476", num_processes=1, process_id=0,
+    )
+    assert calls["args"] == ("10.0.0.1:8476", 1, 0)
+    assert mesh.axis_names == ("dp",)
+    assert mesh.devices.size == len(device.jax.devices())
