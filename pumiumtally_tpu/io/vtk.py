@@ -161,7 +161,9 @@ def write_vtu(
 
     xml: list = []
     xml.append('<?xml version="1.0"?>')
-    safe_title = title.replace("--", "- -")
+    safe_title = title
+    while "--" in safe_title:  # XML forbids '--' inside comments
+        safe_title = safe_title.replace("--", "- -")
     xml.append(f"<!-- {safe_title} -->")
     xml.append(
         '<VTKFile type="UnstructuredGrid" version="1.0" '

@@ -8,17 +8,25 @@ every particle has either reached its destination or left the domain —
 the same lock-step property as the reference's search loop (SURVEY.md
 §3.3), but expressed as dense, static-shaped array ops XLA can fuse.
 
-Per iteration, for every not-done particle:
+The walk is parametrized by the scalar ray coordinate ``s ∈ [0,1]``
+along the FIXED segment ``x0 → dest`` (``d0 = dest − x0``): for any tet
+face, the intersection satisfies ``s_f = (off_f − n_f·x0) / (n_f·d0)``
+— both projections are against walk-constant vectors, so no position
+needs updating inside the loop (the classic per-step form
+``t = (off − n·x)/(n·d)`` recomputes ``n·x`` against a moving point
+every iteration); positions are materialized ONCE from ``s`` at the
+end. Per iteration, for every not-done particle:
   1. gather the packed walk row of its current tet — 4 face planes +
      4 neighbor ids in ONE contiguous [20]-float row (replaces PUMIPic's
      per-particle adjacency chase; packing measured ~2.6× faster than
      three separate gathers on TPU),
-  2. exit parameter ``t_f = (off_f − n_f·x) / (n_f·d)`` over faces with
-     ``n_f·d > tol`` — the ray/tet-face intersection (reference fork's
-     search internals; semantics pinned by the oracles in BASELINE.md),
-  3. tally ``‖Δx‖ · weight`` into the current element — the reference's
-     ``EvaluateFlux`` + ``Kokkos::atomic_add`` (PumiTallyImpl.cpp:352-380)
-     becomes a deterministic XLA scatter-add,
+  2. exit coordinate ``s_f`` over faces with ``n_f·d_remaining > tol``
+     (same crossing predicate as the reference fork's search internals;
+     semantics pinned by the oracles in BASELINE.md),
+  3. tally ``(s_new − s)·‖d0‖ · weight`` into the current element — the
+     reference's ``EvaluateFlux`` + ``Kokkos::atomic_add``
+     (PumiTallyImpl.cpp:352-380) becomes a deterministic XLA
+     scatter-add,
   4. vacuum BC: a particle whose exit face has no neighbor is done and
      its position clamps to the boundary intersection point — reference
      ``ApplyVacuumBC`` (PumiTallyImpl.cpp:256-286),
@@ -125,56 +133,77 @@ def walk(
     # be "unvarying" and break the while_loop carry typing).
     active0 = in_flight != in_flight
     flying = in_flight.astype(bool)
+    x0 = x
+    d0 = dest - x0  # the whole walk's segment; s parametrizes along it
+    seg_len = jnp.linalg.norm(d0, axis=1)  # computed once, not per iter
+    s0 = jnp.zeros_like(seg_len)
 
     def body(state):
         """One lock-step iteration over a (possibly windowed) batch."""
-        it, x, elem, dest, flying, weight, done, exited, flux = state
+        it, s, elem, x0, d0, seg_len, flying, weight, done, exited, flux = state
         active = ~done
-        d = dest - x  # remaining segment
         fn, fo, adj = _gather_walk_row(mesh, elem)
-        # One pass over the gathered normals for both projections.
-        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d, x], axis=-1))
-        denom = both[..., 0]
-        numer = fo - both[..., 1]
-        crossing = denom > tol
-        t = jnp.where(crossing, numer / jnp.where(crossing, denom, one), jnp.inf)
-        # x may sit epsilon-outside a face after a previous step; don't
+        # Both ray projections are against walk-constant vectors.
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, x0], axis=-1))
+        a = both[..., 0]  # n·d0
+        b = fo - both[..., 1]  # off − n·x0
+        # Crossing predicate on the REMAINING segment (n·d_rem > tol),
+        # matching the reference's per-step test exactly.
+        crossing = a * (one - s)[:, None] > tol
+        s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+        # The committed point may sit epsilon-outside a face; don't
         # step backwards.
-        t = jnp.maximum(t, 0.0)
-        t_exit = jnp.min(t, axis=1)
-        f_exit = jnp.argmin(t, axis=1)
+        s_f = jnp.maximum(s_f, s[:, None])
+        s_exit = jnp.min(s_f, axis=1)
+        f_exit = jnp.argmin(s_f, axis=1)
         # Destination inside the current tet (or no forward crossing at
         # all, e.g. zero-length segment) → done at dest.
-        reached = t_exit >= one
-        t_step = jnp.where(reached, one, t_exit)
-        x_new = x + t_step[:, None] * d
+        reached = s_exit >= one
+        s_new = jnp.where(reached, one, s_exit)
         next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
         hit_boundary = (~reached) & (next_elem == -1)
 
         if tally:
-            seg = t_step * jnp.linalg.norm(d, axis=1)
-            contrib = jnp.where(active & flying, seg * weight, 0.0)
+            contrib = jnp.where(
+                active & flying, (s_new - s) * seg_len * weight, 0.0
+            )
             flux = flux.at[elem].add(contrib, mode="drop")
 
         advance = active & ~reached & ~hit_boundary
         elem = jnp.where(advance, next_elem, elem)
-        x = jnp.where(active[:, None], x_new, x)
+        s = jnp.where(active, s_new, s)
         done = done | reached | hit_boundary
         exited = exited | (active & hit_boundary)
-        return it + 1, x, elem, dest, flying, weight, done, exited, flux
+        return it + 1, s, elem, x0, d0, seg_len, flying, weight, done, exited, flux
 
     it0 = jnp.asarray(0, jnp.int32)
+
+    def final_x(s, done, exited):
+        """Materialize positions from the ray coordinate — exactly once.
+        Particles that reached their destination commit ``dest``
+        bit-exactly (the continue-mode contract: next move's origins
+        equal the committed positions); boundary leavers commit the
+        clamped intersection point."""
+        return jnp.where(
+            (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
+        )
 
     min_window = max(1, min_window)
     if not compact or n_total <= min_window:
         def cond(state):
-            it, _x, _elem, _dest, _flying, _weight, done, _exited, _flux = state
+            it = state[0]
+            done = state[-3]
             return (it < max_iters) & jnp.any(~done)
 
-        it, x, elem, _, _, _, done, exited, flux = lax.while_loop(
-            cond, body, (it0, x, elem, dest, flying, weight, active0, active0, flux)
+        it, s, elem, _, _, _, _, _, done, exited, flux = lax.while_loop(
+            cond, body,
+            (it0, s0, elem, x0, d0, seg_len, flying, weight, active0,
+             active0, flux),
         )
-        return WalkResult(x=x, elem=elem, done=done, exited=exited, flux=flux, iters=it)
+        return WalkResult(
+            x=final_x(s, done, exited), elem=elem, done=done,
+            exited=exited, flux=flux, iters=it,
+        )
 
     # ---- compaction cascade --------------------------------------------
     # Static window schedule: N, N/2, …, down to min_window.
@@ -186,6 +215,7 @@ def walk(
     # compaction permutations can be undone at the end.
     idx = jnp.cumsum(jnp.ones_like(elem)) - 1  # iota, varying under shard_map
 
+    s = s0
     done = active0
     exited = active0
     it = it0
@@ -193,17 +223,19 @@ def walk(
         nxt = windows[si + 1] if si + 1 < len(windows) else 0
 
         def cond(state, _w=w, _nxt=nxt):
-            it, _x, _elem, _dest, _flying, _weight, done, _exited, _flux = state
+            it = state[0]
+            done = state[-3]
             n_active = jnp.sum(~done)
             return (it < max_iters) & (n_active > _nxt)
 
         head = lambda a: a[:w]  # noqa: E731 — static-size window slice
-        it, xh, eh, _, _, _, dh, exh, flux = lax.while_loop(
+        it, sh, eh, _, _, _, _, _, dh, exh, flux = lax.while_loop(
             cond,
             body,
             (
-                it, head(x), head(elem), head(dest), head(flying),
-                head(weight), head(done), head(exited), flux,
+                it, head(s), head(elem), head(x0), head(d0),
+                head(seg_len), head(flying), head(weight), head(done),
+                head(exited), flux,
             ),
         )
         # NOTE: these window write-backs deliberately use concatenate,
@@ -213,7 +245,7 @@ def walk(
         # jax 0.8.x — duplicated/missing rows). Concatenate forces a
         # fresh result buffer and costs the same copy.
         tail = lambda a, h: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
-        x = tail(x, xh)
+        s = tail(s, sh)
         elem = tail(elem, eh)
         done = tail(done, dh)
         exited = tail(exited, exh)
@@ -230,8 +262,11 @@ def walk(
             key = jnp.where(dh, jnp.iinfo(jnp.int32).max, eh)
             perm = jnp.argsort(key, stable=True)
             upd = lambda a: jnp.concatenate([a[:w][perm], a[w:]], axis=0)  # noqa: E731
-            x = upd(x)
+            s = upd(s)
             elem = upd(elem)
+            x0 = upd(x0)
+            d0 = upd(d0)
+            seg_len = upd(seg_len)
             dest = upd(dest)
             flying = upd(flying)
             weight = upd(weight)
@@ -241,7 +276,8 @@ def walk(
 
     # Undo the accumulated permutation: row i holds original slot idx[i].
     inv = jnp.argsort(idx, stable=True)
+    x_fin = final_x(s, done, exited)
     return WalkResult(
-        x=x[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
+        x=x_fin[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
         flux=flux, iters=it,
     )

@@ -19,6 +19,9 @@ from pumiumtally_tpu import (
 from pumiumtally_tpu.parallel import make_device_mesh
 from pumiumtally_tpu.parallel.partition import build_partition, rcb_partition
 
+
+from tests.conftest import CLIP_HI as _HI, CLIP_LO as _LO
+
 N = 3000
 
 
@@ -67,8 +70,8 @@ def test_partitioned_matches_single_chip(continue_mode):
     rng = np.random.default_rng(3)
     src = rng.uniform(0.05, 0.95, (N, 3))
     # long steps → many particles cross partition boundaries
-    dest1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)), 0.02, 0.98)
-    dest2 = np.clip(dest1 + rng.normal(scale=0.3, size=(N, 3)), 0.02, 0.98)
+    dest1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)), _LO, _HI)
+    dest2 = np.clip(dest1 + rng.normal(scale=0.3, size=(N, 3)), _LO, _HI)
     fly = (rng.uniform(size=N) > 0.1).astype(np.int8)
     w = rng.uniform(0.5, 2.0, N)
 
@@ -115,7 +118,7 @@ def test_partitioned_phase_a_migration_keeps_weights_aligned():
     # resample EVERY particle to a far corner region → all migrate in
     # phase A; then short tallied hops with per-particle weights
     origins = rng.uniform(0.05, 0.95, (n, 3))[::-1].copy()
-    dests = np.clip(origins + rng.normal(scale=0.1, size=(n, 3)), 0.02, 0.98)
+    dests = np.clip(origins + rng.normal(scale=0.1, size=(n, 3)), _LO, _HI)
     w = rng.uniform(0.1, 4.0, n)
 
     ref = PumiTally(mesh, n, TallyConfig())
@@ -172,7 +175,7 @@ def test_partitioned_split_adjacency_matches_packed():
     rng = np.random.default_rng(5)
     n = 500
     src = rng.uniform(0.05, 0.95, (n, 3))
-    dest = np.clip(src + rng.normal(scale=0.3, size=(n, 3)), 0.02, 0.98)
+    dest = np.clip(src + rng.normal(scale=0.3, size=(n, 3)), _LO, _HI)
 
     results = []
     for split in (False, True):
@@ -204,7 +207,7 @@ def test_partitioned_stress_forced_migrations():
     n = 100_000
     rng = np.random.default_rng(42)
     src = rng.uniform(0.05, 0.95, (n, 3))
-    dest = np.clip(src + rng.normal(scale=0.35, size=(n, 3)), 0.02, 0.98)
+    dest = np.clip(src + rng.normal(scale=0.35, size=(n, 3)), _LO, _HI)
 
     par = PartitionedPumiTally(
         mesh, n, TallyConfig(device_mesh=dm, capacity_factor=2.0)
@@ -260,7 +263,7 @@ def test_partitioned_lost_source_points_never_tally(capsys):
     # the lost particles and they tally again (single-chip parity for
     # reincarnated particles, reference PumiTallyImpl.cpp:88-109).
     orig2 = rng.uniform(0.1, 0.9, (n, 3))
-    dest2 = np.clip(orig2 + 0.05, 0.02, 0.98)
+    dest2 = np.clip(orig2 + 0.05, _LO, _HI)
     t.MoveToNextLocation(orig2.reshape(-1).copy(), dest2.reshape(-1).copy(),
                          np.ones(n, np.int8), np.ones(n))
     assert np.all(t.elem_ids >= 0)

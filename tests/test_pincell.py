@@ -24,9 +24,13 @@ def test_pincell_fills_the_cell_exactly():
     fuel = vols[region == 0].sum()
     assert fuel < math.pi * R**2 * height
     assert fuel > 0.95 * math.pi * R**2 * height
-    # Boundary faces = 4 sides * (n_theta/1? sectors) + top/bottom.
+    # Exact boundary topology: lateral surface = 2 tris per side quad
+    # (nz layers x n_theta sectors), caps = the 2-D triangulation's
+    # n_theta*(2*nrings-1) triangles each.
+    n_theta, nrings, nz = 16, 6, 4  # build_pincell defaults
     fa = np.asarray(mesh.face_adj)
-    assert int((fa == -1).sum()) > 0
+    expect_boundary = 2 * nz * n_theta + 2 * n_theta * (2 * nrings - 1)
+    assert int((fa == -1).sum()) == expect_boundary
 
 
 def test_pincell_counts_scale():

@@ -14,6 +14,9 @@ from pumiumtally_tpu.utils import (
     set_verbosity,
 )
 
+
+from tests.conftest import CLIP_HI as _HI, CLIP_LO as _LO
+
 N = 16
 
 
@@ -62,7 +65,7 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
     mesh_args = (1, 1, 1, 4, 4, 4)
     rng = np.random.default_rng(9)
     src = rng.uniform(0.1, 0.9, (n, 3))
-    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), 0.02, 0.98)
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), _LO, _HI)
 
     t = PumiTally(build_box(*mesh_args), n)
     t.CopyInitialPosition(src.reshape(-1).copy())
@@ -77,7 +80,7 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
             TallyConfig(device_mesh=make_device_mesh(4), capacity_factor=4.0),
         ),
     }
-    dst2 = np.clip(dst - 0.15, 0.02, 0.98)
+    dst2 = np.clip(dst - 0.15, _LO, _HI)
     t.MoveToNextLocation(None, dst2.reshape(-1).copy())
     for name, t2 in targets.items():
         load_tally_state(t2, ckpt)
