@@ -224,22 +224,32 @@ def walk_local(
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
-    chip. Returns (x, lelem, done, exited, pending, flux, iters)."""
+    chip. Returns (x, lelem, done, exited, pending, flux, iters).
+
+    Parametrized by the ray coordinate ``s`` along this ROUND's fixed
+    segment ``x → dest`` (see ops/walk.py): both face projections are
+    against walk-constant vectors, positions materialize once at the
+    end. A migrated particle starts a fresh round (and a fresh ray)
+    from its pause point, so ``s`` never crosses a migration.
+    """
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
     flying_b = flying.astype(bool)
+    x0 = x
+    d0 = dest - x0
+    seg_len = jnp.linalg.norm(d0, axis=1)
+    s0 = jnp.zeros_like(seg_len)
     # Derived from an input so it carries the varying type under
     # shard_map (a literal constant would break the while carry).
     pending0 = (lelem - lelem) - 1
 
     def cond(state):
-        it, _x, _lelem, done, _exited, pending, _flux = state
+        it, _s, _lelem, done, _exited, pending, _flux = state
         return (it < max_iters) & jnp.any(~done & (pending < 0))
 
     def body(state):
-        it, x, lelem, done, exited, pending, flux = state
+        it, s, lelem, done, exited, pending, flux = state
         active = ~done & (pending < 0)
-        d = dest - x
         row = table[lelem]
         n = row.shape[0]
         fn = row[:, WALK_TABLE_NORMALS].reshape(n, 4, 3)
@@ -248,38 +258,44 @@ def walk_local(
             adj = adj_int[lelem]
         else:
             adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
-        denom = jnp.einsum("nfc,nc->nf", fn, d)
-        numer = fo - jnp.einsum("nfc,nc->nf", fn, x)
-        crossing = denom > tol
-        t = jnp.where(crossing, numer / jnp.where(crossing, denom, one), jnp.inf)
-        t = jnp.maximum(t, 0.0)
-        t_exit = jnp.min(t, axis=1)
-        f_exit = jnp.argmin(t, axis=1)
-        reached = t_exit >= one
-        t_step = jnp.where(reached, one, t_exit)
-        x_new = x + t_step[:, None] * d
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, x0], axis=-1))
+        a = both[..., 0]
+        b = fo - both[..., 1]
+        crossing = a * (one - s)[:, None] > tol
+        s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+        s_f = jnp.maximum(s_f, s[:, None])
+        s_exit = jnp.min(s_f, axis=1)
+        f_exit = jnp.argmin(s_f, axis=1)
+        reached = s_exit >= one
+        s_new = jnp.where(reached, one, s_exit)
         nxt = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
         hit_boundary = (~reached) & (nxt == -1)
         goes_remote = (~reached) & (nxt <= -2)
 
         if tally:
-            seg = t_step * jnp.linalg.norm(d, axis=1)
-            contrib = jnp.where(active & flying_b, seg * weight, 0.0)
+            contrib = jnp.where(
+                active & flying_b, (s_new - s) * seg_len * weight, 0.0
+            )
             flux = flux.at[lelem].add(contrib, mode="drop")
 
         advance = active & ~reached & ~hit_boundary & ~goes_remote
         lelem = jnp.where(advance, nxt, lelem)
-        x = jnp.where(active[:, None], x_new, x)
+        s = jnp.where(active, s_new, s)
         pending = jnp.where(active & goes_remote, -nxt - 2, pending)
         done = done | (active & (reached | hit_boundary))
         exited = exited | (active & hit_boundary)
-        return it + 1, x, lelem, done, exited, pending, flux
+        return it + 1, s, lelem, done, exited, pending, flux
 
     it0 = jnp.asarray(0, jnp.int32)
-    it, x, lelem, done, exited, pending, flux = lax.while_loop(
-        cond, body, (it0, x, lelem, done, exited, pending0, flux)
+    it, s, lelem, done, exited, pending, flux = lax.while_loop(
+        cond, body, (it0, s0, lelem, done, exited, pending0, flux)
     )
-    return x, lelem, done, exited, pending, flux, it
+    # Reached particles commit dest bit-exactly (continue-mode
+    # contract); leavers/pausers commit the intersection point.
+    x_fin = jnp.where(
+        (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
+    )
+    return x_fin, lelem, done, exited, pending, flux, it
 
 
 # ---------------------------------------------------------------------------
@@ -613,6 +629,11 @@ class PartitionedEngine:
         def phase(table, adj, state, flux):
             st = dict(state)
             st["done"] = ~st["alive"] | (st["fly"] == 0)
+            # Per-walk flag, like the single-chip engine's fresh
+            # exited mask each walk() call: a particle that left the
+            # domain last move but was re-flown must not carry a stale
+            # True (it would dodge the commit-dest-bit-exactly path).
+            st["exited"] = jnp.zeros_like(st["exited"])
             # Non-flying particles hold position: dest <- x.
             st["dest"] = jnp.where(
                 (st["fly"] == 1)[:, None], st["dest"], st["x"]

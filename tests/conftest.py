@@ -27,7 +27,6 @@ os.environ["JAX_ENABLE_X64"] = "true"
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
-import numpy as np  # noqa: E402
 from jax._src import xla_bridge  # noqa: E402
 
 if xla_bridge._backends:
@@ -36,12 +35,3 @@ if xla_bridge._backends:
     )
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-
-# Per-axis asymmetric clip bounds for cross-engine comparison tests:
-# clipping with EQUAL bounds parks many destinations exactly on the box
-# meshes' diagonal tet faces (two coords equal), where the containing
-# element is genuinely ambiguous and engines may tie-break differently;
-# these bounds sit on no grid plane or diagonal of any mesh used in the
-# suite.
-CLIP_LO = np.array([0.0213, 0.0227, 0.0241])
-CLIP_HI = np.array([0.9787, 0.9773, 0.9759])

@@ -20,7 +20,7 @@ from pumiumtally_tpu.parallel import make_device_mesh
 from pumiumtally_tpu.parallel.partition import build_partition, rcb_partition
 
 
-from tests.conftest import CLIP_HI as _HI, CLIP_LO as _LO
+from tests.bounds import CLIP_HI as _HI, CLIP_LO as _LO
 
 N = 3000
 

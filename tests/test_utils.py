@@ -15,7 +15,7 @@ from pumiumtally_tpu.utils import (
 )
 
 
-from tests.conftest import CLIP_HI as _HI, CLIP_LO as _LO
+from tests.bounds import CLIP_HI as _HI, CLIP_LO as _LO
 
 N = 16
 
