@@ -116,6 +116,7 @@ def walk(
     max_iters: int,
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
+    cond_every: int = 1,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -123,6 +124,14 @@ def walk(
     caller (hold position — reference PumiTallyImpl.cpp:100-103); they
     finish on the first iteration with zero tally contribution
     (EvaluateFlux skips them, PumiTallyImpl.cpp:364).
+
+    ``cond_every`` unrolls that many masked body iterations per
+    ``while_loop`` step, evaluating the all-done reduction once per
+    group instead of per crossing — done particles are inert under the
+    active mask, so extra unrolled iterations change no result, only
+    waste at most ``cond_every − 1`` window passes per stage exit (and
+    the iteration budget may overshoot by the same amount before the
+    "not found" warning fires).
     """
     fdtype = x.dtype
     n_total = x.shape[0]
@@ -177,6 +186,15 @@ def walk(
         return it + 1, s, elem, x0, d0, seg_len, flying, weight, done, exited, flux
 
     it0 = jnp.asarray(0, jnp.int32)
+
+    cond_every = max(1, int(cond_every))
+    if cond_every > 1:
+        body_1 = body
+
+        def body(state):  # noqa: F811 — deliberate k-unrolled variant
+            for _ in range(cond_every):
+                state = body_1(state)
+            return state
 
     def final_x(s, done, exited):
         """Materialize positions from the ray coordinate — exactly once.

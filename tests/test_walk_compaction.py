@@ -111,3 +111,41 @@ def test_cascade_respects_max_iter_budget():
     # budget exhausted → some particles unfinished, reported not-done
     assert not bool(jnp.all(r.done))
     assert int(r.iters) <= 3
+
+
+def test_cond_every_k_is_exact():
+    """k-unrolled cond evaluation: per-particle results are bitwise
+    identical (the s-parametrized step math is window-independent);
+    flux matches to summation order (a stage may retire contributions
+    in different iteration groups, reordering the f64 adds)."""
+    from pumiumtally_tpu.api.tally import _localize_step
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 3000
+    rng = np.random.default_rng(12)
+    src = jnp.asarray(rng.uniform(0.05, 0.95, (n, 3)))
+    dest = jnp.asarray(rng.uniform(-0.1, 1.1, (n, 3)))
+    elem = jnp.zeros((n,), jnp.int32)
+    # Localize from the tet-0 centroid (walk's 'x inside elem'
+    # precondition) and insist it converged.
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    x, elem, done, _ = _localize_step(
+        mesh, jnp.broadcast_to(c0, (n, 3)), elem, src, tol=1e-8,
+        max_iters=2000,
+    )
+    assert bool(jnp.all(done))
+    fly = jnp.ones((n,), jnp.int8)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    flux0 = jnp.zeros((mesh.nelems,))
+    outs = [
+        walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-8,
+             max_iters=2000, min_window=256, cond_every=k)
+        for k in (1, 3)
+    ]
+    np.testing.assert_allclose(np.asarray(outs[0].flux),
+                               np.asarray(outs[1].flux),
+                               rtol=1e-13, atol=1e-14)
+    np.testing.assert_array_equal(np.asarray(outs[0].elem),
+                                  np.asarray(outs[1].elem))
+    np.testing.assert_array_equal(np.asarray(outs[0].x),
+                                  np.asarray(outs[1].x))

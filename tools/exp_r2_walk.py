@@ -1,0 +1,73 @@
+"""End-to-end walk A/B on the current backend: cond_every sweep +
+continue/two-phase rates at bench scale. Run AFTER exp_r2_profile.py
+when the chip is available.
+
+Usage: python tools/exp_r2_walk.py [N] [DIV] [MOVES]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import build_box
+from pumiumtally_tpu.ops.walk import walk
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+MOVES = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+
+def main():
+    import jax
+
+    mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+    rng = np.random.default_rng(0)
+    pts = [rng.uniform(0.05, 0.95, (N, 3)).astype(np.float32)]
+    for _ in range(MOVES):
+        step = rng.normal(scale=0.25 / np.sqrt(3), size=(N, 3))
+        pts.append(np.clip(pts[-1] + step, 0.02, 0.98).astype(np.float32))
+
+    from functools import partial
+
+    # Localize once; every cond_every variant starts from this state.
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    r0 = jax.jit(partial(walk, tally=False, tol=1e-6, max_iters=4096))(
+        mesh, jnp.broadcast_to(c0, (N, 3)), jnp.zeros((N,), jnp.int32),
+        jnp.asarray(pts[0]),
+        jnp.ones((N,), jnp.int8), jnp.zeros((N,), jnp.float32),
+        jnp.zeros((mesh.nelems,), jnp.float32),
+    )
+    x0, elem0 = r0.x, r0.elem
+
+    for k in (1, 2, 4, 8):
+        stepper = jax.jit(partial(
+            walk, tally=True, tol=1e-6, max_iters=4096, cond_every=k,
+        ))
+        x, elem = x0, elem0
+        flux = jnp.zeros((mesh.nelems,), jnp.float32)
+        fly = jnp.ones((N,), jnp.int8)
+        w = jnp.ones((N,), jnp.float32)
+        # warmup
+        r = stepper(mesh, x, elem, jnp.asarray(pts[1]), fly, w, flux)
+        float(jnp.sum(r.flux))
+        x2, e2, fx = r.x, r.elem, r.flux
+        t0 = time.perf_counter()
+        for m in range(2, MOVES + 1):
+            r = stepper(mesh, x2, e2, jnp.asarray(pts[m]), fly, w, fx)
+            x2, e2, fx = r.x, r.elem, r.flux
+        total = float(jnp.sum(fx))
+        dt = time.perf_counter() - t0
+        rate = N * (MOVES - 1) / dt
+        print(f"cond_every={k}: {rate:,.0f} moves/s  (sum={total:.3f})",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
