@@ -1,0 +1,100 @@
+"""Real multi-process jax.distributed smoke: 2 processes x 4 virtual
+CPU devices each -> one 8-device global mesh, driven through
+initialize_distributed + the sharded tally step.
+
+Each process runs this file with PROC_ID set; process 0 also spawns
+process 1 when RUN_BOTH=1. Success criterion: both processes see 8
+global devices, the sharded move runs, and the psum'd flux matches the
+single-process value.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PORT = int(os.environ.get("COORD_PORT", "47123"))
+
+
+def worker(pid: int) -> None:
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+    import numpy as np
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel.device import initialize_distributed
+
+    mesh_dev = initialize_distributed(
+        coordinator_address=f"127.0.0.1:{PORT}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert mesh_dev.devices.size == 8, mesh_dev
+    n = 64
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    rng = np.random.default_rng(0)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    dst = rng.uniform(0.1, 0.9, (n, 3))
+    t = PumiTally(mesh, n, TallyConfig(device_mesh=mesh_dev,
+                                       check_found_all=False))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    import jax.numpy as jnp
+
+    total = float(jnp.sum(t.flux))
+    expect = float(np.linalg.norm(dst - src, axis=1).sum())
+    rel = abs(total - expect) / expect
+    print(f"proc {pid}: devices={len(jax.devices())} "
+          f"flux={total:.6f} rel_err={rel:.2e}", flush=True)
+    assert rel < 1e-6
+    jax.distributed.shutdown()
+
+
+def main() -> None:
+    pid = int(os.environ.get("PROC_ID", "0"))
+    if os.environ.get("RUN_BOTH") == "1" and pid == 0:
+        env = dict(os.environ)
+        env["PROC_ID"] = "1"
+        env.pop("RUN_BOTH")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            worker(0)
+        except BaseException:
+            # A dead process 0 deadlocks the child's collectives; kill
+            # it so the original error surfaces, not a pipe timeout.
+            child.kill()
+            raise
+        finally:
+            try:
+                out, _ = child.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                out, _ = child.communicate()
+            print("--- child output ---")
+            print(out[-2000:])
+        if child.returncode != 0:
+            raise SystemExit(f"child rc={child.returncode}")
+        print("MULTIPROC-OK")
+    else:
+        worker(pid)
+
+
+if __name__ == "__main__":
+    main()
