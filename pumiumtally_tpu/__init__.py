@@ -25,7 +25,7 @@ from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.mesh.box import build_box
 from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
-from pumiumtally_tpu.api.streaming import StreamingTally
+from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
 
 __version__ = "0.1.0"
 
@@ -35,6 +35,7 @@ __all__ = [
     "build_box",
     "PumiTally",
     "PartitionedPumiTally",
+    "StreamingPartitionedTally",
     "StreamingTally",
     "TallyTimes",
 ]

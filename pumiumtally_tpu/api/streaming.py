@@ -76,6 +76,12 @@ class StreamingTally(PumiTally):
             # Chunks shard evenly over the mesh; pad slots never fly.
             self.chunk_size = -(-self.chunk_size // ndev) * ndev
         self.nchunks = -(-self.num_particles // self.chunk_size)
+        self._alloc_chunks(mesh)
+        self.tally_times.initialization_time += time.perf_counter() - t0
+
+    def _alloc_chunks(self, mesh: TetMesh) -> None:
+        """Per-chunk device state (overridden by the partitioned
+        composition below)."""
         c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0).astype(self.dtype)
         self._x = [
             jnp.broadcast_to(c0, (self.chunk_size, 3))
@@ -89,7 +95,6 @@ class StreamingTally(PumiTally):
             jnp.zeros((mesh.nelems,), self.dtype) for _ in range(self.nchunks)
         ]
         jax.block_until_ready(self._x[0])
-        self.tally_times.initialization_time += time.perf_counter() - t0
 
     # -- chunk staging ----------------------------------------------------
     def _chunk_bounds(self, k: int):
@@ -129,21 +134,7 @@ class StreamingTally(PumiTally):
         dones = []
         for k in range(self.nchunks):
             dest = self._stage_chunk_positions(host, k)
-            if self.device_mesh is not None:
-                from pumiumtally_tpu.parallel.sharded import (
-                    sharded_localize_step,
-                )
-
-                self._x[k], self._elem[k], done, _ = sharded_localize_step(
-                    self.device_mesh, self.mesh, self._x[k], self._elem[k],
-                    dest, tol=self._tol, max_iters=self._max_iters,
-                )
-            else:
-                self._x[k], self._elem[k], done, _ = _localize_step(
-                    self.mesh, self._x[k], self._elem[k], dest,
-                    tol=self._tol, max_iters=self._max_iters,
-                )
-            dones.append(done)
+            dones.append(self._chunk_localize(k, dest))
         if self.config.check_found_all and not all(
             bool(jnp.all(d)) for d in dones
         ):
@@ -195,47 +186,77 @@ class StreamingTally(PumiTally):
                 mask = np.zeros(self.chunk_size, np.int8)
                 mask[: hi - lo] = 1
                 fly = fly * jnp.asarray(mask)
-            if self.device_mesh is not None:
-                from pumiumtally_tpu.parallel.sharded import (
-                    sharded_move_step,
-                    sharded_move_step_continue,
-                )
-
-                if origins_h is None:
-                    (
-                        self._x[k], self._elem[k], self._flux[k], ok,
-                    ) = sharded_move_step_continue(
-                        self.device_mesh, self.mesh, self._x[k],
-                        self._elem[k], dest, fly, w, self._flux[k],
-                        tol=self._tol, max_iters=self._max_iters,
-                    )
-                else:
-                    orig = self._stage_chunk_positions(origins_h, k)
-                    (
-                        self._x[k], self._elem[k], self._flux[k], ok,
-                    ) = sharded_move_step(
-                        self.device_mesh, self.mesh, self._x[k],
-                        self._elem[k], orig, dest, fly, w, self._flux[k],
-                        tol=self._tol, max_iters=self._max_iters,
-                    )
-            elif origins_h is None:
-                self._x[k], self._elem[k], self._flux[k], ok = _move_step_continue(
-                    self.mesh, self._x[k], self._elem[k], dest, fly, w,
-                    self._flux[k], tol=self._tol, max_iters=self._max_iters,
-                )
-            else:
-                orig = self._stage_chunk_positions(origins_h, k)
-                self._x[k], self._elem[k], self._flux[k], ok = _move_step(
-                    self.mesh, self._x[k], self._elem[k], orig, dest, fly, w,
-                    self._flux[k], tol=self._tol, max_iters=self._max_iters,
-                )
-            oks.append(ok)
+            orig = (
+                None
+                if origins_h is None
+                else self._stage_chunk_positions(origins_h, k)
+            )
+            oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
         self.iter_count += 1
+        self._after_chunk_moves()
         if self.config.check_found_all and not all(bool(o) for o in oks):
             print("ERROR: Not all particles are found. May need more loops in search")
         jax.block_until_ready(self._flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
+
+    def _after_chunk_moves(self) -> None:
+        """Hook: deferred per-chunk error checks (partitioned mode)."""
+
+    # -- per-chunk dispatch (overridden by StreamingPartitionedTally) ----
+    def _chunk_localize(self, k: int, dest: jnp.ndarray):
+        """Localize chunk k to staged [chunk,3] destinations; returns
+        the chunk's done flags (lazy)."""
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
+
+            self._x[k], self._elem[k], done, _ = sharded_localize_step(
+                self.device_mesh, self.mesh, self._x[k], self._elem[k],
+                dest, tol=self._tol, max_iters=self._max_iters,
+            )
+        else:
+            self._x[k], self._elem[k], done, _ = _localize_step(
+                self.mesh, self._x[k], self._elem[k], dest,
+                tol=self._tol, max_iters=self._max_iters,
+            )
+        return done
+
+    def _chunk_move(self, k: int, orig, dest, fly, w):
+        """One tallied move of chunk k (orig None = continue mode);
+        returns found_all (lazy)."""
+        if self.device_mesh is not None:
+            from pumiumtally_tpu.parallel.sharded import (
+                sharded_move_step,
+                sharded_move_step_continue,
+            )
+
+            if orig is None:
+                (
+                    self._x[k], self._elem[k], self._flux[k], ok,
+                ) = sharded_move_step_continue(
+                    self.device_mesh, self.mesh, self._x[k],
+                    self._elem[k], dest, fly, w, self._flux[k],
+                    tol=self._tol, max_iters=self._max_iters,
+                )
+            else:
+                (
+                    self._x[k], self._elem[k], self._flux[k], ok,
+                ) = sharded_move_step(
+                    self.device_mesh, self.mesh, self._x[k],
+                    self._elem[k], orig, dest, fly, w, self._flux[k],
+                    tol=self._tol, max_iters=self._max_iters,
+                )
+        elif orig is None:
+            self._x[k], self._elem[k], self._flux[k], ok = _move_step_continue(
+                self.mesh, self._x[k], self._elem[k], dest, fly, w,
+                self._flux[k], tol=self._tol, max_iters=self._max_iters,
+            )
+        else:
+            self._x[k], self._elem[k], self._flux[k], ok = _move_step(
+                self.mesh, self._x[k], self._elem[k], orig, dest, fly, w,
+                self._flux[k], tol=self._tol, max_iters=self._max_iters,
+            )
+        return ok
 
     # -- state views ------------------------------------------------------
     @property
@@ -260,3 +281,109 @@ class StreamingTally(PumiTally):
     @property
     def elem_ids(self) -> np.ndarray:
         return np.asarray(self.elem)
+
+
+class StreamingPartitionedTally(StreamingTally):
+    """Streaming chunks through the PARTITIONED engine: the mesh too
+    large to replicate per chip AND the batch too large for one slot
+    array (BASELINE configs 2 + 5 composed). Each chunk owns a
+    ``PartitionedEngine`` slot state; all chunks share one mesh
+    partition and one set of compiled locate/phase programs, and owned
+    flux accumulates across chunks.
+    """
+
+    def __init__(
+        self,
+        mesh: Union[TetMesh, str],
+        num_particles: int,
+        chunk_size: int = 1_000_000,
+        config: Optional[TallyConfig] = None,
+    ):
+        if config is None or config.device_mesh is None:
+            raise ValueError(
+                "StreamingPartitionedTally requires TallyConfig.device_mesh"
+            )
+        super().__init__(mesh, num_particles, chunk_size, config)
+
+    def _alloc_chunks(self, mesh: TetMesh) -> None:
+        from pumiumtally_tpu.parallel.partition import (
+            PartitionedEngine,
+            build_partition,
+        )
+
+        part = build_partition(mesh, int(self.device_mesh.devices.size))
+        cache: dict = {}
+        # Each engine is sized to its chunk's REAL particle count (a
+        # padded slot would otherwise be a live particle piling onto
+        # whatever chip owns the repeated pad point).
+        self.engines = []
+        for k in range(self.nchunks):
+            lo, hi = self._chunk_bounds(k)
+            self.engines.append(PartitionedEngine(
+                mesh, self.device_mesh, hi - lo,
+                capacity_factor=self.config.capacity_factor,
+                tol=self._tol, max_iters=self._max_iters,
+                max_rounds=self.config.max_migration_rounds,
+                check_found_all=self.config.check_found_all,
+                part=part, shared_jit_cache=cache,
+            ))
+        # Base-class sync/view lists are unused in this mode.
+        self._x = []
+        self._elem = []
+        self._flux = []
+        self._pending_overflows = []
+        jax.block_until_ready(part.table)
+
+    # -- per-chunk dispatch via the partitioned engines ------------------
+    def _chunk_localize(self, k: int, dest: jnp.ndarray):
+        n = self.engines[k].n  # strip staging pads: engines hold only
+        found_all, _ = self.engines[k].localize(dest[:n])  # real slots
+        return found_all
+
+    def _chunk_move(self, k: int, orig, dest, fly, w):
+        # defer_sync: a per-chunk host sync would serialize the chunk
+        # pipeline; overflow flags are collected and checked once per
+        # move in _after_chunk_moves.
+        n = self.engines[k].n
+        ok, ovf = self.engines[k].move(
+            None if orig is None else orig[:n], dest[:n], fly[:n], w[:n],
+            defer_sync=True,
+        )
+        self._pending_overflows.append(ovf)
+        return ok
+
+    def _after_chunk_moves(self) -> None:
+        ovfs, self._pending_overflows = self._pending_overflows, []
+        if ovfs and bool(jnp.any(jnp.stack(ovfs))):
+            raise RuntimeError(
+                "partitioned-mode chip capacity exceeded during particle "
+                "migration; raise TallyConfig.capacity_factor"
+            )
+
+    # -- state views (numpy-side: engine accessors already fetched) ------
+    @property
+    def x(self):
+        return np.concatenate(
+            [e.positions() for e in self.engines], axis=0
+        )[: self.num_particles]
+
+    @property
+    def elem(self):
+        return np.concatenate(
+            [e.elem_ids() for e in self.engines]
+        )[: self.num_particles]
+
+    @property
+    def positions(self) -> np.ndarray:
+        return self.x
+
+    @property
+    def elem_ids(self) -> np.ndarray:
+        return self.elem
+
+    @property
+    def flux(self) -> jnp.ndarray:
+        total = self.engines[0].flux_original()
+        for e in self.engines[1:]:
+            total = total + e.flux_original()
+        return total

@@ -426,7 +426,14 @@ class PartitionedEngine:
         max_iters: int,
         max_rounds: int = 64,
         check_found_all: bool = True,
+        part: Optional[MeshPartition] = None,
+        shared_jit_cache: Optional[dict] = None,
     ):
+        """``part`` reuses a prebuilt partition (chunked engines over
+        the same mesh share one); ``shared_jit_cache`` shares the
+        compiled locate/phase programs between engines with identical
+        partition/tolerance/round parameters — without it every chunk
+        engine would recompile the phase while_loop."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -435,7 +442,9 @@ class PartitionedEngine:
         # The full TetMesh is consumed here once and NOT retained: after
         # build_partition every engine path (localization included)
         # touches only per-chip sharded tables.
-        self.part = build_partition(mesh, self.ndev)
+        self.part = part if part is not None else build_partition(
+            mesh, self.ndev
+        )
         self.cap_per_chip = int(
             -(-self.n // self.ndev) * capacity_factor + 1
         )
@@ -451,8 +460,8 @@ class PartitionedEngine:
         pid = np.full(self.cap, -1, np.int32)
         pid[: self.n] = np.arange(self.n, dtype=np.int32)
         alive = pid >= 0
-        self._phase_fns: dict = {}
-        self._locate_fn = None
+        cache = shared_jit_cache if shared_jit_cache is not None else {}
+        self._jit_cache = cache
         self._n_lost = 0
         self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
@@ -488,8 +497,9 @@ class PartitionedEngine:
     def _locate_program(self):
         """Cached jitted sharded point-location: [M,3] replicated points
         → [M] padded global element id (``ndev*L`` = not found)."""
-        if self._locate_fn is not None:
-            return self._locate_fn
+        key = ("locate", self._locate_chunk_size, self.tol, id(self.part))
+        if key in self._jit_cache:
+            return self._jit_cache[key]
         pp = P(self.axis)
         ax = self.axis
         L = self.part.L
@@ -515,7 +525,7 @@ class PartitionedEngine:
             # shared partition faces).
             return lax.pmin(glid, ax)
 
-        self._locate_fn = locate
+        self._jit_cache[key] = locate
         return locate
 
     @property
@@ -591,8 +601,16 @@ class PartitionedEngine:
         migrate→walk rounds as needed, all inside one ``lax.while_loop``
         — zero per-round host syncs (the reference's search loop pays an
         MPI rendezvous per migration instead)."""
-        if tally in self._phase_fns:
-            return self._phase_fns[tally]
+        # The closures bake in EVERY per-engine parameter they capture
+        # — capacity, round/iteration budgets, tolerance, and the
+        # partition itself — so the cache key must carry all of them:
+        # engines sharing a cache reuse a compiled phase only for a
+        # fully identical configuration (chunked engines differ in the
+        # last, smaller chunk's capacity).
+        key = ("phase", tally, self.cap_per_chip, self.max_rounds,
+               self.max_iters, self.tol, id(self.part))
+        if key in self._jit_cache:
+            return self._jit_cache[key]
         pp = P(self.axis)
         ax = self.axis
         part_L, ndev, cpc = self.part.L, self.ndev, self.cap_per_chip
@@ -681,19 +699,31 @@ class PartitionedEngine:
             found_all = (n_nd == 0) & (n_p == 0)
             return st, fx, found_all, ovf
 
-        self._phase_fns[tally] = phase
+        self._jit_cache[key] = phase
         return phase
 
-    def _run_phase(self, tally: bool) -> bool:
-        """One jitted walk+migrate phase; a single host sync at the end.
-        Returns found_all (False if the round budget ran out)."""
+    def _run_phase(self, tally: bool, defer_sync: bool = False):
+        """One jitted walk+migrate phase.
+
+        Default: a single host sync at the end; returns found_all
+        (False if the round budget ran out), raising on overflow BEFORE
+        committing so the engine keeps its pre-phase state.
+
+        ``defer_sync=True`` (the streaming pipeline: chunk k+1's
+        staging must overlap chunk k's walk) returns the LAZY
+        (found_all, overflow) scalars and commits unconditionally — the
+        caller syncs a whole batch of chunks at once and raises then;
+        on overflow the state is corrupt, which is acceptable because
+        the raise abandons the run."""
         phase = self._phase_program(tally)
         st, fx, found_all, ovf = phase(
             self.part.table, self.part.adj_int, self.state, self.flux_padded
         )
+        if defer_sync:
+            self.state = st
+            self.flux_padded = fx
+            return found_all, ovf
         ovf_v, found_v = jax.device_get((ovf, found_all))
-        # Raise BEFORE committing: on overflow the engine keeps its
-        # pre-phase state/flux instead of a corrupted post-overflow one.
         self._check_overflow(ovf_v)
         self.state = st
         self.flux_padded = fx
@@ -705,8 +735,12 @@ class PartitionedEngine:
         dests_n: jnp.ndarray,
         fly_n: jnp.ndarray,
         w_n: jnp.ndarray,
-    ) -> bool:
-        """Full (or continue-mode) tallied move. Returns found_all."""
+        defer_sync: bool = False,
+    ):
+        """Full (or continue-mode) tallied move.
+
+        Returns found_all (bool), or with ``defer_sync=True`` the lazy
+        (found_all, overflow) pair — see ``_run_phase``."""
         if origins_n is not None and self._n_lost:
             # Revival: a resampled origin inside the mesh re-locates a
             # lost particle (mirrors the single-chip engine, where
@@ -720,12 +754,17 @@ class PartitionedEngine:
         st["fly"] = jnp.where(st["lost"], jnp.asarray(0, jnp.int8), st["fly"])
         st["w"] = self._by_pid(w_n, jnp.asarray(0.0, st["w"].dtype))
         ok_a = True
+        ovf_a = None
         if origins_n is not None:
             # Phase A: relocate to origins, weights zeroed (cpp:105).
             st["dest"] = self._by_pid(origins_n, jnp.asarray(0.0, st["x"].dtype))
             st["w"] = jnp.zeros_like(st["w"])
             self.state = st
-            ok_a = self._run_phase(tally=False)
+            ra = self._run_phase(tally=False, defer_sync=defer_sync)
+            if defer_sync:
+                ok_a, ovf_a = ra
+            else:
+                ok_a = ra
             st = self.state
             # Re-route the real weights by pid: phase-A migrations may
             # have permuted every slot, so a saved pre-phase copy would
@@ -733,8 +772,12 @@ class PartitionedEngine:
             st["w"] = self._by_pid(w_n, jnp.asarray(0.0, st["w"].dtype))
         st["dest"] = self._by_pid(dests_n, jnp.asarray(0.0, st["x"].dtype))
         self.state = st
-        ok_b = self._run_phase(tally=True)
-        return ok_a and ok_b
+        rb = self._run_phase(tally=True, defer_sync=defer_sync)
+        if defer_sync:
+            ok_b, ovf_b = rb
+            ovf = ovf_b if ovf_a is None else (ovf_a | ovf_b)
+            return ok_a & ok_b, ovf
+        return ok_a and rb
 
     def _revive_lost(self, origins_n: jnp.ndarray) -> None:
         """Re-locate lost particles whose resampled origin lies inside
