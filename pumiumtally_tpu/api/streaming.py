@@ -135,6 +135,7 @@ class StreamingTally(PumiTally):
         for k in range(self.nchunks):
             dest = self._stage_chunk_positions(host, k)
             dones.append(self._chunk_localize(k, dest))
+        self._after_chunk_dispatch()
         if self.config.check_found_all and not all(
             bool(jnp.all(d)) for d in dones
         ):
@@ -194,13 +195,13 @@ class StreamingTally(PumiTally):
             oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
         self.iter_count += 1
-        self._after_chunk_moves()
+        self._after_chunk_dispatch()
         if self.config.check_found_all and not all(bool(o) for o in oks):
             print("ERROR: Not all particles are found. May need more loops in search")
         jax.block_until_ready(self._flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
 
-    def _after_chunk_moves(self) -> None:
+    def _after_chunk_dispatch(self) -> None:
         """Hook: deferred per-chunk error checks (partitioned mode)."""
 
     # -- per-chunk dispatch (overridden by StreamingPartitionedTally) ----
@@ -335,15 +336,18 @@ class StreamingPartitionedTally(StreamingTally):
         jax.block_until_ready(part.table)
 
     # -- per-chunk dispatch via the partitioned engines ------------------
+    # defer_sync everywhere: a per-chunk host sync would serialize the
+    # chunk pipeline; overflow flags are collected and checked once per
+    # protocol call in _after_chunk_dispatch.
     def _chunk_localize(self, k: int, dest: jnp.ndarray):
         n = self.engines[k].n  # strip staging pads: engines hold only
-        found_all, _ = self.engines[k].localize(dest[:n])  # real slots
+        found_all, ovf = self.engines[k].localize(  # real slots
+            dest[:n], defer_sync=True
+        )
+        self._pending_overflows.append(ovf)
         return found_all
 
     def _chunk_move(self, k: int, orig, dest, fly, w):
-        # defer_sync: a per-chunk host sync would serialize the chunk
-        # pipeline; overflow flags are collected and checked once per
-        # move in _after_chunk_moves.
         n = self.engines[k].n
         ok, ovf = self.engines[k].move(
             None if orig is None else orig[:n], dest[:n], fly[:n], w[:n],
@@ -352,13 +356,12 @@ class StreamingPartitionedTally(StreamingTally):
         self._pending_overflows.append(ovf)
         return ok
 
-    def _after_chunk_moves(self) -> None:
+    def _after_chunk_dispatch(self) -> None:
+        from pumiumtally_tpu.parallel.partition import OVERFLOW_MESSAGE
+
         ovfs, self._pending_overflows = self._pending_overflows, []
         if ovfs and bool(jnp.any(jnp.stack(ovfs))):
-            raise RuntimeError(
-                "partitioned-mode chip capacity exceeded during particle "
-                "migration; raise TallyConfig.capacity_factor"
-            )
+            raise RuntimeError(OVERFLOW_MESSAGE)
 
     # -- state views (numpy-side: engine accessors already fetched) ------
     @property

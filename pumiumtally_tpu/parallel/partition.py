@@ -373,6 +373,12 @@ def _default_state(cap: int, like: dict) -> dict:
     return d
 
 
+OVERFLOW_MESSAGE = (
+    "partitioned-mode chip capacity exceeded during particle "
+    "migration; raise TallyConfig.capacity_factor"
+)
+
+
 # ---------------------------------------------------------------------------
 # Sharded point location (localization without a replicated mesh)
 # ---------------------------------------------------------------------------
@@ -462,7 +468,8 @@ class PartitionedEngine:
         alive = pid >= 0
         cache = shared_jit_cache if shared_jit_cache is not None else {}
         self._jit_cache = cache
-        self._n_lost = 0
+        self._n_lost_dev = None
+        self._n_lost_cache = 0
         self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
             "x": jnp.zeros((self.cap, 3), dtype),
@@ -550,11 +557,17 @@ class PartitionedEngine:
             )
         return locate(self.part.table, self._valid, pts)[: self.n]
 
-    def localize(self, dest_n: jnp.ndarray) -> Tuple[Any, int]:
+    def localize(
+        self, dest_n: jnp.ndarray, defer_sync: bool = False
+    ) -> Tuple[Any, Any]:
         """CopyInitialPosition: sharded point location (module docstring)
         instead of the reference's walk-from-element-0 — same observable
         contract (particle lands in the element containing its source
-        point, zero flux). Returns (found_all, n_exited=0).
+        point, zero flux). Returns (found_all, n_exited=0); with
+        ``defer_sync=True`` (streaming chunk pipelines) the second
+        element is instead the LAZY overflow flag and no host sync
+        happens here — the caller checks overflow for a whole batch of
+        chunks at once.
 
         Divergence from the single-chip engine, by design: a source
         point inside NO element (out-of-hull, or a non-convex gap) makes
@@ -582,19 +595,30 @@ class PartitionedEngine:
             part_L=self.part.L, ndev=self.ndev,
             cap_per_chip=self.cap_per_chip, state=st,
         )
-        self._check_overflow(overflow)
         # Mark the phase finished for all particles.
         self.state["done"] = jnp.ones((self.cap,), bool)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
-        # One host sync per localization (not per move): the revival
-        # path in move() only engages while lost particles exist.
-        self._n_lost = int(jnp.sum(~found))
-        if self._n_lost and self.check_found_all:
+        # Lazy lost count: fetched only when the warning needs it or
+        # when a two-phase move engages the revival path.
+        self._n_lost_dev = jnp.sum(~found)
+        self._n_lost_cache = None
+        if defer_sync:
+            return jnp.all(found), overflow
+        self._check_overflow(overflow)
+        if self.check_found_all and self._n_lost:
             print(
                 f"[WARNING] {self._n_lost} source points lie in no mesh "
                 "element; their particles are excluded from transport"
             )
         return jnp.all(found), 0
+
+    @property
+    def _n_lost(self) -> int:
+        if self._n_lost_cache is None:
+            self._n_lost_cache = (
+                0 if self._n_lost_dev is None else int(self._n_lost_dev)
+            )
+        return self._n_lost_cache
 
     def _phase_program(self, tally: bool):
         """Cached jitted FULL phase: initial walk round plus as many
@@ -800,15 +824,13 @@ class PartitionedEngine:
         )
         self._check_overflow(overflow)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
-        self._n_lost = int(jnp.sum(self.state["lost"]))
+        self._n_lost_dev = jnp.sum(self.state["lost"])
+        self._n_lost_cache = None
 
     # -- outputs ---------------------------------------------------------
     def _check_overflow(self, overflow) -> None:
         if bool(overflow):
-            raise RuntimeError(
-                "partitioned-mode chip capacity exceeded during particle "
-                "migration; raise TallyConfig.capacity_factor"
-            )
+            raise RuntimeError(OVERFLOW_MESSAGE)
 
     def _order(self) -> jnp.ndarray:
         """Slot order returning caller-visible particle order."""

@@ -40,7 +40,54 @@ def _write_msh_v4(path, coords, tets):
         f.write("$EndElements\n")
 
 
-@pytest.mark.parametrize("writer", [_write_msh_v2, _write_msh_v4])
+def _write_msh_v2_binary(path, coords, tets):
+    import struct
+
+    with open(path, "wb") as f:
+        f.write(b"$MeshFormat\n2.2 1 8\n")
+        f.write(struct.pack("<i", 1))
+        f.write(b"\n$EndMeshFormat\n$Nodes\n")
+        f.write(f"{len(coords)}\n".encode())
+        for i, (x, y, z) in enumerate(coords, start=1):
+            f.write(struct.pack("<iddd", i, x, y, z))
+        f.write(b"\n$EndNodes\n$Elements\n")
+        f.write(f"{len(tets)}\n".encode())
+        # one block of tets: etype=4, nfollow, ntags=2
+        f.write(struct.pack("<iii", 4, len(tets), 2))
+        for i, t in enumerate(tets, start=1):
+            f.write(struct.pack("<7i", i, 0, 1,
+                                t[0] + 1, t[1] + 1, t[2] + 1, t[3] + 1))
+        f.write(b"\n$EndElements\n")
+
+
+def _write_msh_v4_binary(path, coords, tets):
+    import struct
+
+    nv, ne = len(coords), len(tets)
+    with open(path, "wb") as f:
+        f.write(b"$MeshFormat\n4.1 1 8\n")
+        f.write(struct.pack("<i", 1))
+        f.write(b"\n$EndMeshFormat\n$Nodes\n")
+        f.write(struct.pack("<4q", 1, nv, 1, nv))
+        f.write(struct.pack("<iiiq", 3, 1, 0, nv))
+        for i in range(1, nv + 1):
+            f.write(struct.pack("<q", i))
+        for x, y, z in coords:
+            f.write(struct.pack("<3d", x, y, z))
+        f.write(b"\n$EndNodes\n$Elements\n")
+        f.write(struct.pack("<4q", 1, ne, 1, ne))
+        f.write(struct.pack("<iiiq", 3, 1, 4, ne))
+        for i, t in enumerate(tets, start=1):
+            f.write(struct.pack("<5q", i,
+                                t[0] + 1, t[1] + 1, t[2] + 1, t[3] + 1))
+        f.write(b"\n$EndElements\n")
+
+
+@pytest.mark.parametrize(
+    "writer",
+    [_write_msh_v2, _write_msh_v4, _write_msh_v2_binary,
+     _write_msh_v4_binary],
+)
 def test_gmsh_round_trip(tmp_path, writer):
     coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
     path = str(tmp_path / "m.msh")
