@@ -82,22 +82,25 @@ pumiumtally_handle* pumiumtally_create(const char* mesh_filename,
   GilGuard gil;
   if (!ensure_numpy()) return nullptr;
 
-  PyObject* mod = PyImport_ImportModule("pumiumtally_tpu");
+  /* Engine selection (mono / streaming / partitioned / ...) is
+   * environment-driven so the C signature stays the reference's;
+   * see pumiumtally_tpu/api/native.py for the PUMIUMTALLY_* vars. */
+  PyObject* mod = PyImport_ImportModule("pumiumtally_tpu.api.native");
   if (!mod) {
-    fail_py("import pumiumtally_tpu");
+    fail_py("import pumiumtally_tpu.api.native");
     return nullptr;
   }
-  PyObject* cls = PyObject_GetAttrString(mod, "PumiTally");
+  PyObject* cls = PyObject_GetAttrString(mod, "native_create");
   Py_DECREF(mod);
   if (!cls) {
-    fail_py("PumiTally lookup");
+    fail_py("native_create lookup");
     return nullptr;
   }
   PyObject* tally = PyObject_CallFunction(cls, "si", mesh_filename,
                                           (int)num_particles);
   Py_DECREF(cls);
   if (!tally) {
-    fail_py("PumiTally()");
+    fail_py("native_create()");
     return nullptr;
   }
   auto* h = new pumiumtally_handle{tally, num_particles};

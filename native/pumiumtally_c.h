@@ -22,9 +22,13 @@ extern "C" {
 
 typedef struct pumiumtally_handle pumiumtally_handle;
 
-/* Create an engine bound to a mesh file (.msh Gmsh ASCII or .osh
- * Omega_h directory; the reference ctor takes its .osh path,
- * PumiTally.h:50).
+/* Create an engine bound to a mesh file (.msh Gmsh ASCII/binary or
+ * .osh Omega_h directory; the reference ctor takes its .osh path,
+ * PumiTally.h:50). The engine flavor is environment-selected so this
+ * signature stays builtin-typed: PUMIUMTALLY_ENGINE = mono (default),
+ * streaming, partitioned, or streaming_partitioned, with
+ * PUMIUMTALLY_{DEVICES,CHUNK_SIZE,CAPACITY_FACTOR,TOLERANCE,OUTPUT}
+ * knobs (see pumiumtally_tpu/api/native.py).
  * Returns NULL on failure (error printed to stderr). */
 pumiumtally_handle* pumiumtally_create(const char* mesh_filename,
                                        int32_t num_particles);

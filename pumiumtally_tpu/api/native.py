@@ -1,0 +1,69 @@
+"""Engine factory for the native C ABI.
+
+The C boundary (native/pumiumtally_c.h) keeps the reference's
+builtin-typed constructor signature — ``(mesh_filename,
+num_particles)``, reference PumiTally.h:50 — so engine selection for a
+physics host app happens through the environment, the same way the
+reference selects its Kokkos backend at build time:
+
+    PUMIUMTALLY_ENGINE            mono (default) | streaming |
+                                  partitioned | streaming_partitioned
+    PUMIUMTALLY_DEVICES           device-mesh size (default: all local
+                                  devices; implies the sharded
+                                  replicated mode for `mono`/`streaming`)
+    PUMIUMTALLY_CHUNK_SIZE        streaming chunk size (default 1e6)
+    PUMIUMTALLY_CAPACITY_FACTOR   partitioned slot over-provisioning
+    PUMIUMTALLY_TOLERANCE         walk tolerance override
+    PUMIUMTALLY_OUTPUT            default VTK output path
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def native_create(mesh_filename: str, num_particles: int):
+    """Build the engine the environment asks for (see module doc)."""
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        PumiTally,
+        StreamingPartitionedTally,
+        StreamingTally,
+        TallyConfig,
+    )
+
+    engine = os.environ.get("PUMIUMTALLY_ENGINE", "mono").lower()
+    kwargs = {}
+    tol = os.environ.get("PUMIUMTALLY_TOLERANCE")
+    if tol:
+        kwargs["tolerance"] = float(tol)
+    capf = os.environ.get("PUMIUMTALLY_CAPACITY_FACTOR")
+    if capf:
+        kwargs["capacity_factor"] = float(capf)
+    out = os.environ.get("PUMIUMTALLY_OUTPUT")
+    if out:
+        kwargs["output_filename"] = out
+    ndev = os.environ.get("PUMIUMTALLY_DEVICES")
+    partitioned = engine in ("partitioned", "streaming_partitioned")
+    if ndev or partitioned:
+        from pumiumtally_tpu.parallel import make_device_mesh
+
+        kwargs["device_mesh"] = make_device_mesh(
+            int(ndev) if ndev else None
+        )
+    cfg = TallyConfig(**kwargs)
+    chunk = int(os.environ.get("PUMIUMTALLY_CHUNK_SIZE", "1000000"))
+    if engine == "mono":
+        return PumiTally(mesh_filename, num_particles, cfg)
+    if engine == "streaming":
+        return StreamingTally(mesh_filename, num_particles, chunk, cfg)
+    if engine == "partitioned":
+        return PartitionedPumiTally(mesh_filename, num_particles, cfg)
+    if engine == "streaming_partitioned":
+        return StreamingPartitionedTally(
+            mesh_filename, num_particles, chunk, cfg
+        )
+    raise ValueError(
+        f"PUMIUMTALLY_ENGINE={engine!r}: expected mono, streaming, "
+        "partitioned, or streaming_partitioned"
+    )

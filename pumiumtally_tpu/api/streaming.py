@@ -362,6 +362,18 @@ class StreamingPartitionedTally(StreamingTally):
         ovfs, self._pending_overflows = self._pending_overflows, []
         if ovfs and bool(jnp.any(jnp.stack(ovfs))):
             raise RuntimeError(OVERFLOW_MESSAGE)
+        # Resolve every engine's lost count at this batch sync point:
+        # the two-phase revival check in move() then reads a cached int
+        # instead of forcing a mid-pipeline device fetch.
+        n_lost = sum(e._n_lost for e in self.engines)
+        if n_lost and not self.is_initialized and self.config.check_found_all:
+            # The localization call (is_initialized flips right after):
+            # surface the specific diagnostic the per-chunk deferred
+            # path skipped.
+            print(
+                f"[WARNING] {n_lost} source points lie in no mesh "
+                "element; their particles are excluded from transport"
+            )
 
     # -- state views (numpy-side: engine accessors already fetched) ------
     @property

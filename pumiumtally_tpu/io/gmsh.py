@@ -17,9 +17,16 @@ from typing import List, Tuple
 
 import numpy as np
 
-# Node counts per Gmsh element type (type 4 = 4-node tetrahedron).
-_NODES_PER_ELEM_TYPE = {1: 2, 2: 3, 3: 4, 4: 4, 5: 8, 6: 6, 7: 5, 8: 3,
-                        9: 6, 10: 9, 11: 10, 15: 1}
+# Node counts per Gmsh element type (type 4 = 4-node tetrahedron). The
+# binary readers need these to SKIP non-tet blocks (the record stride
+# depends on the node count), so the table carries the full standard
+# set; a type outside it is unskippable and must error.
+_NODES_PER_ELEM_TYPE = {
+    1: 2, 2: 3, 3: 4, 4: 4, 5: 8, 6: 6, 7: 5, 8: 3, 9: 6, 10: 9,
+    11: 10, 12: 27, 13: 18, 14: 14, 15: 1, 16: 8, 17: 20, 18: 15,
+    19: 13, 20: 9, 21: 10, 22: 12, 23: 15, 24: 15, 25: 21, 26: 4,
+    27: 5, 28: 6, 29: 20, 30: 35, 31: 56, 92: 64, 93: 125,
+}
 
 
 def _section(data: bytes, name: str) -> bytes:

@@ -1,6 +1,7 @@
 """API surface tests: VTK output, normalization, timing, config."""
 
 import numpy as np
+import pytest
 
 from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
@@ -94,3 +95,44 @@ def test_flat_and_2d_inputs_equivalent():
     t1.CopyInitialPosition(init2d.reshape(-1))
     t2.CopyInitialPosition(init2d)
     np.testing.assert_array_equal(t1.elem_ids, t2.elem_ids)
+
+
+def test_native_create_engine_selection(monkeypatch, tmp_path):
+    """The C ABI's environment-driven engine factory builds each engine
+    flavor (native/pumiumtally_c.cpp routes pumiumtally_create here)."""
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        PumiTally,
+        StreamingPartitionedTally,
+        StreamingTally,
+    )
+    from pumiumtally_tpu.api.native import native_create
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    mesh_path = str(tmp_path / "m.osh")
+    write_osh(mesh_path, coords, tets)
+
+    monkeypatch.delenv("PUMIUMTALLY_ENGINE", raising=False)
+    assert type(native_create(mesh_path, 50)) is PumiTally
+
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "streaming")
+    monkeypatch.setenv("PUMIUMTALLY_CHUNK_SIZE", "16")
+    t = native_create(mesh_path, 50)
+    assert type(t) is StreamingTally and t.nchunks == 4
+
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "partitioned")
+    monkeypatch.setenv("PUMIUMTALLY_DEVICES", "4")
+    monkeypatch.setenv("PUMIUMTALLY_CAPACITY_FACTOR", "4.0")
+    t = native_create(mesh_path, 50)
+    assert type(t) is PartitionedPumiTally
+    assert t.engine.ndev == 4
+
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "streaming_partitioned")
+    t = native_create(mesh_path, 50)
+    assert type(t) is StreamingPartitionedTally
+
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "bogus")
+    with pytest.raises(ValueError, match="PUMIUMTALLY_ENGINE"):
+        native_create(mesh_path, 50)

@@ -171,3 +171,26 @@ def test_streaming_partitioned_deferred_overflow_raises():
     corner = np.tile([0.03, 0.03, 0.03], (n, 1))
     with pytest.raises(RuntimeError, match="capacity exceeded"):
         sp.MoveToNextLocation(None, corner.reshape(-1).copy())
+
+
+def test_streaming_partitioned_lost_warning(capsys):
+    """The deferred chunk pipeline still surfaces the specific
+    out-of-mesh-source diagnostic (at the batch sync point)."""
+    from pumiumtally_tpu import StreamingPartitionedTally
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    dm = make_device_mesh(4)
+    n = 64
+    sp = StreamingPartitionedTally(
+        mesh, n, chunk_size=32,
+        config=TallyConfig(device_mesh=dm, capacity_factor=4.0),
+    )
+    rng = np.random.default_rng(2)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    src[::8] += 7.0  # out of the unit box
+    sp.CopyInitialPosition(src.reshape(-1).copy())
+    out = capsys.readouterr().out
+    assert "8 source points lie in no mesh element" in out
+    ids = sp.elem_ids
+    assert np.all(ids[::8] == -1)
