@@ -161,6 +161,51 @@ def run_pincell(n: int, moves: int) -> dict:
     return timed_moves(t, pts, moves, drive)
 
 
+def preflight_device(max_wait_s: float = 600.0) -> None:
+    """Fail fast (rc 1) if the accelerator cannot be claimed.
+
+    A killed TPU client can leave the tunnel's device grant stuck, and
+    a jax backend init then hangs forever. Probe in SUBPROCESSES (the
+    hang is only escapable by killing the process) with retries, so a
+    transiently busy tunnel still gets its bench, and a wedged one
+    produces a diagnosable failure instead of an eternal hang.
+    """
+    deadline = time.monotonic() + max_wait_s
+    attempt = 0
+    fast_failures = 0
+    last_err = ""
+    while True:
+        attempt += 1
+        timed_out = False
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "print(float(jnp.sum(jnp.ones(8))))"],
+                capture_output=True, text=True, timeout=150,
+            )
+            if r.returncode == 0:
+                return
+            last_err = r.stderr[-2000:]
+            fast_failures += 1
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            last_err = "(probe timed out — wedged device tunnel?)"
+        # A quick rc!=0 is deterministic (broken install/driver), not a
+        # busy tunnel: don't burn the whole deadline retrying it.
+        if (not timed_out and fast_failures >= 3) or (
+            time.monotonic() >= deadline
+        ):
+            print(
+                f"# FATAL: accelerator unreachable after {attempt} probe "
+                f"attempts; no benchmark number can be measured.\n"
+                f"# last probe error:\n{last_err}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        time.sleep(30)
+
+
 def main() -> None:
     if os.environ.get("PUMIUMTALLY_BENCH_CPU") == "1":
         # Subprocess mode: CPU baseline on the IDENTICAL workload.
@@ -168,6 +213,7 @@ def main() -> None:
         print(json.dumps({"cpu_two_phase_rate": res["moves_per_sec"]}))
         return
 
+    preflight_device()
     two = run_workload(N, MOVES, "two_phase")
     cont = run_workload(N, MOVES, "continue")
     pincell = run_pincell(N, 4)

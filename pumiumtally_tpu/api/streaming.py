@@ -333,6 +333,7 @@ class StreamingPartitionedTally(StreamingTally):
         self._elem = []
         self._flux = []
         self._pending_overflows = []
+        self._dispatched_localize = False
         jax.block_until_ready(part.table)
 
     # -- per-chunk dispatch via the partitioned engines ------------------
@@ -340,6 +341,7 @@ class StreamingPartitionedTally(StreamingTally):
     # chunk pipeline; overflow flags are collected and checked once per
     # protocol call in _after_chunk_dispatch.
     def _chunk_localize(self, k: int, dest: jnp.ndarray):
+        self._dispatched_localize = True
         n = self.engines[k].n  # strip staging pads: engines hold only
         found_all, ovf = self.engines[k].localize(  # real slots
             dest[:n], defer_sync=True
@@ -366,10 +368,13 @@ class StreamingPartitionedTally(StreamingTally):
         # the two-phase revival check in move() then reads a cached int
         # instead of forcing a mid-pipeline device fetch.
         n_lost = sum(e._n_lost for e in self.engines)
-        if n_lost and not self.is_initialized and self.config.check_found_all:
-            # The localization call (is_initialized flips right after):
-            # surface the specific diagnostic the per-chunk deferred
-            # path skipped.
+        was_localize, self._dispatched_localize = (
+            self._dispatched_localize, False
+        )
+        if n_lost and was_localize and self.config.check_found_all:
+            # Surface the specific diagnostic the per-chunk deferred
+            # localize skipped (on EVERY re-sourcing, like the
+            # non-streaming partitioned engine).
             print(
                 f"[WARNING] {n_lost} source points lie in no mesh "
                 "element; their particles are excluded from transport"

@@ -19,10 +19,15 @@ def _engine_kind(tally) -> str:
     # Local imports: utils must not import the api package at module
     # load (api imports utils).
     from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
-    from pumiumtally_tpu.api.streaming import StreamingTally
+    from pumiumtally_tpu.api.streaming import (
+        StreamingPartitionedTally,
+        StreamingTally,
+    )
 
     if isinstance(tally, PartitionedPumiTally):
         return "partitioned"
+    if isinstance(tally, StreamingPartitionedTally):
+        return "streaming_partitioned"
     if isinstance(tally, StreamingTally):
         return "streaming"
     return "monolithic"
@@ -114,6 +119,15 @@ def _restore_canonical(tally, kind, x, elem, flux, z) -> None:
     import jax.numpy as jnp
 
     n = tally.num_particles
+    if kind in ("monolithic", "streaming") and np.any(elem[:n] < 0):
+        # elem == -1 marks LOST particles (source in no element, a
+        # partitioned-engine state); non-partitioned engines have no
+        # way to keep them excluded from transport — aliasing them
+        # onto a real element would silently corrupt the tally.
+        raise ValueError(
+            "checkpoint contains lost particles (element id -1); "
+            "restore it into a partitioned engine"
+        )
     if kind == "monolithic":
         cap = tally._cap
         xf = np.zeros((cap, 3), np.float64)
@@ -141,37 +155,66 @@ def _restore_canonical(tally, kind, x, elem, flux, z) -> None:
             jnp.zeros_like(tally._flux[0]) for _ in range(tally.nchunks - 1)
         ]
     elif kind == "partitioned":
-        eng = tally.engine
-        glid = np.asarray(eng.part.glid_of_orig)[elem]
-        st = dict(eng.state)
-        # Rebuild the slot layout from scratch: particle pid in slot pid,
-        # then one migration distributes to owners.
-        pid = np.full(eng.cap, -1, np.int32)
-        pid[:n] = np.arange(n, dtype=np.int32)
-        alive = pid >= 0
-        xf = np.zeros((eng.cap, 3), np.float64)
-        xf[:n] = x
-        pend = np.full(eng.cap, -1, np.int32)
-        pend[:n] = glid
-        st["x"] = jnp.asarray(xf, dtype=tally.dtype)
-        st["pid"] = jnp.asarray(pid)
-        st["alive"] = jnp.asarray(alive)
-        st["pending"] = jnp.asarray(pend)
-        st["lelem"] = jnp.zeros((eng.cap,), jnp.int32)
-        st["done"] = jnp.asarray(~alive)
-        st["exited"] = jnp.zeros((eng.cap,), bool)
-        from pumiumtally_tpu.parallel.partition import migrate
-
-        eng.state, overflow = migrate(
-            part_L=eng.part.L, ndev=eng.ndev,
-            cap_per_chip=eng.cap_per_chip, state=st,
-        )
-        eng._check_overflow(overflow)
-        eng.state["done"] = jnp.ones((eng.cap,), bool)
-        eng.state["pending"] = jnp.full((eng.cap,), -1, jnp.int32)
-        # Owned flux layout: original order -> padded glid slots.
-        fpad = np.zeros((eng.ndev * eng.part.L,), np.float64)
-        fpad[np.asarray(eng.part.glid_of_orig)] = flux
-        eng.flux_padded = jnp.asarray(fpad, dtype=tally.dtype)
+        _restore_partitioned_engine(tally.engine, x, elem, flux, tally.dtype)
+    elif kind == "streaming_partitioned":
+        # Per-chunk engines; the accumulated flux lives wholly in
+        # engine 0 (the flux property sums engines).
+        for k, eng in enumerate(tally.engines):
+            lo, hi = tally._chunk_bounds(k)
+            _restore_partitioned_engine(
+                eng, x[lo:hi], elem[lo:hi],
+                flux if k == 0 else None, tally.dtype,
+            )
     tally.iter_count = int(z["iter_count"])
     tally.is_initialized = bool(z["is_initialized"])
+
+
+def _restore_partitioned_engine(eng, x, elem, flux, dtype) -> None:
+    """Rebuild one PartitionedEngine's slot layout from canonical
+    (caller-order) state: particle pid in slot pid, then one migration
+    distributes to owners. ``elem == -1`` marks lost particles (no
+    containing element) — they stay unlocated and excluded from
+    transport, never aliased onto a real element. ``flux`` (original
+    element order) may be None to leave this engine's owned flux zero."""
+    import jax.numpy as jnp
+
+    n = eng.n
+    glid_all = np.asarray(eng.part.glid_of_orig)
+    lost = elem < 0
+    glid = np.where(lost, -1, glid_all[np.clip(elem, 0, None)])
+    st = dict(eng.state)
+    pid = np.full(eng.cap, -1, np.int32)
+    pid[:n] = np.arange(n, dtype=np.int32)
+    alive = pid >= 0
+    xf = np.zeros((eng.cap, 3), np.float64)
+    xf[:n] = x
+    pend = np.full(eng.cap, -1, np.int32)
+    pend[:n] = glid
+    lostf = np.zeros(eng.cap, bool)
+    lostf[:n] = lost
+    st["x"] = jnp.asarray(xf, dtype=dtype)
+    st["pid"] = jnp.asarray(pid)
+    st["alive"] = jnp.asarray(alive)
+    st["pending"] = jnp.asarray(pend)
+    st["lelem"] = jnp.zeros((eng.cap,), jnp.int32)
+    st["done"] = jnp.asarray(~alive)
+    st["exited"] = jnp.zeros((eng.cap,), bool)
+    st["lost"] = jnp.asarray(lostf)
+    from pumiumtally_tpu.parallel.partition import migrate
+
+    eng.state, overflow = migrate(
+        part_L=eng.part.L, ndev=eng.ndev,
+        cap_per_chip=eng.cap_per_chip, state=st,
+    )
+    eng._check_overflow(overflow)
+    eng.state["done"] = jnp.ones((eng.cap,), bool)
+    eng.state["pending"] = jnp.full((eng.cap,), -1, jnp.int32)
+    eng._n_lost_dev = None
+    eng._n_lost_cache = int(lost.sum())
+    if flux is not None:
+        # Owned flux layout: original order -> padded glid slots.
+        fpad = np.zeros((eng.ndev * eng.part.L,), np.float64)
+        fpad[glid_all] = flux
+        eng.flux_padded = jnp.asarray(fpad, dtype=dtype)
+    else:
+        eng.flux_padded = jnp.zeros_like(eng.flux_padded)

@@ -58,7 +58,11 @@ def test_checkpoint_roundtrip(tmp_path):
 def test_checkpoint_cross_engine_roundtrip(tmp_path):
     """A checkpoint is canonical: save from one engine kind, resume in
     another, and the continued tally matches exactly."""
-    from pumiumtally_tpu import PartitionedPumiTally, StreamingTally
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        StreamingPartitionedTally,
+        StreamingTally,
+    )
     from pumiumtally_tpu.parallel import make_device_mesh
 
     n = 600
@@ -78,6 +82,12 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
         "part": PartitionedPumiTally(
             build_box(*mesh_args), n,
             TallyConfig(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+        ),
+        "stream_part": StreamingPartitionedTally(
+            build_box(*mesh_args), n, chunk_size=250,
+            config=TallyConfig(
+                device_mesh=make_device_mesh(4), capacity_factor=4.0
+            ),
         ),
     }
     dst2 = np.clip(dst - 0.15, _LO, _HI)
