@@ -60,6 +60,38 @@ def cmd_scale(args) -> None:
     print(f"wrote {args.output}: scaled by {args.factor}")
 
 
+def cmd_box(args) -> None:
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(args.lx, args.ly, args.lz,
+                              args.nx, args.ny, args.nz)
+    write_osh(args.output, coords, tets)
+    print(f"wrote {args.output}: {coords.shape[0]} vertices, "
+          f"{len(tets)} tets")
+
+
+def cmd_pincell(args) -> None:
+    """Generate the pincell benchmark geometry (BASELINE configs[0-1])
+    as an .osh directory — the reference obtains this via Gmsh +
+    msh2osh (reference README.md:115-125)."""
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.pincell import pincell_arrays
+
+    coords, tets, region = pincell_arrays(
+        pitch=args.pitch, fuel_radius=args.fuel_radius, height=args.height,
+        n_theta=args.n_theta, n_rings_fuel=args.rings_fuel,
+        n_rings_pad=args.rings_pad, nz=args.nz,
+    )
+    # Material classification rides along as the class_id element tag
+    # (the tag name Omega_h meshes carry for geometric classification).
+    write_osh(args.output, coords, tets,
+              elem_tags={"class_id": region.astype(np.int32)})
+    nf = int((region == 0).sum())
+    print(f"wrote {args.output}: {coords.shape[0]} vertices, "
+          f"{len(tets)} tets ({nf} fuel / {len(tets) - nf} moderator)")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         prog="pumiumtally",
@@ -81,6 +113,29 @@ def main(argv=None) -> None:
     c.add_argument("output")
     c.add_argument("factor", type=float)
     c.set_defaults(fn=cmd_scale)
+
+    c = sub.add_parser("box", help="generate a structured box tet mesh")
+    c.add_argument("output")
+    c.add_argument("--lx", type=float, default=1.0)
+    c.add_argument("--ly", type=float, default=1.0)
+    c.add_argument("--lz", type=float, default=1.0)
+    c.add_argument("--nx", type=int, default=10)
+    c.add_argument("--ny", type=int, default=10)
+    c.add_argument("--nz", type=int, default=10)
+    c.set_defaults(fn=cmd_box)
+
+    c = sub.add_parser(
+        "pincell", help="generate the pincell benchmark mesh (O-grid)"
+    )
+    c.add_argument("output")
+    c.add_argument("--pitch", type=float, default=1.26)
+    c.add_argument("--fuel-radius", type=float, default=0.4095)
+    c.add_argument("--height", type=float, default=1.0)
+    c.add_argument("--n-theta", type=int, default=16)
+    c.add_argument("--rings-fuel", type=int, default=3)
+    c.add_argument("--rings-pad", type=int, default=3)
+    c.add_argument("--nz", type=int, default=4)
+    c.set_defaults(fn=cmd_pincell)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -436,13 +436,16 @@ def write_osh(
     coords: np.ndarray,
     tet2vert: np.ndarray,
     nparts: int = 1,
+    elem_tags: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
     """Write an ``.osh`` directory in the Omega_h layout.
 
     ``nparts > 1`` splits elements into contiguous blocks with
     per-part ``global`` tags (each part stores copies of the vertices
     it touches), exercising the same multi-part structure Omega_h
-    writes for distributed meshes.
+    writes for distributed meshes. ``elem_tags`` are per-element
+    arrays written as dimension-3 tags (e.g. the ``class_id``
+    material classification Omega_h meshes carry).
     """
     coords = np.asarray(coords, np.float64)
     tet2vert = np.asarray(tet2vert, np.int32)
@@ -450,14 +453,25 @@ def write_osh(
         raise ValueError(f"coords must be [V,3], got {coords.shape}")
     if tet2vert.ndim != 2 or tet2vert.shape[1] != 4:
         raise ValueError(f"tet2vert must be [E,4], got {tet2vert.shape}")
+    for name, arr in (elem_tags or {}).items():
+        if np.asarray(arr).shape[0] != tet2vert.shape[0]:
+            raise ValueError(
+                f"element tag {name!r} has {np.asarray(arr).shape[0]} "
+                f"values for {tet2vert.shape[0]} tets"
+            )
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "nparts"), "w") as f:
         f.write(f"{nparts}\n")
     with open(os.path.join(path, "version"), "w") as f:
         f.write(f"{_WRITE_VERSION}\n")
     if nparts == 1:
+        extra: List[Dict[str, np.ndarray]] = [{}, {}, {}, {}]
+        if elem_tags:
+            extra[3].update(
+                {k: np.asarray(v) for k, v in elem_tags.items()}
+            )
         with open(os.path.join(path, "0.osh"), "wb") as f:
-            _write_stream(f, coords, tet2vert)
+            _write_stream(f, coords, tet2vert, extra_tags=extra)
         return
     ne = tet2vert.shape[0]
     bounds = np.linspace(0, ne, nparts + 1).astype(np.int64)
@@ -478,6 +492,11 @@ def write_osh(
         extra[3]["global"] = np.arange(
             bounds[rank], bounds[rank + 1], dtype=np.int64
         )
+        if elem_tags:
+            extra[3].update({
+                k: np.asarray(v)[bounds[rank]:bounds[rank + 1]]
+                for k, v in elem_tags.items()
+            })
         with open(os.path.join(path, f"{rank}.osh"), "wb") as f:
             _write_stream(
                 f, coords[vg],

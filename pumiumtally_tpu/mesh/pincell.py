@@ -60,6 +60,11 @@ def pincell_arrays(
         raise ValueError("n_theta must be a multiple of 8")
     if 2 * fuel_radius >= pitch:
         raise ValueError("fuel diameter must be smaller than the pitch")
+    if n_rings_fuel < 1 or n_rings_pad < 1 or nz < 1:
+        # Zero fuel rings mislabels the center fan, zero pad rings
+        # drops the moderator (mesh no longer fills the cell), zero
+        # layers is no mesh at all.
+        raise ValueError("n_rings_fuel, n_rings_pad, and nz must be >= 1")
     half = pitch / 2.0
     theta = np.arange(n_theta) * (2 * np.pi / n_theta)
 
@@ -97,7 +102,10 @@ def pincell_arrays(
     tris = np.asarray(tris, np.int64)
     tri_region = np.asarray(tri_region, np.int64)
 
-    # Extrude: layer l vertex = 2-D vertex + l*nv2.
+    # Extrude: layer l vertex = 2-D vertex + l*nv2. The cell sits in
+    # [0,pitch]^2 x [0,height] (corner origin — shared by every
+    # consumer; the O-grid itself is built pin-centered).
+    pts2 = pts2 + half
     zs = np.linspace(0.0, height, nz + 1)
     coords = np.concatenate(
         [
@@ -158,8 +166,4 @@ def build_pincell(
     coords, tets, region = pincell_arrays(
         pitch, fuel_radius, height, n_theta, n_rings_fuel, n_rings_pad, nz
     )
-    # Center the cell at the origin in x/y like an OpenMC pincell; shift
-    # so the box is [0,pitch]x[0,pitch]x[0,height] for walk convenience.
-    coords[:, 0] += pitch / 2.0
-    coords[:, 1] += pitch / 2.0
     return TetMesh.from_arrays(coords, tets, dtype=dtype), region

@@ -294,3 +294,33 @@ def test_vtk_binary_scales(tmp_path):
     write_vtk(b, coords, tets, cell_data={"flux": flux})
     raw = coords.size * 8 + ne * 5 * 4 + ne * 4 + ne * 8
     assert os.path.getsize(b) < raw + 4096  # headers only on top of raw
+
+
+def test_cli_box_and_pincell_generation(tmp_path, capsys):
+    from pumiumtally_tpu.cli import main
+    from pumiumtally_tpu.io.load import load_mesh
+
+    box = str(tmp_path / "box.osh")
+    main(["box", box, "--nx", "3", "--ny", "3", "--nz", "3"])
+    mesh = load_mesh(box)
+    assert mesh.nelems == 6 * 27
+    np.testing.assert_allclose(np.asarray(mesh.volumes).sum(), 1.0,
+                               atol=1e-12)
+
+    pin = str(tmp_path / "pin.osh")
+    main(["pincell", pin, "--n-theta", "8", "--nz", "2"])
+    out = capsys.readouterr().out
+    assert "fuel" in out and "moderator" in out
+    mesh = load_mesh(pin)
+    np.testing.assert_allclose(
+        np.asarray(mesh.volumes).sum(), 1.26**2, rtol=1e-12
+    )
+    # The material classification rides in the written stream as the
+    # class_id element tag.
+    from pumiumtally_tpu.io.osh import _read_stream
+
+    with open(pin + "/0.osh", "rb") as f:
+        parsed = _read_stream(f)
+    region = np.asarray(parsed["tags"][3]["class_id"])
+    assert set(np.unique(region)) == {0, 1}
+    assert region.shape[0] == mesh.nelems
