@@ -54,6 +54,7 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from pumiumtally_tpu.config import TallyConfig
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
@@ -165,22 +166,43 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_
     Unjitted and functional — the building block for the jitted
     single-chip path below, the sharded path in ``parallel.sharded``,
     and external drivers that want to fuse it into larger programs.
+
+    When every staged origin equals the committed position bit-for-bit
+    (the common physics case: no particle was resampled, and the host
+    echoes back the positions it was handed), phase A would walk zero
+    distance for every particle and change nothing — a device-side
+    check skips the whole pass, so the full reference protocol pays
+    only the staging, not a redundant batch sweep.
     """
     in_flight = flying
     is_flying = in_flight[:, None] == 1
     # Phase A: flying → walk to origin (no tally); stopped → hold.
     dest_a = jnp.where(is_flying, origins, x)
     zero_w = jnp.zeros_like(weights)  # reference zeroes weights, cpp:105
-    ra = walk(
-        mesh, x, elem, dest_a, in_flight, zero_w, flux,
-        tally=False, tol=tol, max_iters=max_iters,
-    )
+
+    def run_a(op):
+        x_, elem_, flux_ = op
+        ra = walk(
+            mesh, x_, elem_, dest_a, in_flight, zero_w, flux_,
+            tally=False, tol=tol, max_iters=max_iters,
+        )
+        return ra.x, ra.elem, ra.flux, jnp.all(ra.done)
+
+    trivial = jnp.all(dest_a == x)
+
+    def skip_a(op):
+        x_, elem_, flux_ = op
+        # `trivial` is True on this branch, and (being derived from the
+        # particle arrays) carries the right varying type when this
+        # runs inside shard_map — a literal True would not.
+        return x_, elem_, flux_, trivial
+    xa, ea, fa, ok_a = lax.cond(trivial, skip_a, run_a, (x, elem, flux))
     # Phase B is exactly the continue-mode move from the relocated state.
     x2, elem2, flux2, ok_b = move_step_continue(
-        mesh, ra.x, ra.elem, dests, flying, weights, ra.flux,
+        mesh, xa, ea, dests, flying, weights, fa,
         tol=tol, max_iters=max_iters,
     )
-    return x2, elem2, flux2, jnp.all(ra.done) & ok_b
+    return x2, elem2, flux2, ok_a & ok_b
 
 
 _move_step = partial(jax.jit, static_argnames=("tol", "max_iters"))(move_step)

@@ -431,6 +431,33 @@ def _write_stream(
 # Directory-level API
 # ---------------------------------------------------------------------------
 
+# Tag names the writer itself emits; a user tag shadowing one would
+# corrupt the multi-part merge or the coordinate read.
+_RESERVED_TAGS = ("global", "coordinates")
+
+
+def _normalize_tag(name: str, arr, nents: int) -> np.ndarray:
+    """Validate a user tag: reserved names rejected, dtype mapped onto
+    a stream-representable one with NO silent value change."""
+    if name in _RESERVED_TAGS:
+        raise ValueError(f"tag name {name!r} is reserved by the writer")
+    a = np.asarray(arr)
+    if a.shape[0] != nents:
+        raise ValueError(
+            f"element tag {name!r} has {a.shape[0]} values for "
+            f"{nents} entities"
+        )
+    if a.dtype in (np.float64, np.int64, np.int32, np.int8):
+        return a
+    if np.issubdtype(a.dtype, np.floating):
+        return a.astype(np.float64)  # widening: exact
+    if np.issubdtype(a.dtype, np.integer):
+        return a.astype(np.int64)  # widening: exact
+    raise ValueError(
+        f"element tag {name!r} has unsupported dtype {a.dtype}; use a "
+        "float or integer array"
+    )
+
 def write_osh(
     path: str,
     coords: np.ndarray,
@@ -453,12 +480,10 @@ def write_osh(
         raise ValueError(f"coords must be [V,3], got {coords.shape}")
     if tet2vert.ndim != 2 or tet2vert.shape[1] != 4:
         raise ValueError(f"tet2vert must be [E,4], got {tet2vert.shape}")
-    for name, arr in (elem_tags or {}).items():
-        if np.asarray(arr).shape[0] != tet2vert.shape[0]:
-            raise ValueError(
-                f"element tag {name!r} has {np.asarray(arr).shape[0]} "
-                f"values for {tet2vert.shape[0]} tets"
-            )
+    elem_tags = {
+        name: _normalize_tag(name, arr, tet2vert.shape[0])
+        for name, arr in (elem_tags or {}).items()
+    }
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "nparts"), "w") as f:
         f.write(f"{nparts}\n")

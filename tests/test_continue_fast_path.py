@@ -72,3 +72,32 @@ def test_continue_holds_nonflying_particles():
     t.MoveToNextLocation(None, dest.reshape(-1).copy(), fly, np.ones(N))
     np.testing.assert_allclose(t.positions, pos0, atol=1e-14)
     np.testing.assert_allclose(np.asarray(t.flux), 0.0, atol=1e-14)
+
+
+def test_two_phase_with_echoed_origins_matches_continue_bitwise():
+    """When the host echoes committed positions back as origins (no
+    resampling), the full two-phase protocol must produce bit-identical
+    results to the continue-mode fast path: the device-side trivial
+    check skips phase A entirely."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 1500
+    rng = np.random.default_rng(6)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dest = rng.uniform(0.0, 1.0, (n, 3))
+
+    results = []
+    for mode in ("two_phase", "continue"):
+        t = PumiTally(mesh, n, TallyConfig())
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        if mode == "two_phase":
+            pos = t.positions.astype(np.float64)
+            t.MoveToNextLocation(pos.reshape(-1).copy(),
+                                 dest.reshape(-1).copy(),
+                                 np.ones(n, np.int8), np.ones(n))
+        else:
+            t.MoveToNextLocation(None, dest.reshape(-1).copy(),
+                                 np.ones(n, np.int8), np.ones(n))
+        results.append((np.asarray(t.flux), t.positions, t.elem_ids))
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_array_equal(results[0][1], results[1][1])
+    np.testing.assert_array_equal(results[0][2], results[1][2])

@@ -324,3 +324,26 @@ def test_cli_box_and_pincell_generation(tmp_path, capsys):
     region = np.asarray(parsed["tags"][3]["class_id"])
     assert set(np.unique(region)) == {0, 1}
     assert region.shape[0] == mesh.nelems
+
+
+def test_osh_elem_tag_validation(tmp_path):
+    from pumiumtally_tpu.io.osh import _read_stream, write_osh
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    ne = len(tets)
+    with pytest.raises(ValueError, match="reserved"):
+        write_osh(str(tmp_path / "r.osh"), coords, tets,
+                  elem_tags={"global": np.arange(ne)})
+    # float32/int16 widen exactly instead of silently casting to int32
+    p = str(tmp_path / "t.osh")
+    write_osh(p, coords, tets, elem_tags={
+        "density": np.linspace(0.1, 0.7, ne).astype(np.float32),
+        "mat": np.arange(ne, dtype=np.int16),
+    })
+    with open(p + "/0.osh", "rb") as f:
+        tags = _read_stream(f)["tags"][3]
+    np.testing.assert_allclose(
+        tags["density"], np.linspace(0.1, 0.7, ne).astype(np.float32),
+        rtol=1e-7,
+    )
+    np.testing.assert_array_equal(tags["mat"], np.arange(ne))
