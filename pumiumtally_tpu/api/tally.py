@@ -181,25 +181,28 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_
     zero_w = jnp.zeros_like(weights)  # reference zeroes weights, cpp:105
 
     def run_a(op):
-        x_, elem_, flux_ = op
+        x_, elem_ = op
+        # A tally=False walk never touches flux — pass a dummy so the
+        # [E]-sized array need not ride through the cond.
         ra = walk(
-            mesh, x_, elem_, dest_a, in_flight, zero_w, flux_,
+            mesh, x_, elem_, dest_a, in_flight, zero_w,
+            jnp.zeros((0,), x_.dtype),
             tally=False, tol=tol, max_iters=max_iters,
         )
-        return ra.x, ra.elem, ra.flux, jnp.all(ra.done)
+        return ra.x, ra.elem, jnp.all(ra.done)
 
     trivial = jnp.all(dest_a == x)
 
     def skip_a(op):
-        x_, elem_, flux_ = op
+        x_, elem_ = op
         # `trivial` is True on this branch, and (being derived from the
         # particle arrays) carries the right varying type when this
         # runs inside shard_map — a literal True would not.
-        return x_, elem_, flux_, trivial
-    xa, ea, fa, ok_a = lax.cond(trivial, skip_a, run_a, (x, elem, flux))
+        return x_, elem_, trivial
+    xa, ea, ok_a = lax.cond(trivial, skip_a, run_a, (x, elem))
     # Phase B is exactly the continue-mode move from the relocated state.
     x2, elem2, flux2, ok_b = move_step_continue(
-        mesh, xa, ea, dests, flying, weights, fa,
+        mesh, xa, ea, dests, flying, weights, flux,
         tol=tol, max_iters=max_iters,
     )
     return x2, elem2, flux2, ok_a & ok_b

@@ -449,13 +449,31 @@ def _normalize_tag(name: str, arr, nents: int) -> np.ndarray:
         )
     if a.dtype in (np.float64, np.int64, np.int32, np.int8):
         return a
+    if a.dtype == np.bool_:
+        return a.astype(np.int8)  # 0/1: exact
     if np.issubdtype(a.dtype, np.floating):
-        return a.astype(np.float64)  # widening: exact
+        widened = a.astype(np.float64)
+        # f16/f32 → f64 is exact; longdouble → f64 may round.
+        if a.dtype.itemsize > 8 and not np.array_equal(
+            widened.astype(a.dtype), a
+        ):
+            raise ValueError(
+                f"element tag {name!r} ({a.dtype}) does not fit float64 "
+                "exactly; cast it yourself if the rounding is acceptable"
+            )
+        return widened
+    if np.issubdtype(a.dtype, np.unsignedinteger):
+        if a.dtype.itemsize == 8 and a.size and a.max() > np.iinfo(np.int64).max:
+            raise ValueError(
+                f"element tag {name!r} has uint64 values beyond int64 "
+                "range; the .osh stream has no unsigned 64-bit type"
+            )
+        return a.astype(np.int64)  # in-range: exact
     if np.issubdtype(a.dtype, np.integer):
         return a.astype(np.int64)  # widening: exact
     raise ValueError(
         f"element tag {name!r} has unsupported dtype {a.dtype}; use a "
-        "float or integer array"
+        "float, integer or bool array"
     )
 
 def write_osh(

@@ -116,7 +116,7 @@ def walk(
     max_iters: int,
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
-    cond_every: int = 1,
+    cond_every: int = 4,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -131,31 +131,45 @@ def walk(
     active mask, so extra unrolled iterations change no result, only
     waste at most ``cond_every − 1`` window passes per stage exit (and
     the iteration budget may overshoot by the same amount before the
-    "not found" warning fires).
+    "not found" warning fires). Default 4: measured best on v5e
+    (docs/PERF_NOTES.md round-2 sweep).
+
+    The loop carry is deliberately minimal — it is also the payload the
+    compaction cascade must permute at every stage boundary (measured
+    a major cascade cost, docs/PERF_NOTES.md): the in-flight flag,
+    weight and segment length fold into ONE premultiplied tally weight
+    ``eff_w = flying·weight·‖d0‖`` (the only place any of them is read),
+    the start position is re-derived from ``dest − d0``, and the exited
+    mask is recovered post-loop as ``done & (s < 1)`` (a boundary exit
+    always strictly precedes the destination; reaching it exactly
+    commits ``s = 1``).
     """
     fdtype = x.dtype
     n_total = x.shape[0]
     one = jnp.asarray(1.0, fdtype)
-    # All-False initial done/exited masks, derived from an input so they
-    # carry the same sharding/varying-axis type as the particle arrays
-    # when this runs inside shard_map (a literal zeros() constant would
-    # be "unvarying" and break the while_loop carry typing).
-    active0 = in_flight != in_flight
-    flying = in_flight.astype(bool)
-    x0 = x
-    d0 = dest - x0  # the whole walk's segment; s parametrizes along it
+    # All-False initial done mask, derived from an input so it carries
+    # the same sharding/varying-axis type as the particle arrays when
+    # this runs inside shard_map (a literal zeros() constant would be
+    # "unvarying" and break the while_loop carry typing).
+    done0 = in_flight != in_flight
+    d0 = dest - x  # the whole walk's segment; s parametrizes along it
     seg_len = jnp.linalg.norm(d0, axis=1)  # computed once, not per iter
     s0 = jnp.zeros_like(seg_len)
+    # flying/weight/seg_len enter the loop only through the tally
+    # contribution — premultiply once (f64 parity: associativity-only
+    # change, ~1 ulp).
+    eff_w = jnp.where(in_flight.astype(bool), weight * seg_len, 0.0)
 
     def body(state):
         """One lock-step iteration over a (possibly windowed) batch."""
-        it, s, elem, x0, d0, seg_len, flying, weight, done, exited, flux = state
+        it, s, elem, dest, d0, eff_w, done, flux = state
         active = ~done
         fn, fo, adj = _gather_walk_row(mesh, elem)
-        # Both ray projections are against walk-constant vectors.
-        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, x0], axis=-1))
+        # Both ray projections are against walk-constant vectors
+        # (x0 = dest − d0, so off − n·x0 = off − n·dest + n·d0).
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
         a = both[..., 0]  # n·d0
-        b = fo - both[..., 1]  # off − n·x0
+        b = fo - both[..., 1] + a  # off − n·x0
         # Crossing predicate on the REMAINING segment (n·d_rem > tol),
         # matching the reference's per-step test exactly.
         crossing = a * (one - s)[:, None] > tol
@@ -173,17 +187,14 @@ def walk(
         hit_boundary = (~reached) & (next_elem == -1)
 
         if tally:
-            contrib = jnp.where(
-                active & flying, (s_new - s) * seg_len * weight, 0.0
-            )
+            contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
             flux = flux.at[elem].add(contrib, mode="drop")
 
         advance = active & ~reached & ~hit_boundary
         elem = jnp.where(advance, next_elem, elem)
         s = jnp.where(active, s_new, s)
         done = done | reached | hit_boundary
-        exited = exited | (active & hit_boundary)
-        return it + 1, s, elem, x0, d0, seg_len, flying, weight, done, exited, flux
+        return it + 1, s, elem, dest, d0, eff_w, done, flux
 
     it0 = jnp.asarray(0, jnp.int32)
 
@@ -196,30 +207,30 @@ def walk(
                 state = body_1(state)
             return state
 
-    def final_x(s, done, exited):
+    def final_x(s, done, exited, dest, d0):
         """Materialize positions from the ray coordinate — exactly once.
         Particles that reached their destination commit ``dest``
         bit-exactly (the continue-mode contract: next move's origins
         equal the committed positions); boundary leavers commit the
-        clamped intersection point."""
+        clamped intersection point ``x0 + s·d0 = dest + (s−1)·d0``."""
         return jnp.where(
-            (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
+            (done & ~exited)[:, None], dest, dest + (s - one)[:, None] * d0
         )
 
     min_window = max(1, min_window)
     if not compact or n_total <= min_window:
         def cond(state):
             it = state[0]
-            done = state[-3]
+            done = state[-2]
             return (it < max_iters) & jnp.any(~done)
 
-        it, s, elem, _, _, _, _, _, done, exited, flux = lax.while_loop(
+        it, s, elem, _, _, _, done, flux = lax.while_loop(
             cond, body,
-            (it0, s0, elem, x0, d0, seg_len, flying, weight, active0,
-             active0, flux),
+            (it0, s0, elem, dest, d0, eff_w, done0, flux),
         )
+        exited = done & (s < one)
         return WalkResult(
-            x=final_x(s, done, exited), elem=elem, done=done,
+            x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
             exited=exited, flux=flux, iters=it,
         )
 
@@ -234,26 +245,24 @@ def walk(
     idx = jnp.cumsum(jnp.ones_like(elem)) - 1  # iota, varying under shard_map
 
     s = s0
-    done = active0
-    exited = active0
+    done = done0
     it = it0
     for si, w in enumerate(windows):
         nxt = windows[si + 1] if si + 1 < len(windows) else 0
 
-        def cond(state, _w=w, _nxt=nxt):
+        def cond(state, _nxt=nxt):
             it = state[0]
-            done = state[-3]
+            done = state[-2]
             n_active = jnp.sum(~done)
             return (it < max_iters) & (n_active > _nxt)
 
         head = lambda a: a[:w]  # noqa: E731 — static-size window slice
-        it, sh, eh, _, _, _, _, _, dh, exh, flux = lax.while_loop(
+        it, sh, eh, _, _, _, dh, flux = lax.while_loop(
             cond,
             body,
             (
-                it, head(s), head(elem), head(x0), head(d0),
-                head(seg_len), head(flying), head(weight), head(done),
-                head(exited), flux,
+                it, head(s), head(elem), head(dest), head(d0),
+                head(eff_w), head(done), flux,
             ),
         )
         # NOTE: these window write-backs deliberately use concatenate,
@@ -262,39 +271,33 @@ def walk(
         # reading the same buffer (observed on the CPU backend,
         # jax 0.8.x — duplicated/missing rows). Concatenate forces a
         # fresh result buffer and costs the same copy.
-        tail = lambda a, h: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
-        s = tail(s, sh)
-        elem = tail(elem, eh)
-        done = tail(done, dh)
-        exited = tail(exited, exh)
-
         if nxt:
             # Stable sort on (done, current element): survivors move to
-            # the front AND are grouped by element, so the next stage's
-            # walk-table gathers and flux scatters hit near-contiguous
-            # rows (row-granularity HBM DMA is the measured per-
-            # iteration floor, docs/PERF_NOTES.md) — deterministic, and
-            # the sort was already being paid for the compaction.
-            # Only rows [:w] can be active, so sorting the window alone
-            # suffices and the sort shrinks with the cascade.
+            # the front AND are grouped by element — deterministic, and
+            # the sort is the price of the compaction itself. Only rows
+            # [:w] can be active, so sorting the window alone suffices
+            # and the sort shrinks with the cascade. The write-back and
+            # the permutation fuse into ONE concatenate per array.
             key = jnp.where(dh, jnp.iinfo(jnp.int32).max, eh)
             perm = jnp.argsort(key, stable=True)
-            upd = lambda a: jnp.concatenate([a[:w][perm], a[w:]], axis=0)  # noqa: E731
-            s = upd(s)
-            elem = upd(elem)
-            x0 = upd(x0)
-            d0 = upd(d0)
-            seg_len = upd(seg_len)
-            dest = upd(dest)
-            flying = upd(flying)
-            weight = upd(weight)
-            done = upd(done)
-            exited = upd(exited)
-            idx = upd(idx)
+            upd = lambda a, h: jnp.concatenate([h[perm], a[w:]], axis=0)  # noqa: E731
+            s = upd(s, sh)
+            elem = upd(elem, eh)
+            done = upd(done, dh)
+            dest = upd(dest, dest[:w])
+            d0 = upd(d0, d0[:w])
+            eff_w = upd(eff_w, eff_w[:w])
+            idx = upd(idx, idx[:w])
+        else:
+            tail = lambda a, h: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
+            s = tail(s, sh)
+            elem = tail(elem, eh)
+            done = tail(done, dh)
 
     # Undo the accumulated permutation: row i holds original slot idx[i].
     inv = jnp.argsort(idx, stable=True)
-    x_fin = final_x(s, done, exited)
+    exited = done & (s < one)
+    x_fin = final_x(s, done, exited, dest, d0)
     return WalkResult(
         x=x_fin[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
         flux=flux, iters=it,
