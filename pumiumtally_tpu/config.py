@@ -52,12 +52,27 @@ class TallyConfig:
         PumiTallyImpl.cpp:455-458).
       output_filename: default VTK output path (reference hard-codes
         "fluxresult.vtk", PumiTallyImpl.cpp:153).
+      auto_continue: if True (default), ``MoveToNextLocation`` detects
+        on the host when the staged origins echo the previous move's
+        destinations bit-for-bit AND the engine proved the committed
+        positions equal those destinations — then the origin upload and
+        phase A are skipped entirely (the continue fast path), which is
+        bit-exact equivalent: phase A would relocate every particle a
+        zero distance. This turns the reference's full per-step
+        protocol (origins staged every call, PumiTallyImpl.cpp:66-149)
+        into continue-path speed whenever no particle was resampled,
+        stopped, or absorbed at the boundary since the last move.
+        Applies to the monolithic and sharded engines;
+        ``PartitionedPumiTally`` keeps its state in partition slot
+        order and never produces the device-side proof, so the knob is
+        inert there (every call runs the full protocol).
     """
 
     tolerance: Optional[float] = None
     max_iters: Optional[int] = None
     dtype: Any = None
     check_found_all: bool = True
+    auto_continue: bool = True
     # NOTE: the reference's migration cadence (``iter_count % 100``,
     # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU
     # partitioned engine migrates a particle exactly when it pauses at a

@@ -455,7 +455,7 @@ def _normalize_tag(name: str, arr, nents: int) -> np.ndarray:
         widened = a.astype(np.float64)
         # f16/f32 → f64 is exact; longdouble → f64 may round.
         if a.dtype.itemsize > 8 and not np.array_equal(
-            widened.astype(a.dtype), a
+            widened.astype(a.dtype), a, equal_nan=True
         ):
             raise ValueError(
                 f"element tag {name!r} ({a.dtype}) does not fit float64 "

@@ -83,6 +83,8 @@ def sharded_localize_step(
     )
     def step(mesh_, x_, elem_, dest_):
         n = x_.shape[0]
+        # A tally=False walk never touches flux — zero-size dummy
+        # (carry-type consistent: it never mixes with varying values).
         r = walk(
             mesh_,
             x_,
@@ -90,7 +92,7 @@ def sharded_localize_step(
             dest_,
             _pvary(jnp.ones((n,), jnp.int8), ax),
             _pvary(jnp.zeros((n,), x_.dtype), ax),
-            _pvary(jnp.zeros((mesh_.volumes.shape[0],), x_.dtype), ax),
+            jnp.zeros((0,), x_.dtype),
             tally=False,
             tol=tol,
             max_iters=max_iters,

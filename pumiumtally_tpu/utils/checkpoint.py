@@ -94,6 +94,12 @@ def load_tally_state(tally, path: str) -> None:
     """
     import jax.numpy as jnp
 
+    # Restoring rewrites committed positions out from under the
+    # auto-continue echo check — invalidate its bookkeeping.
+    if hasattr(tally, "_committed_eq"):
+        tally._last_dests_host = None
+        tally._committed_eq = None
+
     kind = _engine_kind(tally)
     with np.load(path) as z:
         _check_header(z, tally)

@@ -101,3 +101,123 @@ def test_two_phase_with_echoed_origins_matches_continue_bitwise():
     np.testing.assert_array_equal(results[0][0], results[1][0])
     np.testing.assert_array_equal(results[0][1], results[1][1])
     np.testing.assert_array_equal(results[0][2], results[1][2])
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_auto_continue_fires_on_echo_and_matches_disabled(sharded):
+    """Host-side auto-continue (TallyConfig.auto_continue): echoing the
+    previous destinations as origins skips the origin upload, with
+    results bit-identical to the optimization turned off."""
+    dm = make_device_mesh(8) if sharded else None
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    rng = np.random.default_rng(11)
+    src = rng.uniform(0.05, 0.95, (N, 3))
+    d1 = rng.uniform(0.05, 0.95, (N, 3))
+    d2 = rng.uniform(0.05, 0.95, (N, 3))
+
+    out = []
+    for auto in (True, False):
+        t = PumiTally(mesh, N, TallyConfig(device_mesh=dm, auto_continue=auto))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(N, np.int8), np.ones(N))
+        # echo: origins == previous destinations (the physics-host case)
+        t.MoveToNextLocation(d1.reshape(-1).copy(), d2.reshape(-1).copy(),
+                             np.ones(N, np.int8), np.ones(N))
+        out.append((np.asarray(t.flux), t.positions, t.elem_ids,
+                    t.auto_continue_hits))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_array_equal(out[0][2], out[1][2])
+    assert out[0][3] == 1  # move 2 skipped the upload
+    assert out[1][3] == 0
+
+
+def test_auto_continue_declines_after_boundary_exit():
+    """A particle clamped at the hull makes committed != dests — the
+    device proof must refuse the skip, and the full protocol's phase A
+    (walk from the clamp point toward the echoed outside origin) must
+    run. Results must match auto_continue=False exactly."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 500
+    rng = np.random.default_rng(12)
+    src = rng.uniform(0.3, 0.7, (n, 3))
+    d1 = src + np.array([2.0, 0.0, 0.0])  # everyone exits +x
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+
+    out = []
+    for auto in (True, False):
+        t = PumiTally(mesh, n, TallyConfig(auto_continue=auto))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        t.MoveToNextLocation(d1.reshape(-1).copy(), d2.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t.flux), t.positions, t.auto_continue_hits))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    assert out[0][2] == 0  # exit clamp must veto the skip
+    assert out[1][2] == 0
+
+
+def test_auto_continue_declines_on_resample_and_nonflying():
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 400
+    rng = np.random.default_rng(13)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+
+    # resampled origins differ from the previous dests -> host veto
+    t = PumiTally(mesh, n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    resampled = rng.uniform(0.05, 0.95, (n, 3))
+    t.MoveToNextLocation(resampled.reshape(-1).copy(),
+                         np.clip(resampled + 0.1, 0, 1).reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    assert t.auto_continue_hits == 0
+
+    # a held (non-flying) particle keeps its old position -> device veto
+    t2 = PumiTally(mesh, n)
+    t2.CopyInitialPosition(src.reshape(-1).copy())
+    fly = np.ones(n, np.int8)
+    fly[0] = 0
+    t2.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                          fly.copy(), np.ones(n))
+    t2.MoveToNextLocation(d1.reshape(-1).copy(),
+                          np.clip(d1 + 0.1, 0, 1).reshape(-1).copy(),
+                          np.ones(n, np.int8), np.ones(n))
+    assert t2.auto_continue_hits == 0
+
+
+def test_auto_continue_not_fooled_by_recycled_caller_buffer():
+    """In f64 mode staging returns VIEWS of the caller's buffer; a host
+    app that reuses its destination buffer to hold the next move's
+    resampled origins must not trick the echo check into comparing the
+    caller's memory against itself."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 300
+    rng = np.random.default_rng(14)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    buf = np.empty(3 * n)  # the recycled host buffer
+
+    t = PumiTally(mesh, n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    buf[:] = rng.uniform(0.05, 0.95, 3 * n)  # move-1 destinations
+    d1 = buf.reshape(n, 3).copy()
+    t.MoveToNextLocation(src.reshape(-1).copy(), buf, np.ones(n, np.int8),
+                         np.ones(n))
+    # Recycle: same buffer now holds RESAMPLED origins.
+    resampled = rng.uniform(0.05, 0.95, (n, 3))
+    buf[:] = resampled.reshape(-1)
+    d2 = np.clip(resampled + 0.1, 0.02, 0.98)
+    t.MoveToNextLocation(buf, d2.reshape(-1).copy(), np.ones(n, np.int8),
+                         np.ones(n))
+    assert t.auto_continue_hits == 0  # resample must NOT be skipped
+    # flux must include the phase-A-relocated leg, i.e. move 2 tallies
+    # |d2 - resampled|, not |d2 - d1|.
+    want = float(np.linalg.norm(d1 - src, axis=1).sum()
+                 + np.linalg.norm(d2 - resampled, axis=1).sum())
+    got = float(np.sum(np.asarray(t.flux)))
+    assert abs(got - want) / want < 1e-12
