@@ -128,6 +128,7 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
     cfg = TallyConfig(
         check_found_all=False,
         auto_continue=(mode != "two_phase_forced"),
+        fenced_timing=False,  # let moves pipeline; timed_moves syncs at the end
     )
     t = PumiTally(mesh, n, cfg)
     rng = np.random.default_rng(0)
@@ -162,7 +163,7 @@ def run_pincell(n: int, moves: int) -> dict:
         pitch=pitch, height=height, n_theta=32, n_rings_fuel=5,
         n_rings_pad=5, nz=12,
     )
-    t = PumiTally(mesh, n, TallyConfig(check_found_all=False))
+    t = PumiTally(mesh, n, TallyConfig(check_found_all=False, fenced_timing=False))
     rng = np.random.default_rng(1)
     pts = make_trajectory(rng, n, moves + 1, box=[pitch, pitch, height])
     t.CopyInitialPosition(pts[0].reshape(-1).copy())

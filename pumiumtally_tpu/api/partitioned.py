@@ -64,10 +64,10 @@ class PartitionedPumiTally(PumiTally):
         return self.engine.localize(dest)  # (found_all, n_exited)
 
     def _dispatch_move(self, origins, dests, fly, w):
-        # Never sets _committed_eq: particle state lives in partition
-        # slot order, so the base class's committed==dests proof has no
-        # cheap equivalent here — auto_continue stays inert (see
-        # TallyConfig.auto_continue).
+        # auto_continue applies here too: when the base class detects an
+        # origin echo it hands back the device array that staged last
+        # move's destinations (caller order), which this engine treats
+        # exactly like freshly uploaded origins.
         return self.engine.move(origins, dests, fly, w)
 
     # -- state views (caller-visible order) -------------------------------

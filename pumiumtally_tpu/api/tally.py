@@ -212,7 +212,6 @@ _move_step = partial(jax.jit, static_argnames=("tol", "max_iters"))(move_step)
 _move_step_continue = partial(
     jax.jit, static_argnames=("tol", "max_iters")
 )(move_step_continue)
-_arrays_equal = jax.jit(lambda a, b: jnp.array_equal(a, b))
 
 
 class PumiTally:
@@ -283,24 +282,40 @@ class PumiTally:
         self.is_initialized = False
         self.tally_times = TallyTimes()
         # Auto-continue bookkeeping: the working-dtype destinations of
-        # the previous move (host copy) and a lazily-fetched device
-        # scalar proving the committed positions equal them. Both reset
-        # whenever something other than a move changes particle state.
+        # the previous move, kept BOTH as an owned host array (for the
+        # echo compare) and as the device array already staged for that
+        # move (substituted for the caller's origins on an echo — no
+        # upload, no sync, and phase A still runs on device whenever it
+        # must, e.g. after a boundary clamp). Reset whenever something
+        # other than a move changes particle state.
         self._last_dests_host: Optional[np.ndarray] = None
-        self._committed_eq = None
-        self.auto_continue_hits = 0  # diagnostic: moves that skipped phase A on the host
+        self._last_dests_dev = None
+        self.auto_continue_hits = 0  # diagnostic: moves that skipped the origin upload
         return mesh
 
     # -- staging helpers -------------------------------------------------
-    def _as_positions_host(self, buf, size: Optional[int]) -> np.ndarray:
+    def _as_positions_cast(self, buf, size: Optional[int]) -> np.ndarray:
+        """[n,3] working-dtype host array; MAY be a view of the
+        caller's buffer (f64 working dtype). Cast on the host with
+        numpy BEFORE handing to jax: letting jnp.asarray do the
+        f64→f32 conversion goes through a slow backend path (measured
+        ~100× slower than a numpy pre-cast + plain transfer)."""
         a = host_positions(buf, size, self.num_particles)
-        # Cast on the host with numpy BEFORE handing to jax: letting
-        # jnp.asarray do the f64→f32 conversion goes through a slow
-        # backend path (measured ~100× slower than a numpy pre-cast
-        # followed by a plain transfer).
         return np.asarray(
             a.reshape(self.num_particles, 3), dtype=np.dtype(self.dtype)
         )
+
+    @staticmethod
+    def _owned(h: np.ndarray) -> np.ndarray:
+        """Materialize an OWNED copy unless ``h`` already owns its
+        memory. Anything staged to the device or kept across calls must
+        be owned: the CPU backend's jnp.asarray can be zero-copy, and
+        the auto-continue bookkeeping outlives the call — a view of a
+        recycled caller buffer would corrupt both."""
+        return h if (h.base is None and h.flags.owndata) else h.copy()
+
+    def _as_positions_host(self, buf, size: Optional[int]) -> np.ndarray:
+        return self._owned(self._as_positions_cast(buf, size))
 
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
         return jnp.asarray(self._as_positions_host(buf, size))
@@ -318,7 +333,7 @@ class PumiTally:
         PumiTallyImpl.cpp:54-64)."""
         t0 = time.perf_counter()
         self._last_dests_host = None  # localization rewrites the state
-        self._committed_eq = None
+        self._last_dests_dev = None
         dest = self._as_positions(init_particle_positions, size)
         found_all, n_exited = self._dispatch_localize(dest)
         if self.config.check_found_all:
@@ -339,7 +354,8 @@ class PumiTally:
                     "the boundary"
                 )
         self.is_initialized = True
-        jax.block_until_ready(self.x)
+        if self.config.fenced_timing:
+            jax.block_until_ready(self.x)
         self.tally_times.initialization_time += time.perf_counter() - t0
 
     def _dispatch_localize(self, dest: jnp.ndarray):
@@ -388,29 +404,35 @@ class PumiTally:
                 "(reference invariant, PumiTallyImpl.cpp:437-438)"
             )
         t0 = time.perf_counter()
-        origins_host = (
+        # The cast view is enough for the echo compare; the owned copy
+        # is only materialized on the miss path (where the array is
+        # actually uploaded), so an echo hit pays no [n,3] memcpy.
+        origins_cast = (
             None
             if particle_origin is None
-            else self._as_positions_host(particle_origin, size)
+            else self._as_positions_cast(particle_origin, size)
         )
         dests_host = self._as_positions_host(particle_destinations, size)
+        origins: Optional[jnp.ndarray]
         if (
-            origins_host is not None
+            origins_cast is not None
             and self.config.auto_continue
             and self._last_dests_host is not None
-            and self._committed_eq is not None
-            and np.array_equal(origins_host, self._last_dests_host)
-            and bool(self._committed_eq)
+            and np.array_equal(origins_cast, self._last_dests_host)
         ):
             # The staged origins echo the previous destinations in the
-            # working dtype, and the device proved the committed
-            # positions equal those destinations — phase A would move
-            # every particle zero distance, so skip the origin upload
-            # and take the continue path (bit-exact equivalent; see
-            # TallyConfig.auto_continue).
-            origins_host = None
+            # working dtype — substitute the device array that staged
+            # them last move instead of uploading the same bytes again.
+            # Bit-exact: phase A still runs on device (against values
+            # identical to the caller's origins), and the device-side
+            # trivial check skips its walk whenever every particle
+            # committed its destination. See TallyConfig.auto_continue.
+            origins = self._last_dests_dev
             self.auto_continue_hits += 1
-        origins = None if origins_host is None else jnp.asarray(origins_host)
+        elif origins_cast is None:
+            origins = None
+        else:
+            origins = jnp.asarray(self._owned(origins_cast))
         dests = jnp.asarray(dests_host)
         n = self.num_particles
         if flying is None:
@@ -443,15 +465,15 @@ class PumiTally:
         zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)
-        # Snapshot (copy!): in f64 mode _as_positions_host returns a
-        # VIEW of the caller's buffer, and a host app may recycle that
-        # buffer for the next call's resampled origins — comparing the
-        # caller's memory against itself would falsely echo.
-        self._last_dests_host = np.array(dests_host, copy=True)
+        # _as_positions_host returned OWNED memory, so these snapshots
+        # cannot alias a caller buffer that gets recycled next call.
+        self._last_dests_host = dests_host
+        self._last_dests_dev = dests
         self.iter_count += 1
         if self.config.check_found_all and not bool(found_all):
             print("ERROR: Not all particles are found. May need more loops in search")
-        jax.block_until_ready(self.flux)
+        if self.config.fenced_timing:
+            jax.block_until_ready(self.flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
 
     def _dispatch_move(self, origins, dests, fly, w):
@@ -489,12 +511,6 @@ class PumiTally:
         self.x, self.elem, self.flux, found_all = step(
             fly, w, self.flux, tol=self._tol, max_iters=self._max_iters
         )
-        if self.config.auto_continue:
-            # Prove (on device, async) that every committed position —
-            # padded slots included — equals the staged destination;
-            # consumed by the next call's echo check. Exited (clamped)
-            # or held particles make it False.
-            self._committed_eq = _arrays_equal(self.x, dests)
         return found_all
 
     def WriteTallyResults(self, filename: Optional[str] = None) -> None:

@@ -54,18 +54,31 @@ class TallyConfig:
         "fluxresult.vtk", PumiTallyImpl.cpp:153).
       auto_continue: if True (default), ``MoveToNextLocation`` detects
         on the host when the staged origins echo the previous move's
-        destinations bit-for-bit AND the engine proved the committed
-        positions equal those destinations — then the origin upload and
-        phase A are skipped entirely (the continue fast path), which is
-        bit-exact equivalent: phase A would relocate every particle a
-        zero distance. This turns the reference's full per-step
-        protocol (origins staged every call, PumiTallyImpl.cpp:66-149)
-        into continue-path speed whenever no particle was resampled,
-        stopped, or absorbed at the boundary since the last move.
-        Applies to the monolithic and sharded engines;
-        ``PartitionedPumiTally`` keeps its state in partition slot
-        order and never produces the device-side proof, so the knob is
-        inert there (every call runs the full protocol).
+        destinations bit-for-bit in the working dtype — the physics
+        host's common case (no resampling since the last move; the
+        reference's protocol echoes committed positions back as
+        origins, PumiTallyImpl.cpp:66-149) — and substitutes the
+        device array that staged those destinations instead of
+        uploading the identical bytes again. Bit-exact: phase A still
+        executes on device against values equal to the caller's
+        origins (and its walk is skipped by the device-side trivial
+        check when every particle committed its destination). Saves
+        one [N,3] host→device transfer per echoing move, with no added
+        synchronization. Applies to the monolithic, sharded and
+        partitioned facades; the streaming facades stage chunk-wise
+        through their own ``MoveToNextLocation`` and ignore this knob.
+      fenced_timing: if True (default), each API call blocks until its
+        device work finishes so ``TallyTimes`` measures real per-phase
+        wall time (the fence the reference intended via
+        ``Kokkos::fence``, SURVEY.md §5). Set False to let moves
+        PIPELINE: calls return after dispatch, the next move's host
+        staging overlaps the previous move's device compute, and
+        ``TallyTimes`` attributes only dispatch time (a final
+        result/flux read still synchronizes everything). Pipelining
+        additionally needs ``check_found_all=False`` — the convergence
+        warning reads a device scalar back every call, which is itself
+        a sync. The streaming facades ignore this knob (their overlap
+        comes from chunk-wise double buffering; they always fence).
     """
 
     tolerance: Optional[float] = None
@@ -73,6 +86,7 @@ class TallyConfig:
     dtype: Any = None
     check_found_all: bool = True
     auto_continue: bool = True
+    fenced_timing: bool = True
     # NOTE: the reference's migration cadence (``iter_count % 100``,
     # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU
     # partitioned engine migrates a particle exactly when it pauses at a

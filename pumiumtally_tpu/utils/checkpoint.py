@@ -96,9 +96,9 @@ def load_tally_state(tally, path: str) -> None:
 
     # Restoring rewrites committed positions out from under the
     # auto-continue echo check — invalidate its bookkeeping.
-    if hasattr(tally, "_committed_eq"):
+    if hasattr(tally, "_last_dests_host"):
         tally._last_dests_host = None
-        tally._committed_eq = None
+        tally._last_dests_dev = None
 
     kind = _engine_kind(tally)
     with np.load(path) as z:

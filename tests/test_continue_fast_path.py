@@ -133,11 +133,12 @@ def test_auto_continue_fires_on_echo_and_matches_disabled(sharded):
     assert out[1][3] == 0
 
 
-def test_auto_continue_declines_after_boundary_exit():
-    """A particle clamped at the hull makes committed != dests — the
-    device proof must refuse the skip, and the full protocol's phase A
-    (walk from the clamp point toward the echoed outside origin) must
-    run. Results must match auto_continue=False exactly."""
+def test_auto_continue_correct_after_boundary_exit():
+    """A particle clamped at the hull has committed != dests, so phase A
+    is NOT trivial on the next echoing move: the substituted device
+    origins must still drive the relocation walk (clamp point → echoed
+    outside origin → re-clamp), with results bit-identical to
+    auto_continue=False."""
     mesh = build_box(1, 1, 1, 4, 4, 4)
     n = 500
     rng = np.random.default_rng(12)
@@ -156,11 +157,11 @@ def test_auto_continue_declines_after_boundary_exit():
         out.append((np.asarray(t.flux), t.positions, t.auto_continue_hits))
     np.testing.assert_array_equal(out[0][0], out[1][0])
     np.testing.assert_array_equal(out[0][1], out[1][1])
-    assert out[0][2] == 0  # exit clamp must veto the skip
+    assert out[0][2] == 1  # upload skipped; phase A still ran on device
     assert out[1][2] == 0
 
 
-def test_auto_continue_declines_on_resample_and_nonflying():
+def test_auto_continue_declines_on_resample_and_correct_for_nonflying():
     mesh = build_box(1, 1, 1, 4, 4, 4)
     n = 400
     rng = np.random.default_rng(13)
@@ -178,17 +179,24 @@ def test_auto_continue_declines_on_resample_and_nonflying():
                          np.ones(n, np.int8), np.ones(n))
     assert t.auto_continue_hits == 0
 
-    # a held (non-flying) particle keeps its old position -> device veto
-    t2 = PumiTally(mesh, n)
-    t2.CopyInitialPosition(src.reshape(-1).copy())
-    fly = np.ones(n, np.int8)
-    fly[0] = 0
-    t2.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
-                          fly.copy(), np.ones(n))
-    t2.MoveToNextLocation(d1.reshape(-1).copy(),
-                          np.clip(d1 + 0.1, 0, 1).reshape(-1).copy(),
-                          np.ones(n, np.int8), np.ones(n))
-    assert t2.auto_continue_hits == 0
+    # a particle held (non-flying) on move 1 sits at src, not d1; the
+    # echoing move 2 must relocate it through phase A even though the
+    # origin upload was skipped.
+    out = []
+    for auto in (True, False):
+        t2 = PumiTally(mesh, n, TallyConfig(auto_continue=auto))
+        t2.CopyInitialPosition(src.reshape(-1).copy())
+        fly = np.ones(n, np.int8)
+        fly[0] = 0
+        t2.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                              fly.copy(), np.ones(n))
+        t2.MoveToNextLocation(d1.reshape(-1).copy(),
+                              np.clip(d1 + 0.1, 0, 1).reshape(-1).copy(),
+                              np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t2.flux), t2.positions, t2.auto_continue_hits))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    assert out[0][2] == 1 and out[1][2] == 0
 
 
 def test_auto_continue_not_fooled_by_recycled_caller_buffer():
@@ -221,3 +229,29 @@ def test_auto_continue_not_fooled_by_recycled_caller_buffer():
                  + np.linalg.norm(d2 - resampled, axis=1).sum())
     got = float(np.sum(np.asarray(t.flux)))
     assert abs(got - want) / want < 1e-12
+
+
+def test_unfenced_timing_pipeline_matches_fenced():
+    """fenced_timing=False lets calls return after dispatch; results
+    after the final sync must be identical to the fenced engine."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 1000
+    rng = np.random.default_rng(15)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    traj = [src]
+    for _ in range(4):
+        traj.append(np.clip(traj[-1] + rng.normal(scale=0.2, size=(n, 3)),
+                            0.02, 0.98))
+    out = []
+    for fenced in (True, False):
+        t = PumiTally(mesh, n, TallyConfig(fenced_timing=fenced,
+                                           check_found_all=False))
+        t.CopyInitialPosition(traj[0].reshape(-1).copy())
+        for m in range(1, 5):
+            t.MoveToNextLocation(traj[m - 1].reshape(-1).copy(),
+                                 traj[m].reshape(-1).copy(),
+                                 np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t.flux), t.positions, t.elem_ids))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_array_equal(out[0][2], out[1][2])
