@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pumiumtally_tpu.api.tally import PumiTally, TallyConfig
+from pumiumtally_tpu.io.vtk import write_pvtu
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.parallel.partition import PartitionedEngine
 
@@ -69,6 +70,31 @@ class PartitionedPumiTally(PumiTally):
         # move's destinations (caller order), which this engine treats
         # exactly like freshly uploaded origins.
         return self.engine.move(origins, dests, fly, w)
+
+    def WriteTallyResults(self, filename: Optional[str] = None) -> None:
+        """Normalize and write results; a ``.pvtu`` filename writes one
+        binary piece per chip (the elements it owns) plus the index
+        file — the rank-aware output path of the reference
+        (``vtk::write_parallel``, PumiTallyImpl.cpp:415). Any other
+        extension falls through to the monolithic writers."""
+        out = filename or self.config.output_filename
+        if not out.endswith(".pvtu"):
+            return super().WriteTallyResults(filename)
+        t0 = time.perf_counter()
+        owner = self.engine.part.owner
+        write_pvtu(
+            out,
+            np.asarray(self.mesh.coords),
+            np.asarray(self.mesh.tet2vert),
+            owner,
+            cell_data={
+                "flux": np.asarray(self.normalized_flux()),
+                "volume": np.asarray(self.mesh.volumes),
+                "owner": owner.astype(np.float64),
+            },
+        )
+        self.tally_times.vtk_file_write_time += time.perf_counter() - t0
+        self.tally_times.print_times()
 
     # -- state views (caller-visible order) -------------------------------
     @property

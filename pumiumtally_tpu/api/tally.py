@@ -465,10 +465,13 @@ class PumiTally:
         zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)
-        # _as_positions_host returned OWNED memory, so these snapshots
-        # cannot alias a caller buffer that gets recycled next call.
-        self._last_dests_host = dests_host
-        self._last_dests_dev = dests
+        if self.config.auto_continue:
+            # _as_positions_host returned OWNED memory, so these
+            # snapshots cannot alias a caller buffer that gets recycled
+            # next call. Not kept when the knob is off — they would pin
+            # [n,3] of HBM and host memory per engine for nothing.
+            self._last_dests_host = dests_host
+            self._last_dests_dev = dests
         self.iter_count += 1
         if self.config.check_found_all and not bool(found_all):
             print("ERROR: Not all particles are found. May need more loops in search")

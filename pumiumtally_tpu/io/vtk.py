@@ -57,6 +57,12 @@ def write_vtk(
     ASCII headers with big-endian raw payloads — seconds for a 1M-tet
     mesh. ``ascii=True`` restores the all-text variant.
     """
+    if path.endswith(".pvtu"):
+        raise ValueError(
+            ".pvtu (multi-piece parallel) output needs per-element "
+            "ownership — use write_pvtu, or WriteTallyResults on a "
+            "PartitionedPumiTally"
+        )
     if path.endswith(".vtu"):
         if ascii:
             raise ValueError(
@@ -200,6 +206,83 @@ def write_vtu(
             f.write(struct.pack("<Q", len(payload)))
             f.write(payload)
         f.write(b"\n</AppendedData>\n</VTKFile>\n")
+
+
+def write_pvtu(
+    path: str,
+    coords: np.ndarray,
+    tet2vert: np.ndarray,
+    owner: np.ndarray,
+    cell_data: Optional[Dict[str, np.ndarray]] = None,
+    title: str = "pumiumtally_tpu flux result",
+) -> None:
+    """Parallel multi-piece output: one raw-appended ``.vtu`` per owner
+    rank plus a ``.pvtu`` index referencing them — the TPU-native
+    analogue of the reference's rank-aware ``Omega_h::vtk::write_parallel``
+    (reference PumiTallyImpl.cpp:415). Each piece holds the elements a
+    chip owns (with its vertices reindexed locally) and that chip's
+    slice of every cell-data array, so a 1M-tet partitioned result
+    writes as ndev independent pieces instead of one monolithic file.
+    """
+    if not path.endswith(".pvtu"):
+        raise ValueError(f"write_pvtu needs a .pvtu path, got {path!r}")
+    coords = np.asarray(coords, np.float64)
+    tet2vert = np.asarray(tet2vert, np.int64)
+    ne = tet2vert.shape[0]
+    owner = np.asarray(owner, np.int64).reshape(-1)
+    if owner.shape[0] != ne:
+        raise ValueError(
+            f"owner has {owner.shape[0]} entries for {ne} elements"
+        )
+    if ne and owner.min() < 0:
+        raise ValueError(
+            "owner ids must be non-negative: every element needs a "
+            "piece (-1 sentinels would be silently dropped)"
+        )
+    cell_data = {
+        name: _check_len(name, np.asarray(arr), ne, "cell")
+        for name, arr in (cell_data or {}).items()
+    }
+    nparts = int(owner.max()) + 1 if ne else 1
+
+    base = os.path.basename(path)[: -len(".pvtu")]
+    outdir = os.path.dirname(os.path.abspath(path))
+    piece_files = []
+    for r in range(nparts):
+        sel = np.flatnonzero(owner == r)
+        tets_r = tet2vert[sel]
+        verts_r = np.unique(tets_r)
+        local = np.full(coords.shape[0], -1, np.int64)
+        local[verts_r] = np.arange(verts_r.shape[0])
+        piece = f"{base}_p{r}.vtu"
+        piece_files.append(piece)
+        write_vtu(
+            os.path.join(outdir, piece),
+            coords[verts_r],
+            local[tets_r],
+            cell_data={k: v[sel] for k, v in cell_data.items()},
+            title=f"{title} (piece {r}/{nparts})",
+        )
+
+    xml = ['<?xml version="1.0"?>']
+    xml.append(
+        '<VTKFile type="PUnstructuredGrid" version="1.0" '
+        'byte_order="LittleEndian" header_type="UInt64">'
+    )
+    xml.append('<PUnstructuredGrid GhostLevel="0">')
+    xml.append("<PPoints>")
+    xml.append('<PDataArray type="Float64" Name="Points" NumberOfComponents="3"/>')
+    xml.append("</PPoints>")
+    xml.append("<PCellData>")
+    for name in cell_data:
+        xml.append(f'<PDataArray type="Float64" Name="{name}"/>')
+    xml.append("</PCellData>")
+    for piece in piece_files:
+        xml.append(f'<Piece Source="{piece}"/>')
+    xml.append("</PUnstructuredGrid>")
+    xml.append("</VTKFile>")
+    with open(path, "w") as f:
+        f.write("\n".join(xml) + "\n")
 
 
 # ---------------------------------------------------------------------------
