@@ -92,6 +92,7 @@ class PartitionedPumiTally(PumiTally):
                 "volume": np.asarray(self.mesh.volumes),
                 "owner": owner.astype(np.float64),
             },
+            nparts=int(self.device_mesh.devices.size),
         )
         self.tally_times.vtk_file_write_time += time.perf_counter() - t0
         self.tally_times.print_times()

@@ -290,8 +290,24 @@ class PumiTally:
         # other than a move changes particle state.
         self._last_dests_host: Optional[np.ndarray] = None
         self._last_dests_dev = None
+        # Pure input caches (no state dependence, so never invalidated):
+        # device ones for flying/weights, and the previous move's
+        # weights for the unchanged-weights echo.
+        self._ones_cache: dict = {}
+        self._last_weights_host: Optional[np.ndarray] = None
+        self._last_weights_dev = None
         self.auto_continue_hits = 0  # diagnostic: moves that skipped the origin upload
         return mesh
+
+    def _cached_ones(self, kind: str) -> jnp.ndarray:
+        """Device all-ones [n] (int8 flying / working-dtype weights) —
+        allocated once, reused every move."""
+        a = self._ones_cache.get(kind)
+        if a is None:
+            dt = jnp.int8 if kind == "fly" else self.dtype
+            a = jnp.ones((self.num_particles,), dt)
+            self._ones_cache[kind] = a
+        return a
 
     # -- staging helpers -------------------------------------------------
     def _as_positions_cast(self, buf, size: Optional[int]) -> np.ndarray:
@@ -436,32 +452,50 @@ class PumiTally:
         dests = jnp.asarray(dests_host)
         n = self.num_particles
         if flying is None:
-            fly = jnp.ones((n,), jnp.int8)
+            fly = self._cached_ones("fly")
         else:
             flying_np = np.asarray(flying)
             if flying_np.size < n:
                 raise ValueError(
                     f"flying buffer has {flying_np.size} values, need {n}"
                 )
-            # Copy BEFORE staging: jnp.asarray on the CPU backend may
-            # alias the caller's buffer zero-copy, and we are about to
-            # zero that buffer in place below — without the copy the
-            # staged flags would be zeroed too and no particle would fly.
-            fly = jnp.asarray(
-                np.array(flying_np.reshape(-1)[:n], dtype=np.int8, copy=True)
-            )
+            fly_cast = flying_np.reshape(-1)[:n].astype(np.int8, copy=False)
+            if self.config.auto_continue and np.all(fly_cast == 1):
+                # All in flight — the common physics batch; reuse the
+                # cached device ones instead of uploading n bytes.
+                fly = self._cached_ones("fly")
+            else:
+                # Copy BEFORE staging: jnp.asarray on the CPU backend
+                # may alias the caller's buffer zero-copy, and we are
+                # about to zero that buffer in place below — without
+                # the copy the staged flags would be zeroed too and no
+                # particle would fly.
+                fly = jnp.asarray(self._owned(fly_cast))
         if weights is None:
-            w = jnp.ones((n,), self.dtype)
+            w = self._cached_ones("w")
         else:
             weights_np = np.asarray(weights, dtype=np.float64).reshape(-1)
             if weights_np.size < n:
                 raise ValueError(
                     f"weights buffer has {weights_np.size} values, need {n}"
                 )
-            # numpy pre-cast before transfer — see _as_positions.
-            w = jnp.asarray(
-                np.asarray(weights_np[:n], dtype=np.dtype(self.dtype))
-            )
+            # numpy pre-cast before transfer — see _as_positions_cast.
+            w_cast = np.asarray(weights_np[:n], dtype=np.dtype(self.dtype))
+            if (
+                self.config.auto_continue
+                and self._last_weights_host is not None
+                and np.array_equal(w_cast, self._last_weights_host)
+            ):
+                # Unchanged statistical weights (echo of the previous
+                # batch): reuse the device array already holding them.
+                # Pure input caching — needs no engine-state proof.
+                w = self._last_weights_dev
+            else:
+                w_cast = self._owned(w_cast)
+                w = jnp.asarray(w_cast)
+                if self.config.auto_continue:
+                    self._last_weights_host = w_cast
+                    self._last_weights_dev = w
         zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)

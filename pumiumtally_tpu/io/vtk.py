@@ -215,6 +215,7 @@ def write_pvtu(
     owner: np.ndarray,
     cell_data: Optional[Dict[str, np.ndarray]] = None,
     title: str = "pumiumtally_tpu flux result",
+    nparts: Optional[int] = None,
 ) -> None:
     """Parallel multi-piece output: one raw-appended ``.vtu`` per owner
     rank plus a ``.pvtu`` index referencing them — the TPU-native
@@ -243,7 +244,15 @@ def write_pvtu(
         name: _check_len(name, np.asarray(arr), ne, "cell")
         for name, arr in (cell_data or {}).items()
     }
-    nparts = int(owner.max()) + 1 if ne else 1
+    # Explicit nparts keeps one piece per RANK even when the trailing
+    # ranks own zero elements (consumers enumerate pieces per rank).
+    inferred = int(owner.max()) + 1 if ne else 1
+    if nparts is None:
+        nparts = inferred
+    elif nparts < inferred:
+        raise ValueError(
+            f"nparts={nparts} but owner ids reach {inferred - 1}"
+        )
 
     base = os.path.basename(path)[: -len(".pvtu")]
     outdir = os.path.dirname(os.path.abspath(path))

@@ -255,3 +255,42 @@ def test_unfenced_timing_pipeline_matches_fenced():
     np.testing.assert_array_equal(out[0][0], out[1][0])
     np.testing.assert_array_equal(out[0][1], out[1][1])
     np.testing.assert_array_equal(out[0][2], out[1][2])
+
+
+def test_staging_caches_for_flying_and_weights_match_disabled():
+    """All-ones flying reuses the cached device ones; unchanged
+    non-unit weights reuse the previous device array. Results must be
+    bit-identical to auto_continue=False (which stages everything)."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 800
+    rng = np.random.default_rng(16)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+    w = rng.uniform(0.5, 2.0, n)
+
+    out = []
+    for auto in (True, False):
+        t = PumiTally(mesh, n, TallyConfig(auto_continue=auto))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(n, np.int8), w.copy())
+        t.MoveToNextLocation(d1.reshape(-1).copy(), d2.reshape(-1).copy(),
+                             np.ones(n, np.int8), w.copy())
+        out.append((np.asarray(t.flux), t.positions, t.elem_ids))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_array_equal(out[0][2], out[1][2])
+
+    # changed weights on move 3 must be staged fresh (miss path)
+    t = PumiTally(mesh, n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                         np.ones(n, np.int8), w.copy())
+    w2 = w * 2.0
+    t.MoveToNextLocation(d1.reshape(-1).copy(), d2.reshape(-1).copy(),
+                         np.ones(n, np.int8), w2.copy())
+    got = float(np.sum(np.asarray(t.flux)))
+    want = float((np.linalg.norm(d1 - src, axis=1) * w).sum()
+                 + (np.linalg.norm(d2 - d1, axis=1) * w2).sum())
+    assert abs(got - want) / want < 1e-12

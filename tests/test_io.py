@@ -411,3 +411,22 @@ def test_partitioned_write_pvtu(tmp_path):
     with _pytest.raises(ValueError, match="pvtu"):
         write_vtk(str(tmp_path / "x.pvtu"), np.asarray(mesh.coords),
                   np.asarray(mesh.tet2vert), cell_data={})
+
+
+def test_pvtu_explicit_nparts_writes_empty_trailing_pieces(tmp_path):
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars, write_pvtu
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)  # 6 tets
+    owner = np.zeros(6, np.int32)  # everything on rank 0 of 4
+    path = str(tmp_path / "skew.pvtu")
+    write_pvtu(path, coords, tets, owner, cell_data={"flux": np.ones(6)},
+               nparts=4)
+    import os
+    pieces = sorted(p for p in os.listdir(tmp_path) if p.endswith(".vtu"))
+    assert pieces == [f"skew_p{r}.vtu" for r in range(4)]
+    assert read_vtk_cell_scalars(str(tmp_path / "skew_p0.vtu"),
+                                 "flux").shape[0] == 6
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="nparts"):
+        write_pvtu(str(tmp_path / "bad.pvtu"), coords, tets,
+                   np.full(6, 5), nparts=2)
