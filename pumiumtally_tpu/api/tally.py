@@ -499,11 +499,14 @@ class PumiTally:
         zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)
-        if self.config.auto_continue:
+        if self.config.auto_continue and origins_cast is not None:
             # _as_positions_host returned OWNED memory, so these
             # snapshots cannot alias a caller buffer that gets recycled
-            # next call. Not kept when the knob is off — they would pin
-            # [n,3] of HBM and host memory per engine for nothing.
+            # next call. Only retained for origin-passing drivers (the
+            # ones that can echo) — a continue-mode driver would pin an
+            # extra [n,3] on device and host for nothing. A stale
+            # snapshot is value-correct by construction: the echo
+            # substitutes bytes equal to whatever the caller passed.
             self._last_dests_host = dests_host
             self._last_dests_dev = dests
         self.iter_count += 1

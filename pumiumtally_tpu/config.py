@@ -64,9 +64,10 @@ class TallyConfig:
         origins (and its walk is skipped by the device-side trivial
         check when every particle committed its destination). Saves
         one [N,3] host→device transfer per echoing move, with no added
-        synchronization. Applies to the monolithic, sharded and
-        partitioned facades; the streaming facades stage chunk-wise
-        through their own ``MoveToNextLocation`` and ignore this knob.
+        synchronization. Applies to every facade: the streaming ones
+        detect the echo on the flat caller buffer and reuse their
+        per-chunk device arrays (the weights/flying caches below them
+        are monolithic/sharded/partitioned only).
       fenced_timing: if True (default), each API call blocks until its
         device work finishes so ``TallyTimes`` measures real per-phase
         wall time (the fence the reference intended via

@@ -194,3 +194,49 @@ def test_streaming_partitioned_lost_warning(capsys):
     assert "8 source points lie in no mesh element" in out
     ids = sp.elem_ids
     assert np.all(ids[::8] == -1)
+
+
+def test_streaming_origin_echo_dedup_matches_disabled():
+    """Echoed origins reuse the retained per-chunk device dests; flux
+    and positions must be bit-identical to auto_continue=False, and a
+    recycled caller buffer must not fool the compare."""
+    from pumiumtally_tpu import StreamingTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n, chunk = 3000, 1024  # 3 chunks, last one partial
+    rng = np.random.default_rng(21)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+
+    out = []
+    for auto in (True, False):
+        t = StreamingTally(mesh, n, chunk_size=chunk,
+                           config=TallyConfig(auto_continue=auto))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        t.MoveToNextLocation(d1.reshape(-1).copy(), d2.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t.flux), t.positions, t.auto_continue_hits))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    assert out[0][2] == 1 and out[1][2] == 0
+
+    # recycled buffer: resampled origins in the same memory must miss
+    buf = np.empty(3 * n)
+    t = StreamingTally(mesh, n, chunk_size=chunk)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    buf[:] = d1.reshape(-1)
+    t.MoveToNextLocation(src.reshape(-1).copy(), buf,
+                         np.ones(n, np.int8), np.ones(n))
+    resampled = rng.uniform(0.05, 0.95, (n, 3))
+    buf[:] = resampled.reshape(-1)
+    d3 = np.clip(resampled + 0.1, 0.02, 0.98)
+    t.MoveToNextLocation(buf, d3.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    assert t.auto_continue_hits == 0
+    want = float(np.linalg.norm(d1 - src, axis=1).sum()
+                 + np.linalg.norm(d3 - resampled, axis=1).sum())
+    got = float(np.sum(np.asarray(t.flux)))
+    assert abs(got - want) / want < 1e-12

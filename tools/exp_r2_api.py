@@ -1,0 +1,75 @@
+"""API-level protocol A/B on the current backend: two_phase (staging
+dedup on), two_phase_forced (everything staged, no pipelining benefit
+denied though — unfenced), and continue rates at bench scale.
+
+Quick version of bench.py's workload matrix (fewer moves, no CPU
+baseline) for iterating on the staging/pipeline design on-chip.
+
+Usage: python tools/exp_r2_api.py [N] [DIV] [MOVES]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+MOVES = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+
+
+def run(mode: str) -> float:
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+    cfg = TallyConfig(
+        check_found_all=False,
+        auto_continue=(mode != "two_phase_forced"),
+        fenced_timing=False,
+    )
+    t = PumiTally(mesh, N, cfg)
+    rng = np.random.default_rng(0)
+    pts = [rng.uniform(0.05, 0.95, (N, 3))]
+    for _ in range(MOVES + 1):
+        step = rng.normal(scale=0.25 / np.sqrt(3), size=(N, 3))
+        pts.append(np.clip(pts[-1] + step, 0.02, 0.98))
+    t.CopyInitialPosition(pts[0].reshape(-1).copy())
+
+    def drive(m: int) -> None:
+        dests = pts[m].reshape(-1).copy()
+        if mode.startswith("two_phase"):
+            t.MoveToNextLocation(
+                pts[m - 1].reshape(-1).copy(), dests,
+                np.ones(N, np.int8), np.ones(N),
+            )
+        else:
+            t.MoveToNextLocation(None, dests)
+
+    drive(1)
+    float(jnp.sum(t.flux))  # sync after warmup/compile
+    t0 = time.perf_counter()
+    for m in range(2, MOVES + 2):
+        drive(m)
+    total = float(jnp.sum(t.flux))
+    dt = time.perf_counter() - t0
+    rate = N * MOVES / dt
+    hits = getattr(t, "auto_continue_hits", 0)
+    print(f"{mode:17s}: {rate:,.0f} moves/s  (sum={total:.1f}, "
+          f"echo hits={hits})", flush=True)
+    return rate
+
+
+def main():
+    for mode in ("continue", "two_phase", "two_phase_forced"):
+        run(mode)
+
+
+if __name__ == "__main__":
+    main()
