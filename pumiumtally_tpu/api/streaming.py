@@ -101,9 +101,18 @@ class StreamingTally(PumiTally):
         lo = k * self.chunk_size
         return lo, min(lo + self.chunk_size, self.num_particles)
 
-    def _stage_chunk_positions(self, host: np.ndarray, k: int) -> jnp.ndarray:
+    def _stage_chunk_positions(
+        self, host: np.ndarray, k: int, retain: bool = False
+    ) -> jnp.ndarray:
         """host is the caller's [3n] buffer (f64); returns [chunk,3] on
-        device, padded by repeating the last row (pad slots never fly)."""
+        device, padded by repeating the last row (pad slots never fly).
+
+        ``retain=True`` for chunks kept past this call (the origin-echo
+        dest cache): in f64 mode the cast is a view of the caller's
+        buffer and the CPU backend's jnp.asarray can alias it
+        zero-copy, so a retained chunk must own its memory. Chunks
+        consumed within the call need no copy — the facade fences
+        before returning."""
         lo, hi = self._chunk_bounds(k)
         a = host[3 * lo : 3 * hi].reshape(hi - lo, 3)
         a = np.asarray(a, dtype=np.dtype(self.dtype))  # host pre-cast
@@ -111,11 +120,7 @@ class StreamingTally(PumiTally):
             a = np.concatenate(
                 [a, np.repeat(a[-1:], self.chunk_size - (hi - lo), axis=0)]
             )
-        else:
-            # Own the memory: in f64 mode the cast is a view of the
-            # caller's buffer, the CPU backend's jnp.asarray can alias
-            # it zero-copy, and dest chunks are retained across calls
-            # for the origin-echo dedup.
+        elif retain:
             a = self._owned(a)
         return jnp.asarray(a)
 
@@ -170,17 +175,13 @@ class StreamingTally(PumiTally):
         )
         # Origin-echo dedup (TallyConfig.auto_continue), chunk-wise: when
         # the caller's origins equal the previous move's destinations
-        # bit-for-bit, reuse the device chunks that staged them instead
-        # of re-uploading the whole batch (here _last_dests_dev is the
-        # LIST of per-chunk device arrays).
-        echo = (
-            origins_h is not None
-            and self.config.auto_continue
-            and self._last_dests_host is not None
-            and np.array_equal(origins_h, self._last_dests_host)
+        # bit-for-bit in the working dtype (same rule as the monolithic
+        # facade — _origins_echo), reuse the device chunks that staged
+        # them instead of re-uploading the whole batch (here
+        # _last_dests_dev is the LIST of per-chunk device arrays).
+        echo = origins_h is not None and self._origins_echo(
+            self._as_positions_cast(particle_origin, size)
         )
-        if echo:
-            self.auto_continue_hits += 1
         fly_h = None if flying is None else np.asarray(flying).reshape(-1)
         w_h = (
             None
@@ -188,12 +189,13 @@ class StreamingTally(PumiTally):
             else np.asarray(weights, np.float64).reshape(-1)
         )
 
+        retain = self.config.auto_continue and origins_h is not None
         oks = []
         dest_chunks = []
         for k in range(self.nchunks):
             # Stage chunk k, dispatch its walk, move on: dispatches are
             # async, so chunk k+1's staging overlaps chunk k's walk.
-            dest = self._stage_chunk_positions(dests_h, k)
+            dest = self._stage_chunk_positions(dests_h, k, retain=retain)
             dest_chunks.append(dest)
             fly = (
                 jnp.ones((self.chunk_size,), jnp.int8)
@@ -218,11 +220,14 @@ class StreamingTally(PumiTally):
                 orig = self._stage_chunk_positions(origins_h, k)
             oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
-        if self.config.auto_continue and origins_h is not None:
-            # host_positions may hand back a view of the caller's
-            # buffer — snapshot an owned copy for the next echo compare.
-            # Only retained for origin-passing drivers (see tally.py).
-            self._last_dests_host = np.array(dests_h, copy=True)
+        if retain:
+            # Snapshot in the working dtype (the compare representation
+            # _origins_echo uses), owned so a recycled caller buffer
+            # cannot fool the next compare. Only retained for
+            # origin-passing drivers (see tally.py).
+            self._last_dests_host = self._as_positions_host(
+                particle_destinations, size
+            )
             self._last_dests_dev = dest_chunks
         self.iter_count += 1
         self._after_chunk_dispatch()

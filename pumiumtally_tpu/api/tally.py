@@ -333,6 +333,20 @@ class PumiTally:
     def _as_positions_host(self, buf, size: Optional[int]) -> np.ndarray:
         return self._owned(self._as_positions_cast(buf, size))
 
+    def _origins_echo(self, origins_cast: Optional[np.ndarray]) -> bool:
+        """Shared echo rule for every facade: the caller's origins,
+        cast to the working dtype, equal the previous move's
+        destinations bit-for-bit. Counts the hit."""
+        if (
+            origins_cast is not None
+            and self.config.auto_continue
+            and self._last_dests_host is not None
+            and np.array_equal(origins_cast, self._last_dests_host)
+        ):
+            self.auto_continue_hits += 1
+            return True
+        return False
+
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
         return jnp.asarray(self._as_positions_host(buf, size))
 
@@ -430,12 +444,7 @@ class PumiTally:
         )
         dests_host = self._as_positions_host(particle_destinations, size)
         origins: Optional[jnp.ndarray]
-        if (
-            origins_cast is not None
-            and self.config.auto_continue
-            and self._last_dests_host is not None
-            and np.array_equal(origins_cast, self._last_dests_host)
-        ):
+        if self._origins_echo(origins_cast):
             # The staged origins echo the previous destinations in the
             # working dtype — substitute the device array that staged
             # them last move instead of uploading the same bytes again.
@@ -444,7 +453,6 @@ class PumiTally:
             # trivial check skips its walk whenever every particle
             # committed its destination. See TallyConfig.auto_continue.
             origins = self._last_dests_dev
-            self.auto_continue_hits += 1
         elif origins_cast is None:
             origins = None
         else:
