@@ -55,9 +55,9 @@ import time
 
 import numpy as np
 
-MESH_DIV = 20  # 20x20x20 cells → 48000 tets
-N = 500_000
-MOVES = 8
+MESH_DIV = int(os.environ.get("PUMIUMTALLY_BENCH_DIV", 20))  # 20³ cells → 48000 tets
+N = int(os.environ.get("PUMIUMTALLY_BENCH_N", 500_000))
+MOVES = int(os.environ.get("PUMIUMTALLY_BENCH_MOVES", 8))
 MEAN_STEP = 0.25  # mean segment length: ~15 tet crossings per move
 CONSERVATION_RTOL = 1e-6
 

@@ -179,8 +179,15 @@ class StreamingTally(PumiTally):
         # facade — _origins_echo), reuse the device chunks that staged
         # them instead of re-uploading the whole batch (here
         # _last_dests_dev is the LIST of per-chunk device arrays).
-        echo = origins_h is not None and self._origins_echo(
-            self._as_positions_cast(particle_origin, size)
+        # Guard BEFORE casting: the cast is a full-batch host pass, only
+        # worth paying when an echo is actually possible.
+        echo = (
+            origins_h is not None
+            and self.config.auto_continue
+            and self._last_dests_host is not None
+            and self._origins_echo(
+                self._as_positions_cast(particle_origin, size)
+            )
         )
         fly_h = None if flying is None else np.asarray(flying).reshape(-1)
         w_h = (

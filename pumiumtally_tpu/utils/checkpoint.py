@@ -153,7 +153,10 @@ def _restore_canonical(tally, kind, x, elem, flux, z) -> None:
         # so elem's scalar fill matches x's last-row pad.
         xflat = np.ascontiguousarray(x.reshape(-1))
         for k in range(tally.nchunks):
-            tally._x[k] = tally._stage_chunk_positions(xflat, k)
+            # retain=True: these chunks become persistent engine state,
+            # so they must own their memory (the no-copy fast path is
+            # only safe for chunks consumed within one fenced call).
+            tally._x[k] = tally._stage_chunk_positions(xflat, k, retain=True)
             tally._elem[k] = tally._stage_chunk_vec(
                 elem, k, np.int32, int(elem[n - 1])
             )

@@ -1,0 +1,77 @@
+"""Roofline statement for the walk engine: achieved HBM bytes/s from a
+measured move rate, against the chip's streaming peak.
+
+The per-iteration traffic model (all numbers per ACTIVE particle per
+crossing, f32):
+
+- walk-table row gather:      80 B read   ([20] floats)
+- flux scatter-add:           ~8 B read+write (one f32 slot, amortized)
+- carry state read+write:     2 x 41 B    (s4 + elem4 + dest12 + d0_12 +
+                                           eff_w4 + done1 + idx4, see
+                                           ops/walk.py slim carry)
+
+plus per-stage cascade costs (argsort key + one concatenate per carried
+array) amortized to roughly one extra carry pass over the window, and
+the lock-step overdraft: iterations run at the window size, not the
+active count — the cascade bounds that waste to ~2x Sigma(path length)
+(measured, docs/PERF_NOTES.md round 1).
+
+Usage:
+  python tools/roofline.py <moves_per_sec> [crossings_per_move] [hbm_gbps]
+
+Defaults: 15 crossings (bench workload), 820 GB/s (v5e HBM streaming
+peak; v5p ~2765).
+"""
+
+from __future__ import annotations
+
+import sys
+
+BYTES_GATHER = 80
+BYTES_SCATTER = 8
+BYTES_CARRY = 2 * 41
+CASCADE_FACTOR = 2.0  # lock-step + stage overheads vs ideal Sigma(path)
+
+
+def roofline(moves_per_sec: float, crossings: float = 15.0,
+             hbm_gbps: float = 820.0) -> dict:
+    per_crossing = BYTES_GATHER + BYTES_SCATTER + BYTES_CARRY
+    bytes_per_move = per_crossing * crossings * CASCADE_FACTOR
+    achieved = moves_per_sec * bytes_per_move
+    return {
+        "bytes_per_move_modeled": bytes_per_move,
+        "achieved_GBps": achieved / 1e9,
+        "hbm_peak_GBps": hbm_gbps,
+        "fraction_of_peak": achieved / (hbm_gbps * 1e9),
+        "peak_bound_moves_per_sec": hbm_gbps * 1e9 / bytes_per_move,
+    }
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(2)
+    rate = float(sys.argv[1])
+    crossings = float(sys.argv[2]) if len(sys.argv) > 2 else 15.0
+    hbm = float(sys.argv[3]) if len(sys.argv) > 3 else 820.0
+    r = roofline(rate, crossings, hbm)
+    print(
+        f"{rate:,.0f} moves/s x {r['bytes_per_move_modeled']:,.0f} modeled "
+        f"B/move = {r['achieved_GBps']:.1f} GB/s achieved "
+        f"= {100 * r['fraction_of_peak']:.1f}% of the {hbm:.0f} GB/s HBM "
+        f"streaming peak (bandwidth-bound ceiling at this traffic model: "
+        f"{r['peak_bound_moves_per_sec']:,.0f} moves/s)."
+    )
+    # The binding resource is NOT the streaming peak: the walk-table
+    # gather is row-granularity DMA, measured at ~7-10 GB/s effective on
+    # v5e (docs/PERF_NOTES.md) — quote that ceiling too.
+    for eff in (7.0, 10.0):
+        bound = eff * 1e9 / (BYTES_GATHER * crossings * CASCADE_FACTOR)
+        print(
+            f"  row-gather-bound ceiling at {eff:.0f} GB/s effective DMA: "
+            f"{bound:,.0f} moves/s"
+        )
+
+
+if __name__ == "__main__":
+    main()
