@@ -160,9 +160,11 @@ def walk(
     # change, ~1 ulp).
     eff_w = jnp.where(in_flight.astype(bool), weight * seg_len, 0.0)
 
-    def body(state):
-        """One lock-step iteration over a (possibly windowed) batch."""
-        it, s, elem, dest, d0, eff_w, done, flux = state
+    def step(it, s, elem, dest, d0, eff_w, done):
+        """One lock-step iteration over a (possibly windowed) batch.
+        Returns the advanced state plus this crossing's tally pair
+        (element indexed, contribution) — the caller decides how to
+        scatter (per iteration, or fused across an unrolled group)."""
         active = ~done
         fn, fo, adj = _gather_walk_row(mesh, elem)
         # Both ray projections are against walk-constant vectors
@@ -188,24 +190,39 @@ def walk(
 
         if tally:
             contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
-            flux = flux.at[elem].add(contrib, mode="drop")
+            pair = (elem, contrib)
+        else:
+            pair = None
 
         advance = active & ~reached & ~hit_boundary
         elem = jnp.where(advance, next_elem, elem)
         s = jnp.where(active, s_new, s)
         done = done | reached | hit_boundary
-        return it + 1, s, elem, dest, d0, eff_w, done, flux
+        return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
     it0 = jnp.asarray(0, jnp.int32)
 
     cond_every = max(1, int(cond_every))
-    if cond_every > 1:
-        body_1 = body
 
-        def body(state):  # noqa: F811 — deliberate k-unrolled variant
-            for _ in range(cond_every):
-                state = body_1(state)
-            return state
+    def body(state):
+        """cond_every iterations per while step: the all-done reduction
+        is evaluated once per group, and the group's tally pairs fuse
+        into ONE scatter-add of k·W values (fewer scatter launches than
+        k scatters of W; f64 oracle impact is add-reordering only)."""
+        *core, flux = state
+        pairs = []
+        for _ in range(cond_every):
+            core, pair = step(*core)
+            pairs.append(pair)
+        if tally:
+            if cond_every == 1:
+                e0, c0 = pairs[0]
+                flux = flux.at[e0].add(c0, mode="drop")
+            else:
+                elems = jnp.concatenate([p[0] for p in pairs])
+                contribs = jnp.concatenate([p[1] for p in pairs])
+                flux = flux.at[elems].add(contribs, mode="drop")
+        return (*core, flux)
 
     def final_x(s, done, exited, dest, d0):
         """Materialize positions from the ray coordinate — exactly once.

@@ -6,9 +6,12 @@ crossing, f32):
 
 - walk-table row gather:      80 B read   ([20] floats)
 - flux scatter-add:           ~8 B read+write (one f32 slot, amortized)
-- carry state read+write:     2 x 41 B    (s4 + elem4 + dest12 + d0_12 +
-                                           eff_w4 + done1 + idx4, see
-                                           ops/walk.py slim carry)
+- carry state read+write:     2 x 37 B    (s4 + elem4 + dest12 + d0_12 +
+                                           eff_w4 + done1 — the walk
+                                           while_loop carry, ops/walk.py;
+                                           idx lives outside the loop and
+                                           is part of the cascade costs
+                                           below)
 
 plus per-stage cascade costs (argsort key + one concatenate per carried
 array) amortized to roughly one extra carry pass over the window, and
@@ -29,7 +32,7 @@ import sys
 
 BYTES_GATHER = 80
 BYTES_SCATTER = 8
-BYTES_CARRY = 2 * 41
+BYTES_CARRY = 2 * 37
 CASCADE_FACTOR = 2.0  # lock-step + stage overheads vs ideal Sigma(path)
 
 
