@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pumiumtally_tpu import build_box
-from pumiumtally_tpu.ops.walk import walk
+from pumiumtally_tpu.ops.walk import _MIN_WINDOW as _MIN_WINDOW_DEFAULT, walk
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
 DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
@@ -46,9 +46,9 @@ def main():
     )
     x0, elem0 = r0.x, r0.elem
 
-    for k in (1, 2, 4, 8):
+    def measure(label, **kw):
         stepper = jax.jit(partial(
-            walk, tally=True, tol=1e-6, max_iters=4096, cond_every=k,
+            walk, tally=True, tol=1e-6, max_iters=4096, **kw,
         ))
         x, elem = x0, elem0
         flux = jnp.zeros((mesh.nelems,), jnp.float32)
@@ -65,8 +65,24 @@ def main():
         total = float(jnp.sum(fx))
         dt = time.perf_counter() - t0
         rate = N * (MOVES - 1) / dt
-        print(f"cond_every={k}: {rate:,.0f} moves/s  (sum={total:.3f})",
-              flush=True)
+        print(f"{label}: {rate:,.0f} moves/s  (sum={total:.3f})", flush=True)
+        return rate
+
+    best_k, best = 1, 0.0
+    for k in (1, 2, 4, 8):
+        r = measure(f"cond_every={k}", cond_every=k)
+        if r > best:
+            best_k, best = k, r
+    for mw in (4096, 8192, 16384, 32768):
+        # 8192 repeats the walk default on purpose: its delta vs the
+        # cond_every sweep entry above quantifies run-to-run variance
+        # (large through the remote tunnel, PERF_NOTES round 2).
+        label = f"min_window={mw} (cond_every={best_k})"
+        if mw == _MIN_WINDOW_DEFAULT:
+            label += " [= default; variance repeat]"
+        measure(label, cond_every=best_k, min_window=mw)
+    measure(f"compact=False (cond_every={best_k})",
+            cond_every=best_k, compact=False)
 
 
 if __name__ == "__main__":
