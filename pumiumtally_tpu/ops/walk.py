@@ -69,6 +69,39 @@ from pumiumtally_tpu.mesh.tetmesh import (
 _MIN_WINDOW = 8192
 
 
+def fused_tally_body(step, cond_every: int, tally: bool):
+    """Build a while_loop body running ``cond_every`` masked iterations
+    of ``step`` per step, fusing the group's (element, contribution)
+    tally pairs into ONE scatter-add of k·W values (fewer scatter
+    launches than k scatters of W; f64 impact is add-reordering only).
+
+    ``step(*core) -> (core', pair)`` with ``pair = (elem, contrib)``
+    when tallying, else None; the loop state is ``(*core, flux)``.
+    Shared by the replicated walk below and the partitioned
+    ``walk_local`` (parallel/partition.py) so the unroll/fuse machinery
+    cannot drift between engines.
+    """
+    cond_every = max(1, int(cond_every))
+
+    def body(state):
+        *core, flux = state
+        pairs = []
+        for _ in range(cond_every):
+            core, pair = step(*core)
+            pairs.append(pair)
+        if tally:
+            if cond_every == 1:
+                e0, c0 = pairs[0]
+                flux = flux.at[e0].add(c0, mode="drop")
+            else:
+                flux = flux.at[jnp.concatenate([p[0] for p in pairs])].add(
+                    jnp.concatenate([p[1] for p in pairs]), mode="drop"
+                )
+        return (*core, flux)
+
+    return body
+
+
 class WalkResult(NamedTuple):
     """Post-walk particle state.
 
@@ -201,28 +234,7 @@ def walk(
         return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
     it0 = jnp.asarray(0, jnp.int32)
-
-    cond_every = max(1, int(cond_every))
-
-    def body(state):
-        """cond_every iterations per while step: the all-done reduction
-        is evaluated once per group, and the group's tally pairs fuse
-        into ONE scatter-add of k·W values (fewer scatter launches than
-        k scatters of W; f64 oracle impact is add-reordering only)."""
-        *core, flux = state
-        pairs = []
-        for _ in range(cond_every):
-            core, pair = step(*core)
-            pairs.append(pair)
-        if tally:
-            if cond_every == 1:
-                e0, c0 = pairs[0]
-                flux = flux.at[e0].add(c0, mode="drop")
-            else:
-                elems = jnp.concatenate([p[0] for p in pairs])
-                contribs = jnp.concatenate([p[1] for p in pairs])
-                flux = flux.at[elems].add(contribs, mode="drop")
-        return (*core, flux)
+    body = fused_tally_body(step, cond_every, tally)
 
     def final_x(s, done, exited, dest, d0):
         """Materialize positions from the ray coordinate — exactly once.

@@ -62,6 +62,7 @@ from pumiumtally_tpu.mesh.tetmesh import (
     WALK_TABLE_NORMALS,
     WALK_TABLE_OFFSETS,
 )
+from pumiumtally_tpu.ops.walk import fused_tally_body
 from pumiumtally_tpu.parallel.sharded import _axis_name
 
 try:  # jax >= 0.8
@@ -221,6 +222,7 @@ def walk_local(
     tol: float,
     max_iters: int,
     adj_int: Optional[jnp.ndarray] = None,  # [L,4] when ids don't fit the float
+    cond_every: int = 4,
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
@@ -231,6 +233,10 @@ def walk_local(
     against walk-constant vectors, positions materialize once at the
     end. A migrated particle starts a fresh round (and a fresh ray)
     from its pause point, so ``s`` never crosses a migration.
+
+    ``cond_every`` mirrors ops.walk.walk: k masked iterations per while
+    step with the group's tally pairs fused into one scatter-add
+    (done/paused particles are inert under the active mask).
     """
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
@@ -247,8 +253,7 @@ def walk_local(
         it, _s, _lelem, done, _exited, pending, _flux = state
         return (it < max_iters) & jnp.any(~done & (pending < 0))
 
-    def body(state):
-        it, s, lelem, done, exited, pending, flux = state
+    def step(it, s, lelem, done, exited, pending):
         active = ~done & (pending < 0)
         row = table[lelem]
         n = row.shape[0]
@@ -276,7 +281,9 @@ def walk_local(
             contrib = jnp.where(
                 active & flying_b, (s_new - s) * seg_len * weight, 0.0
             )
-            flux = flux.at[lelem].add(contrib, mode="drop")
+            pair = (lelem, contrib)
+        else:
+            pair = None
 
         advance = active & ~reached & ~hit_boundary & ~goes_remote
         lelem = jnp.where(advance, nxt, lelem)
@@ -284,7 +291,9 @@ def walk_local(
         pending = jnp.where(active & goes_remote, -nxt - 2, pending)
         done = done | (active & (reached | hit_boundary))
         exited = exited | (active & hit_boundary)
-        return it + 1, s, lelem, done, exited, pending, flux
+        return (it + 1, s, lelem, done, exited, pending), pair
+
+    body = fused_tally_body(step, cond_every, tally)
 
     it0 = jnp.asarray(0, jnp.int32)
     it, s, lelem, done, exited, pending, flux = lax.while_loop(

@@ -70,10 +70,12 @@ def main():
 
     # Two samples per cond_every before choosing: single timings through
     # the remote tunnel have large run-to-run variance (PERF_NOTES
-    # round 2) and everything below conditions on the winner.
+    # round 2) and everything below conditions on the winner. Noise is
+    # one-sided (stalls only ever LOWER a rate), so the best sample is
+    # the estimator.
     best_k, best = 1, 0.0
     for k in (1, 2, 4, 8):
-        r = min(measure(f"cond_every={k} (a)", cond_every=k),
+        r = max(measure(f"cond_every={k} (a)", cond_every=k),
                 measure(f"cond_every={k} (b)", cond_every=k))
         if r > best:
             best_k, best = k, r
