@@ -174,14 +174,16 @@ def run_pincell(n: int, moves: int) -> dict:
     return timed_moves(t, pts, moves, drive)
 
 
-def preflight_device(max_wait_s: float = 600.0) -> None:
+def preflight_device(max_wait_s: float = 1500.0) -> None:
     """Fail fast (rc 1) if the accelerator cannot be claimed.
 
     A killed TPU client can leave the tunnel's device grant stuck, and
     a jax backend init then hangs forever. Probe in SUBPROCESSES (the
     hang is only escapable by killing the process) with retries, so a
     transiently busy tunnel still gets its bench, and a wedged one
-    produces a diagnosable failure instead of an eternal hang.
+    produces a diagnosable failure instead of an eternal hang. The
+    wait is generous (25 min): observed wedges have cleared on the
+    scale of tens of minutes to hours, and a late bench beats no bench.
     """
     deadline = time.monotonic() + max_wait_s
     attempt = 0

@@ -179,3 +179,43 @@ def test_cpp_demo_host(native_lib, tmp_path):
     assert r.returncode == 0, f"demo failed:\n{r.stdout}\n{r.stderr}"
     assert "demo OK" in r.stdout
     assert os.path.exists(str(tmp_path / "demo_fluxresult.vtk"))
+
+
+def test_c_abi_echo_protocol_dedup(native_lib, tmp_path):
+    """Reference-style host loop over the C ABI: origins echo the
+    previous destinations every move (in the SAME recycled buffers a
+    C host would reuse); the engine's auto_continue dedup must keep
+    conservation exact across the boundary."""
+    lib = native_lib
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    n = 64
+    h = lib.pumiumtally_create(msh.encode(), n)
+    assert h
+    try:
+        rng = np.random.default_rng(17)
+        origins = rng.uniform(0.1, 0.9, (n, 3)).reshape(-1)
+        rc = lib.pumiumtally_copy_initial_position(h, _dp(origins), 3 * n)
+        assert rc == 0
+        expect = 0.0
+        obuf = origins.copy()
+        dbuf = np.empty(3 * n)
+        for _ in range(4):
+            dests = rng.uniform(0.1, 0.9, (n, 3)).reshape(-1)
+            dbuf[:] = dests
+            flying = np.ones(n, np.int8)
+            weights = np.ones(n)
+            rc = lib.pumiumtally_move_to_next_location(
+                h, _dp(obuf), _dp(dbuf),
+                flying.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+                _dp(weights), 3 * n,
+            )
+            assert rc == 0
+            expect += float(np.linalg.norm(
+                (dests - obuf).reshape(n, 3), axis=1).sum())
+            obuf[:] = dests  # echo: recycled origin buffer
+        flux = np.zeros(6)
+        lib.pumiumtally_get_flux(h, _dp(flux), 6)
+        assert abs(flux.sum() - expect) / expect < 1e-9
+    finally:
+        lib.pumiumtally_destroy(h)
