@@ -111,8 +111,10 @@ class StreamingTally(PumiTally):
         dest cache): in f64 mode the cast is a view of the caller's
         buffer and the CPU backend's jnp.asarray can alias it
         zero-copy, so a retained chunk must own its memory. Chunks
-        consumed within the call need no copy — the facade fences
-        before returning."""
+        consumed within the call skip the copy ONLY when the facade
+        fences before returning (fenced_timing=True); an unfenced call
+        returns with walks still in flight, so a recycled caller
+        buffer could otherwise mutate data a queued walk reads."""
         lo, hi = self._chunk_bounds(k)
         a = host[3 * lo : 3 * hi].reshape(hi - lo, 3)
         a = np.asarray(a, dtype=np.dtype(self.dtype))  # host pre-cast
@@ -120,7 +122,7 @@ class StreamingTally(PumiTally):
             a = np.concatenate(
                 [a, np.repeat(a[-1:], self.chunk_size - (hi - lo), axis=0)]
             )
-        elif retain:
+        elif retain or not self.config.fenced_timing:
             a = self._owned(a)
         return jnp.asarray(a)
 
@@ -154,7 +156,8 @@ class StreamingTally(PumiTally):
         ):
             print("ERROR: Not all particles are found. May need more loops in search")
         self.is_initialized = True
-        jax.block_until_ready(self._x)
+        if self.config.fenced_timing:
+            jax.block_until_ready(self._x)
         self.tally_times.initialization_time += time.perf_counter() - t0
 
     def MoveToNextLocation(
@@ -240,7 +243,8 @@ class StreamingTally(PumiTally):
         self._after_chunk_dispatch()
         if self.config.check_found_all and not all(bool(o) for o in oks):
             print("ERROR: Not all particles are found. May need more loops in search")
-        jax.block_until_ready(self._flux)
+        if self.config.fenced_timing:
+            jax.block_until_ready(self._flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
 
     def _after_chunk_dispatch(self) -> None:

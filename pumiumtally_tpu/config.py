@@ -78,8 +78,12 @@ class TallyConfig:
         result/flux read still synchronizes everything). Pipelining
         additionally needs ``check_found_all=False`` — the convergence
         warning reads a device scalar back every call, which is itself
-        a sync. The streaming facades ignore this knob (their overlap
-        comes from chunk-wise double buffering; they always fence).
+        a sync. For plain ``StreamingTally`` (whose within-move overlap
+        is chunk-wise double buffering) unfencing additionally lets
+        move m+1's first chunks stage while move m's last chunks
+        compute; ``StreamingPartitionedTally`` still synchronizes its
+        deferred overflow safety check once per call, so this knob
+        does not buy cross-move pipelining there.
     """
 
     tolerance: Optional[float] = None

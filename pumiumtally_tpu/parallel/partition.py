@@ -234,6 +234,14 @@ def walk_local(
     end. A migrated particle starts a fresh round (and a fresh ray)
     from its pause point, so ``s`` never crosses a migration.
 
+    Known benign divergence from the replicated walk: a destination
+    lying exactly ON a tet face can commit a different (face-adjacent)
+    final element here, because the restarted ray's rounding resolves
+    the reached-vs-crossed tie differently after a migration. Committed
+    positions and flux are identical either way — the next move walks
+    the same geometry from the shared face — so only the elem_ids view
+    differs, and only for on-face destinations.
+
     ``cond_every`` mirrors ops.walk.walk: k masked iterations per while
     step with the group's tally pairs fused into one scatter-add
     (done/paused particles are inert under the active mask).

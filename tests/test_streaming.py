@@ -240,3 +240,57 @@ def test_streaming_origin_echo_dedup_matches_disabled():
                  + np.linalg.norm(d3 - resampled, axis=1).sum())
     got = float(np.sum(np.asarray(t.flux)))
     assert abs(got - want) / want < 1e-12
+
+
+def test_streaming_unfenced_matches_fenced():
+    from pumiumtally_tpu import StreamingTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n, chunk = 3000, 1024
+    rng = np.random.default_rng(22)
+    traj = [rng.uniform(0.05, 0.95, (n, 3)) for _ in range(4)]
+    out = []
+    for fenced in (True, False):
+        t = StreamingTally(
+            mesh, n, chunk_size=chunk,
+            config=TallyConfig(fenced_timing=fenced, check_found_all=False),
+        )
+        t.CopyInitialPosition(traj[0].reshape(-1).copy())
+        for m in range(1, 4):
+            t.MoveToNextLocation(traj[m - 1].reshape(-1).copy(),
+                                 traj[m].reshape(-1).copy(),
+                                 np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t.flux), t.positions))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+
+
+def test_streaming_unfenced_recycled_buffers_safe():
+    """An unfenced call returns with walks in flight; a host that
+    immediately overwrites its (f64, view-aliasable) buffers must not
+    corrupt the queued chunks — staging owns its memory when unfenced."""
+    from pumiumtally_tpu import StreamingTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n, chunk = 3000, 1024
+    rng = np.random.default_rng(23)
+    traj = [rng.uniform(0.05, 0.95, (n, 3)) for _ in range(4)]
+    t = StreamingTally(
+        mesh, n, chunk_size=chunk,
+        config=TallyConfig(fenced_timing=False, check_found_all=False,
+                           auto_continue=False),
+    )
+    obuf, dbuf = np.empty(3 * n), np.empty(3 * n)
+    obuf[:] = traj[0].reshape(-1)
+    t.CopyInitialPosition(obuf)
+    obuf[:] = -1e30  # clobber immediately, walks may still be queued
+    for m in range(1, 4):
+        obuf[:] = traj[m - 1].reshape(-1)
+        dbuf[:] = traj[m].reshape(-1)
+        t.MoveToNextLocation(obuf, dbuf, np.ones(n, np.int8), np.ones(n))
+        obuf[:] = -1e30  # recycle: clobber both before the next use
+        dbuf[:] = -1e30
+    got = float(np.sum(np.asarray(t.flux)))
+    want = sum(float(np.linalg.norm(traj[m] - traj[m - 1], axis=1).sum())
+               for m in range(1, 4))
+    assert abs(got - want) / want < 1e-12
