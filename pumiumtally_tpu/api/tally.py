@@ -129,6 +129,13 @@ def zero_flying_side_effect(flying, n: int) -> None:
             )
 
 
+@partial(jax.jit, static_argnames=("tol",))
+def _locate_step(mesh, pts, *, tol):
+    from pumiumtally_tpu.ops.geometry import locate_by_planes
+
+    return locate_by_planes(mesh.face_normals, mesh.face_offsets, pts, tol)
+
+
 @partial(jax.jit, static_argnames=("tol", "max_iters"))
 def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
     n = x.shape[0]
@@ -389,7 +396,7 @@ class PumiTally:
         self.tally_times.initialization_time += time.perf_counter() - t0
 
     def _dispatch_localize(self, dest: jnp.ndarray):
-        """Run the non-tallying localization walk on [n]-shaped staged
+        """Run the non-tallying localization on [n]-shaped staged
         destinations. Returns (found_all, n_exited) — lazily evaluated
         scalars (only fetched when check_found_all is on)."""
         dest = self._pad_particles(dest, self.x)
@@ -400,11 +407,32 @@ class PumiTally:
                 self.device_mesh, self.mesh, self.x, self.elem, dest,
                 tol=self._tol, max_iters=self._max_iters,
             )
-        else:
-            self.x, self.elem, done, exited = _localize_step(
-                self.mesh, self.x, self.elem, dest,
-                tol=self._tol, max_iters=self._max_iters,
-            )
+            return jnp.all(done), jnp.sum(exited)
+        if self.config.localization == "locate":
+            return self._localize_by_planes(dest)
+        self.x, self.elem, done, exited = _localize_step(
+            self.mesh, self.x, self.elem, dest,
+            tol=self._tol, max_iters=self._max_iters,
+        )
+        return jnp.all(done), jnp.sum(exited)
+
+    def _localize_by_planes(self, dest: jnp.ndarray):
+        """TallyConfig.localization="locate": direct MXU point location
+        (one half-space matmul pass instead of an O(mesh-diameter)
+        walk). Points located in no element keep walking from the
+        CURRENT committed state exactly as "walk" mode would (clamping
+        at the hull); located particles enter that walk already at
+        their destination, so it retires them on its first iteration
+        group. No host sync, no branch — the masked walk is dispatched
+        unconditionally and is near-free when everything was located."""
+        elem0 = _locate_step(self.mesh, dest, tol=self._tol)
+        missing = elem0 < 0
+        x = jnp.where(missing[:, None], self.x, dest)
+        elem = jnp.where(missing, self.elem, elem0)
+        self.x, self.elem, done, exited = _localize_step(
+            self.mesh, x, elem, dest,
+            tol=self._tol, max_iters=self._max_iters,
+        )
         return jnp.all(done), jnp.sum(exited)
 
     def MoveToNextLocation(

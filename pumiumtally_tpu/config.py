@@ -92,6 +92,19 @@ class TallyConfig:
     check_found_all: bool = True
     auto_continue: bool = True
     fenced_timing: bool = True
+    # "walk" reproduces the reference's localization exactly (walk from
+    # the committed state — initially element 0's centroid,
+    # PumiTallyImpl.cpp:195-221 — including the clamp-to-hull for
+    # out-of-domain sources). "locate" runs the MXU-shaped half-space
+    # point-location first (one [C,3]x[3,4E] matmul per chunk — the
+    # same kernel the partitioned engine always uses); located points
+    # enter the follow-up masked walk already at their destination (it
+    # retires them immediately), while unlocated points walk from the
+    # committed state and clamp exactly as "walk" mode would. Net:
+    # O(mesh diameter) walk iterations become one matmul pass.
+    # Monolithic engine only — the sharded facade keeps the walk, the
+    # partitioned facade already locates.
+    localization: str = "walk"
     # NOTE: the reference's migration cadence (``iter_count % 100``,
     # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU
     # partitioned engine migrates a particle exactly when it pauses at a
@@ -102,6 +115,13 @@ class TallyConfig:
     capacity_factor: float = 1.5
     max_migration_rounds: int = 64
     output_filename: str = "fluxresult.vtk"
+
+    def __post_init__(self) -> None:
+        if self.localization not in ("walk", "locate"):
+            raise ValueError(
+                "localization must be 'walk' or 'locate', "
+                f"got {self.localization!r}"
+            )
 
     def resolved_dtype(self) -> Any:
         return self.dtype if self.dtype is not None else default_float_dtype()

@@ -8,7 +8,10 @@ equivalents.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
+from jax import lax
 
 
 def tet_volumes(coords: jnp.ndarray, tet2vert: jnp.ndarray) -> jnp.ndarray:
@@ -47,6 +50,60 @@ def contains(
     """Boolean [N]: is point p[n] inside tet elem[n] (within tol)."""
     lam = barycentric(coords, tet2vert, elem, p)
     return jnp.all(lam >= -tol, axis=1)
+
+
+def locate_chunk_by_planes(
+    nmat: jnp.ndarray,  # [4E,3] face normals, row-major per element
+    fo: jnp.ndarray,  # [E,4] face offsets
+    valid: Optional[jnp.ndarray],  # [E] bool mask (None = all valid)
+    pts: jnp.ndarray,  # [C,3]
+    tol: float,
+) -> jnp.ndarray:
+    """Containing element id [C] (−1 = none) by half-space tests.
+
+    A point is inside a tet iff it is on the inner side of all four
+    face planes; the test over every element is ONE [C,3]×[3,4E]
+    matmul — MXU-shaped, no gather — then a compare-and-reduce. Ties
+    (points within tol of a shared face) go to the lowest element id
+    via argmax-of-first-True: deterministic. Shared by the partitioned
+    engine's sharded localization (parallel/partition.py) and the
+    monolithic locate path below.
+    """
+    proj = pts @ nmat.T  # [C, 4E]
+    ok = (proj.reshape(pts.shape[0], -1, 4) <= fo[None] + tol).all(axis=2)
+    if valid is not None:
+        ok = ok & valid[None, :]
+    found = ok.any(axis=1)
+    le = jnp.argmax(ok, axis=1).astype(jnp.int32)
+    return jnp.where(found, le, -1)
+
+
+def locate_by_planes(
+    face_normals: jnp.ndarray,  # [E,4,3]
+    face_offsets: jnp.ndarray,  # [E,4]
+    pts: jnp.ndarray,  # [N,3]
+    tol: float,
+    chunk: Optional[int] = None,
+) -> jnp.ndarray:
+    """Containing element id [N] (−1 = none) for arbitrary point sets,
+    chunked so the [C,4E] intermediate stays ≤ ~128 MB f32 regardless
+    of mesh size (same bound as the partitioned engine's locate)."""
+    n = pts.shape[0]
+    ne = face_offsets.shape[0]
+    nmat = face_normals.reshape(ne * 4, 3)
+    c = chunk or max(8, min(2048, (1 << 23) // max(ne, 1)))
+    c = min(c, max(n, 1))
+    m = -(-n // c) * c
+    if m > n:
+        # Far-away pad points: inside no element.
+        pts = jnp.concatenate(
+            [pts, jnp.full((m - n, 3), 2e30, pts.dtype)]
+        )
+    out = lax.map(
+        lambda p: locate_chunk_by_planes(nmat, face_offsets, None, p, tol),
+        pts.reshape(-1, c, 3),
+    )
+    return out.reshape(-1)[:n]
 
 
 def locate_bruteforce(

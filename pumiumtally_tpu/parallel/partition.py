@@ -62,6 +62,7 @@ from pumiumtally_tpu.mesh.tetmesh import (
     WALK_TABLE_NORMALS,
     WALK_TABLE_OFFSETS,
 )
+from pumiumtally_tpu.ops.geometry import locate_chunk_by_planes
 from pumiumtally_tpu.ops.walk import fused_tally_body
 from pumiumtally_tpu.parallel.sharded import _axis_name
 
@@ -406,23 +407,17 @@ def _locate_chunk(
     pts: jnp.ndarray,  # [C,3]
     tol: float,
 ) -> jnp.ndarray:
-    """Local element containing each point, or -1.
-
-    A point is inside a tet iff it is on the inner side of all four
-    face planes. The test over every local element is one [C,3]×[3,4L]
-    matmul — MXU-shaped, no gather — followed by a compare-and-reduce.
-    Ties (points within tol of a shared face) go to the lowest local id
-    via argmax-of-first-True: deterministic.
-    """
+    """Local element containing each point, or -1 — the shared
+    half-space matmul test (ops.geometry.locate_chunk_by_planes) over
+    this chip's slice of the walk table."""
     L = table.shape[0]
-    nmat = table[:, WALK_TABLE_NORMALS].reshape(L * 4, 3)
-    fo = table[:, WALK_TABLE_OFFSETS]  # [L,4]
-    proj = pts @ nmat.T  # [C, 4L]
-    ok = (proj.reshape(pts.shape[0], L, 4) <= fo[None] + tol).all(axis=2)
-    ok = ok & valid[None, :]
-    found = ok.any(axis=1)
-    le = jnp.argmax(ok, axis=1).astype(jnp.int32)
-    return jnp.where(found, le, -1)
+    return locate_chunk_by_planes(
+        table[:, WALK_TABLE_NORMALS].reshape(L * 4, 3),
+        table[:, WALK_TABLE_OFFSETS],
+        valid,
+        pts,
+        tol,
+    )
 
 
 # ---------------------------------------------------------------------------

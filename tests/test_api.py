@@ -136,3 +136,87 @@ def test_native_create_engine_selection(monkeypatch, tmp_path):
     monkeypatch.setenv("PUMIUMTALLY_ENGINE", "bogus")
     with pytest.raises(ValueError, match="PUMIUMTALLY_ENGINE"):
         native_create(mesh_path, 50)
+
+
+def test_locate_localization_matches_walk():
+    """TallyConfig.localization="locate": MXU point location agrees
+    with the reference-style walk localization, including the
+    out-of-hull clamp fallback, and the subsequent tallied move is
+    bit-identical."""
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, 5, 5, 5)
+    n = 3000
+    rng = np.random.default_rng(71)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    src[::7] += 3.0  # every 7th source outside the hull → clamp path
+    dest = rng.uniform(0.05, 0.95, (n, 3))
+
+    out = []
+    for how in ("walk", "locate"):
+        t = PumiTally(mesh, n, TallyConfig(localization=how))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        pos_after_localize = t.positions.copy()
+        elems = t.elem_ids.copy()
+        t.MoveToNextLocation(None, dest.reshape(-1).copy())
+        out.append((pos_after_localize, elems, np.asarray(t.flux),
+                    t.positions))
+    np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
+    np.testing.assert_allclose(out[0][3], out[1][3], atol=1e-12)
+
+
+def test_locate_localization_interior_fast_path():
+    """All-interior sources take the no-walk path: committed positions
+    equal the staged sources bit-exactly and elements match the
+    brute-force oracle."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.ops import geometry
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    n = 500
+    rng = np.random.default_rng(72)
+    src = rng.uniform(0.02, 0.98, (n, 3))
+    t = PumiTally(mesh, n, TallyConfig(localization="locate"))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    want = geometry.locate_bruteforce(
+        mesh.coords, mesh.tet2vert,
+        jnp.asarray(src, mesh.coords.dtype), tol=t._tol,
+    )
+    np.testing.assert_array_equal(t.elem_ids, np.asarray(want))
+    np.testing.assert_array_equal(
+        t.positions, np.asarray(src, t.positions.dtype)
+    )
+
+
+def test_locate_localization_relocalize_and_validation():
+    """Re-localizing mid-run walks unlocated points from the COMMITTED
+    state (as walk mode does), and bad localization values are
+    rejected at config construction."""
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+    with pytest.raises(ValueError, match="localization"):
+        TallyConfig(localization="Locate")
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 400
+    rng = np.random.default_rng(73)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    d1 = rng.uniform(0.1, 0.9, (n, 3))
+    # second-batch sources: some outside the hull (clamp path from the
+    # committed positions, which differ per particle by now)
+    src2 = rng.uniform(0.1, 0.9, (n, 3))
+    src2[::5] += 2.5
+
+    out = []
+    for how in ("walk", "locate"):
+        t = PumiTally(mesh, n, TallyConfig(localization=how))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        t.CopyInitialPosition(src2.reshape(-1).copy())
+        out.append((t.positions, t.elem_ids))
+    np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
+    np.testing.assert_array_equal(out[0][1], out[1][1])
