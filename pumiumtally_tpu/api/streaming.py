@@ -39,6 +39,7 @@ from pumiumtally_tpu.api.tally import (
     _move_step,
     _move_step_continue,
     host_positions,
+    locate_or_committed,
     zero_flying_side_effect,
 )
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
@@ -261,11 +262,19 @@ class StreamingTally(PumiTally):
                 self.device_mesh, self.mesh, self._x[k], self._elem[k],
                 dest, tol=self._tol, max_iters=self._max_iters,
             )
-        else:
-            self._x[k], self._elem[k], done, _ = _localize_step(
-                self.mesh, self._x[k], self._elem[k], dest,
-                tol=self._tol, max_iters=self._max_iters,
+            return done
+        x, elem = self._x[k], self._elem[k]
+        if self.config.localization == "locate":
+            # MXU point location per chunk; unlocated points keep
+            # walking from the committed state (shared pre-pass with
+            # PumiTally._localize_by_planes).
+            x, elem = locate_or_committed(
+                self.mesh, x, elem, dest, tol=self._tol
             )
+        self._x[k], self._elem[k], done, _ = _localize_step(
+            self.mesh, x, elem, dest,
+            tol=self._tol, max_iters=self._max_iters,
+        )
         return done
 
     def _chunk_move(self, k: int, orig, dest, fly, w):

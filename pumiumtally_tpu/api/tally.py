@@ -136,6 +136,19 @@ def _locate_step(mesh, pts, *, tol):
     return locate_by_planes(mesh.face_normals, mesh.face_offsets, pts, tol)
 
 
+def locate_or_committed(mesh, x, elem, dest, *, tol):
+    """Shared locate-mode pre-pass (monolithic + streaming facades):
+    MXU point location of ``dest``; located particles adopt (dest,
+    element) so the follow-up masked walk retires them immediately,
+    unlocated ones keep their committed (x, elem) and walk/clamp."""
+    e0 = _locate_step(mesh, dest, tol=tol)
+    missing = e0 < 0
+    return (
+        jnp.where(missing[:, None], x, dest),
+        jnp.where(missing, elem, e0),
+    )
+
+
 @partial(jax.jit, static_argnames=("tol", "max_iters"))
 def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
     n = x.shape[0]
@@ -425,10 +438,9 @@ class PumiTally:
         their destination, so it retires them on its first iteration
         group. No host sync, no branch — the masked walk is dispatched
         unconditionally and is near-free when everything was located."""
-        elem0 = _locate_step(self.mesh, dest, tol=self._tol)
-        missing = elem0 < 0
-        x = jnp.where(missing[:, None], self.x, dest)
-        elem = jnp.where(missing, self.elem, elem0)
+        x, elem = locate_or_committed(
+            self.mesh, self.x, self.elem, dest, tol=self._tol
+        )
         self.x, self.elem, done, exited = _localize_step(
             self.mesh, x, elem, dest,
             tol=self._tol, max_iters=self._max_iters,

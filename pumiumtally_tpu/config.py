@@ -101,9 +101,10 @@ class TallyConfig:
     # enter the follow-up masked walk already at their destination (it
     # retires them immediately), while unlocated points walk from the
     # committed state and clamp exactly as "walk" mode would. Net:
-    # O(mesh diameter) walk iterations become one matmul pass.
-    # Monolithic engine only — the sharded facade keeps the walk, the
-    # partitioned facade already locates.
+    # O(mesh diameter) walk iterations become one matmul pass. Applies
+    # to the monolithic engine and (chunk-wise) the plain streaming
+    # facade; the sharded facade keeps the walk, the partitioned
+    # facades already locate.
     localization: str = "walk"
     # NOTE: the reference's migration cadence (``iter_count % 100``,
     # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU

@@ -91,7 +91,9 @@ def locate_by_planes(
     n = pts.shape[0]
     ne = face_offsets.shape[0]
     nmat = face_normals.reshape(ne * 4, 3)
-    c = chunk or max(8, min(2048, (1 << 23) // max(ne, 1)))
+    # No floor: memory is the binding constraint, so on meshes past ~8M
+    # elements the chunk legitimately degrades to one point at a time.
+    c = chunk or max(1, min(2048, (1 << 23) // max(ne, 1)))
     c = min(c, max(n, 1))
     m = -(-n // c) * c
     if m > n:

@@ -294,3 +294,24 @@ def test_streaming_unfenced_recycled_buffers_safe():
     want = sum(float(np.linalg.norm(traj[m] - traj[m - 1], axis=1).sum())
                for m in range(1, 4))
     assert abs(got - want) / want < 1e-12
+
+
+def test_streaming_locate_localization_matches_walk():
+    from pumiumtally_tpu import StreamingTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n, chunk = 3000, 1024
+    rng = np.random.default_rng(24)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    src[::11] += 2.0  # some out-of-hull -> clamp path
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for how in ("walk", "locate"):
+        t = StreamingTally(mesh, n, chunk_size=chunk,
+                           config=TallyConfig(localization=how))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        out.append((t.positions, t.elem_ids, np.asarray(t.flux)))
+    np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
