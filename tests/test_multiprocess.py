@@ -61,3 +61,4 @@ def test_two_process_distributed_tally():
         out = log.read()
         assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-2000:]}"
         assert f"proc {pid}: devices=8" in out
+        assert f"proc {pid}: partitioned flux=" in out

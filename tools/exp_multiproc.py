@@ -60,6 +60,25 @@ def worker(pid: int) -> None:
     print(f"proc {pid}: devices={len(jax.devices())} "
           f"flux={total:.6f} rel_err={rel:.2e}", flush=True)
     assert rel < 1e-6
+
+    # Partitioned mode across the SAME two-process mesh: element
+    # ownership + particle migration, with the migration collectives
+    # crossing the process boundary (the reference's MPI-rank mode,
+    # never tested by its own CI).
+    from pumiumtally_tpu import PartitionedPumiTally
+
+    pt = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(device_mesh=mesh_dev, check_found_all=False,
+                    capacity_factor=8.0),
+    )
+    pt.CopyInitialPosition(src.reshape(-1).copy())
+    pt.MoveToNextLocation(None, dst.reshape(-1).copy())
+    ptotal = float(jnp.sum(pt.flux))
+    prel = abs(ptotal - expect) / expect
+    print(f"proc {pid}: partitioned flux={ptotal:.6f} rel_err={prel:.2e}",
+          flush=True)
+    assert prel < 1e-6
     jax.distributed.shutdown()
 
 
