@@ -220,3 +220,37 @@ def test_locate_localization_relocalize_and_validation():
         out.append((t.positions, t.elem_ids))
     np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
     np.testing.assert_array_equal(out[0][1], out[1][1])
+
+
+def test_locate_localization_degenerate_sources_contained():
+    """Sources exactly on faces/edges/vertices: the located element may
+    legitimately differ from the walk's (tolerance tie), but it must
+    CONTAIN the point and transport from it must conserve."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.ops import geometry
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    # grid nodes, face centers, and edge midpoints of the 4x4x4 lattice
+    g = np.linspace(0, 1, 5)
+    nodes = np.array(np.meshgrid(g, g, g)).reshape(3, -1).T
+    mids = np.array(np.meshgrid(g[:-1] + 0.125, g, g)).reshape(3, -1).T
+    src = np.vstack([nodes, mids])
+    n = src.shape[0]
+
+    t = PumiTally(mesh, n, TallyConfig(localization="locate"))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    ids = t.elem_ids
+    assert np.all(ids >= 0)
+    inside = geometry.contains(
+        mesh.coords, mesh.tet2vert, jnp.asarray(ids),
+        jnp.asarray(src, mesh.coords.dtype), tol=1e-9,
+    )
+    assert bool(jnp.all(inside))
+
+    dest = np.clip(src + 0.2, 0.01, 0.99)
+    t.MoveToNextLocation(None, dest.reshape(-1).copy())
+    got = float(np.sum(np.asarray(t.flux)))
+    want = float(np.linalg.norm(dest - src, axis=1).sum())
+    assert abs(got - want) / want < 1e-12
