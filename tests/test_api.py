@@ -232,11 +232,16 @@ def test_locate_localization_degenerate_sources_contained():
     from pumiumtally_tpu.ops import geometry
 
     mesh = build_box(1, 1, 1, 4, 4, 4)
-    # grid nodes, face centers, and edge midpoints of the 4x4x4 lattice
+    # Grid nodes, edge midpoints in ALL three directions, and cube-face
+    # centers (which lie on face diagonals of the 6-tet decomposition —
+    # a distinct degeneracy class) of the 4x4x4 lattice.
     g = np.linspace(0, 1, 5)
-    nodes = np.array(np.meshgrid(g, g, g)).reshape(3, -1).T
-    mids = np.array(np.meshgrid(g[:-1] + 0.125, g, g)).reshape(3, -1).T
-    src = np.vstack([nodes, mids])
+    h = g[:-1] + 0.125  # cell midlines
+    grids = [(g, g, g), (h, g, g), (g, h, g), (g, g, h),
+             (h, h, g), (h, g, h), (g, h, h)]
+    src = np.vstack([
+        np.array(np.meshgrid(*axes)).reshape(3, -1).T for axes in grids
+    ])
     n = src.shape[0]
 
     t = PumiTally(mesh, n, TallyConfig(localization="locate"))
