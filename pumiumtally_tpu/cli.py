@@ -92,6 +92,26 @@ def cmd_pincell(args) -> None:
           f"{len(tets)} tets ({nf} fuel / {len(tets) - nf} moderator)")
 
 
+def cmd_lattice(args) -> None:
+    """Generate an nx×ny pincell assembly (BASELINE configs[1-2] scale
+    class) as an .osh directory with class_id (material) and cell_id
+    element tags."""
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.pincell import lattice_arrays
+
+    coords, tets, region, cell_id = lattice_arrays(
+        args.nx, args.ny,
+        pitch=args.pitch, fuel_radius=args.fuel_radius, height=args.height,
+        n_theta=args.n_theta, n_rings_fuel=args.rings_fuel,
+        n_rings_pad=args.rings_pad, nz=args.nz,
+    )
+    write_osh(args.output, coords, tets,
+              elem_tags={"class_id": region.astype(np.int32),
+                         "cell_id": cell_id.astype(np.int32)})
+    print(f"wrote {args.output}: {coords.shape[0]} vertices, "
+          f"{len(tets)} tets, {args.nx}x{args.ny} cells")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         prog="pumiumtally",
@@ -124,18 +144,32 @@ def main(argv=None) -> None:
     c.add_argument("--nz", type=int, default=10)
     c.set_defaults(fn=cmd_box)
 
+    # Shared pin-geometry options (one definition; pincell and lattice
+    # stay in lockstep).
+    pin_opts = argparse.ArgumentParser(add_help=False)
+    pin_opts.add_argument("--pitch", type=float, default=1.26)
+    pin_opts.add_argument("--fuel-radius", type=float, default=0.4095)
+    pin_opts.add_argument("--height", type=float, default=1.0)
+    pin_opts.add_argument("--n-theta", type=int, default=16)
+    pin_opts.add_argument("--rings-fuel", type=int, default=3)
+    pin_opts.add_argument("--rings-pad", type=int, default=3)
+    pin_opts.add_argument("--nz", type=int, default=4)
+
     c = sub.add_parser(
-        "pincell", help="generate the pincell benchmark mesh (O-grid)"
+        "pincell", help="generate the pincell benchmark mesh (O-grid)",
+        parents=[pin_opts],
     )
     c.add_argument("output")
-    c.add_argument("--pitch", type=float, default=1.26)
-    c.add_argument("--fuel-radius", type=float, default=0.4095)
-    c.add_argument("--height", type=float, default=1.0)
-    c.add_argument("--n-theta", type=int, default=16)
-    c.add_argument("--rings-fuel", type=int, default=3)
-    c.add_argument("--rings-pad", type=int, default=3)
-    c.add_argument("--nz", type=int, default=4)
     c.set_defaults(fn=cmd_pincell)
+
+    c = sub.add_parser(
+        "lattice", help="generate an nx×ny pincell assembly mesh",
+        parents=[pin_opts],
+    )
+    c.add_argument("output")
+    c.add_argument("--nx", type=int, default=17)
+    c.add_argument("--ny", type=int, default=17)
+    c.set_defaults(fn=cmd_lattice)
 
     args = p.parse_args(argv)
     args.fn(args)

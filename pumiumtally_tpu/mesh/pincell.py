@@ -35,23 +35,15 @@ def _square_point(theta: np.ndarray, half: float) -> np.ndarray:
     return half * np.stack([c / m, s / m], axis=-1)
 
 
-def pincell_arrays(
-    pitch: float = 1.26,
-    fuel_radius: float = 0.4095,
-    height: float = 1.0,
-    n_theta: int = 16,
-    n_rings_fuel: int = 3,
-    n_rings_pad: int = 3,
-    nz: int = 4,
+def _ogrid_2d(
+    pitch: float,
+    fuel_radius: float,
+    n_theta: int,
+    n_rings_fuel: int,
+    n_rings_pad: int,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(coords[V,3], tet2vert[E,4], region[E]) for a single pincell.
-
-    n_theta sectors around the pin (multiple of 8 keeps the square's
-    corners on sector boundaries), n_rings_fuel rings inside the fuel,
-    n_rings_pad transition rings from the fuel surface to the square
-    boundary, nz extruded layers. Tet count: 3*nz*n_theta*(2*(n_rings_
-    fuel+n_rings_pad) - 1).
-    """
+    """One cell's 2-D O-grid: (pts2[V2,2] pin-centered, tris[T,3],
+    tri_region[T] 0 fuel / 1 moderator)."""
     if n_theta % 8:
         # The square's corners sit at 45°+k·90°; sector boundaries land
         # on them only when n_theta is a multiple of 8 — otherwise the
@@ -60,11 +52,10 @@ def pincell_arrays(
         raise ValueError("n_theta must be a multiple of 8")
     if 2 * fuel_radius >= pitch:
         raise ValueError("fuel diameter must be smaller than the pitch")
-    if n_rings_fuel < 1 or n_rings_pad < 1 or nz < 1:
+    if n_rings_fuel < 1 or n_rings_pad < 1:
         # Zero fuel rings mislabels the center fan, zero pad rings
-        # drops the moderator (mesh no longer fills the cell), zero
-        # layers is no mesh at all.
-        raise ValueError("n_rings_fuel, n_rings_pad, and nz must be >= 1")
+        # drops the moderator (mesh no longer fills the cell).
+        raise ValueError("n_rings_fuel and n_rings_pad must be >= 1")
     half = pitch / 2.0
     theta = np.arange(n_theta) * (2 * np.pi / n_theta)
 
@@ -78,7 +69,6 @@ def pincell_arrays(
     for s in np.linspace(0.0, 1.0, n_rings_pad + 1)[1:]:
         pts2.append((1.0 - s) * circ + s * sq)
     pts2 = np.concatenate(pts2, axis=0)
-    nv2 = pts2.shape[0]
     nrings = n_rings_fuel + n_rings_pad
 
     def ring_vert(j: int, k: int) -> int:
@@ -99,53 +89,182 @@ def pincell_arrays(
             tris.append([a, b, d])
             tris.append([a, d, c])
             tri_region.extend([reg, reg])
-    tris = np.asarray(tris, np.int64)
-    tri_region = np.asarray(tri_region, np.int64)
+    return (
+        pts2,
+        np.asarray(tris, np.int64),
+        np.asarray(tri_region, np.int64),
+    )
 
-    # Extrude: layer l vertex = 2-D vertex + l*nv2. The cell sits in
-    # [0,pitch]^2 x [0,height] (corner origin — shared by every
-    # consumer; the O-grid itself is built pin-centered).
-    pts2 = pts2 + half
+
+def _extrude_prisms(
+    pts2: np.ndarray,
+    tris: np.ndarray,
+    tri_labels: np.ndarray,  # [T, L] any per-triangle labels to replicate
+    height: float,
+    nz: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Extrude a 2-D triangulation into tets: every prism splits into 3
+    by the smallest-GLOBAL-vertex diagonal rule (Dompierre et al.), so
+    diagonals on shared quad faces agree between neighboring prisms —
+    including prisms from different lattice cells — and the mesh is
+    conforming by construction. Returns (coords, tet2vert, labels[E,L]).
+    """
+    if nz < 1:
+        raise ValueError("nz must be >= 1")
+    nv2 = pts2.shape[0]
     zs = np.linspace(0.0, height, nz + 1)
     coords = np.concatenate(
-        [
-            np.concatenate(
-                [pts2, np.full((nv2, 1), z)], axis=1
-            )
-            for z in zs
-        ],
+        [np.concatenate([pts2, np.full((nv2, 1), z)], axis=1) for z in zs],
         axis=0,
     )
-
-    # Prism → 3 tets, smallest-vertex diagonal rule (conforming).
-    tets = []
-    region = []
-    for layer in range(nz):
-        lo = layer * nv2
-        hi = (layer + 1) * nv2
-        for t, reg in zip(tris, tri_region):
-            v = np.array([lo + t[0], lo + t[1], lo + t[2],
-                          hi + t[0], hi + t[1], hi + t[2]], np.int64)
-            # Rotate so the globally smallest bottom/top pair is first.
-            rot = int(np.argmin([min(v[0], v[3]), min(v[1], v[4]),
-                                 min(v[2], v[5])]))
-            order = [rot, (rot + 1) % 3, (rot + 2) % 3]
-            v = v[order + [o + 3 for o in order]]
-            if min(v[1], v[5]) < min(v[2], v[4]):
-                new = [(v[0], v[1], v[2], v[5]),
-                       (v[0], v[1], v[5], v[4]),
-                       (v[0], v[4], v[5], v[3])]
-            else:
-                new = [(v[0], v[1], v[2], v[4]),
-                       (v[0], v[4], v[2], v[5]),
-                       (v[0], v[4], v[5], v[3])]
-            tets.extend(new)
-            region.extend([reg] * 3)
+    # All nz·T prisms at once (the per-prism Python loop was the
+    # generation bottleneck at assembly scale — ~1M tets).
+    tris = np.asarray(tris, np.int64)
+    layers = np.arange(nz, dtype=np.int64)[:, None, None] * nv2
+    bot = (tris[None, :, :] + layers).reshape(-1, 3)  # [P,3]
+    v = np.concatenate([bot, bot + nv2], axis=1)  # [P,6]
+    # Rotate so the globally smallest bottom/top pair is first.
+    rot = np.argmin(np.minimum(v[:, 0:3], v[:, 3:6]), axis=1)  # [P]
+    o = (rot[:, None] + np.arange(3)[None, :]) % 3  # [P,3]
+    v = np.take_along_axis(v, np.concatenate([o, o + 3], axis=1), axis=1)
+    # Diagonal choice on the far quad face (Dompierre rule).
+    left = np.minimum(v[:, 1], v[:, 5]) < np.minimum(v[:, 2], v[:, 4])
+    split_a = v[:, [0, 1, 2, 5,   0, 1, 5, 4,   0, 4, 5, 3]]
+    split_b = v[:, [0, 1, 2, 4,   0, 4, 2, 5,   0, 4, 5, 3]]
+    tets = np.where(left[:, None], split_a, split_b).reshape(-1, 4)
+    labels = np.repeat(
+        np.tile(np.asarray(tri_labels), (nz, 1)), 3, axis=0
+    )
     return (
         np.asarray(coords, np.float64),
-        np.asarray(tets, np.int32),
-        np.asarray(region, np.int32),
+        tets.astype(np.int32),
+        labels.astype(np.int32),
     )
+
+
+def pincell_arrays(
+    pitch: float = 1.26,
+    fuel_radius: float = 0.4095,
+    height: float = 1.0,
+    n_theta: int = 16,
+    n_rings_fuel: int = 3,
+    n_rings_pad: int = 3,
+    nz: int = 4,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(coords[V,3], tet2vert[E,4], region[E]) for a single pincell.
+
+    n_theta sectors around the pin (multiple of 8 keeps the square's
+    corners on sector boundaries), n_rings_fuel rings inside the fuel,
+    n_rings_pad transition rings from the fuel surface to the square
+    boundary, nz extruded layers. Tet count: 3*nz*n_theta*(2*(n_rings_
+    fuel+n_rings_pad) - 1).
+    """
+    pts2, tris, tri_region = _ogrid_2d(
+        pitch, fuel_radius, n_theta, n_rings_fuel, n_rings_pad
+    )
+    # The cell sits in [0,pitch]^2 x [0,height] (corner origin — shared
+    # by every consumer; the O-grid itself is built pin-centered).
+    coords, tets, labels = _extrude_prisms(
+        pts2 + pitch / 2.0, tris, tri_region[:, None], height, nz
+    )
+    return coords, tets, labels[:, 0]
+
+
+def lattice_arrays(
+    nx: int,
+    ny: int,
+    pitch: float = 1.26,
+    fuel_radius: float = 0.4095,
+    height: float = 1.0,
+    n_theta: int = 16,
+    n_rings_fuel: int = 3,
+    n_rings_pad: int = 3,
+    nz: int = 4,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(coords[V,3], tet2vert[E,4], region[E], cell_id[E]) for an
+    nx×ny pincell lattice (a fuel-assembly slab) in
+    [0, nx·pitch]×[0, ny·pitch]×[0, height].
+
+    The reference's larger benchmark configs tally assemblies of
+    pincells on ~1M-tet unstructured meshes (BASELINE.json configs[1-2]
+    scale); this builds that geometry natively. Every cell reuses one
+    2-D O-grid pattern; coincident boundary vertices of neighboring
+    cells are WELDED in 2-D (their coordinates agree to float rounding;
+    the weld snaps them identical), and the single global extrusion
+    applies the smallest-global-vertex prism rule, so shared faces —
+    including cell-to-cell interfaces — triangulate identically from
+    both sides: the assembly is conforming by construction.
+    ``region`` is 0 fuel / 1 moderator; ``cell_id`` is j·nx+i.
+    """
+    if nx < 1 or ny < 1:
+        raise ValueError("nx and ny must be >= 1")
+    pts2, tris, tri_region = _ogrid_2d(
+        pitch, fuel_radius, n_theta, n_rings_fuel, n_rings_pad
+    )
+    half = pitch / 2.0
+    nv2 = pts2.shape[0]
+    all_pts = []
+    all_tris = []
+    all_lab = []
+    for j in range(ny):
+        for i in range(nx):
+            all_pts.append(pts2 + np.array([i * pitch + half,
+                                            j * pitch + half]))
+            off = (j * nx + i) * nv2
+            all_tris.append(tris + off)
+            all_lab.append(
+                np.stack(
+                    [tri_region,
+                     np.full_like(tri_region, j * nx + i)],
+                    axis=1,
+                )
+            )
+    pts = np.concatenate(all_pts, axis=0)
+    tris_all = np.concatenate(all_tris, axis=0)
+    labels = np.concatenate(all_lab, axis=0)
+
+    # Weld coincident 2-D vertices (cell-boundary points shared by
+    # neighbors agree to ~1e-16·pitch; interior spacings are orders of
+    # magnitude larger, so a coarse quantization cannot merge distinct
+    # points). First occurrence's coordinates win → exactly identical
+    # shared vertices.
+    quant = np.round(pts / (pitch * 1e-9)).astype(np.int64)
+    _, first, inverse = np.unique(
+        quant, axis=0, return_index=True, return_inverse=True
+    )
+    welded = pts[np.sort(first)]
+    # unique() orders by key; remap to first-occurrence order so vertex
+    # numbering stays cell-major (keeps the extrusion rule stable).
+    order = np.argsort(first)
+    rank_of_unique = np.empty_like(order)
+    rank_of_unique[order] = np.arange(order.shape[0])
+    vmap = rank_of_unique[inverse]
+    tris_w = vmap[tris_all]
+
+    coords, tets, labels3 = _extrude_prisms(
+        welded, tris_w, labels, height, nz
+    )
+    return coords, tets, labels3[:, 0], labels3[:, 1]
+
+
+def build_lattice(
+    nx: int,
+    ny: int,
+    pitch: float = 1.26,
+    fuel_radius: float = 0.4095,
+    height: float = 1.0,
+    n_theta: int = 16,
+    n_rings_fuel: int = 3,
+    n_rings_pad: int = 3,
+    nz: int = 4,
+    dtype=None,
+) -> Tuple[TetMesh, np.ndarray, np.ndarray]:
+    """(TetMesh, region[E], cell_id[E]) — validated nx×ny assembly."""
+    coords, tets, region, cell_id = lattice_arrays(
+        nx, ny, pitch, fuel_radius, height, n_theta, n_rings_fuel,
+        n_rings_pad, nz,
+    )
+    return TetMesh.from_arrays(coords, tets, dtype=dtype), region, cell_id
 
 
 def build_pincell(

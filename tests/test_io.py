@@ -430,3 +430,23 @@ def test_pvtu_explicit_nparts_writes_empty_trailing_pieces(tmp_path):
     with _pytest.raises(ValueError, match="nparts"):
         write_pvtu(str(tmp_path / "bad.pvtu"), coords, tets,
                    np.full(6, 5), nparts=2)
+
+
+def test_cli_lattice_generation(tmp_path, capsys):
+    from pumiumtally_tpu.cli import main as cli_main
+    from pumiumtally_tpu.io.osh import _read_stream
+
+    out = str(tmp_path / "asm.osh")
+    cli_main(["lattice", out, "--nx", "2", "--ny", "2", "--n-theta", "8",
+              "--rings-fuel", "2", "--rings-pad", "2", "--nz", "2"])
+    msg = capsys.readouterr().out
+    assert "2x2 cells" in msg
+    mesh = load_mesh(out)
+    np.testing.assert_allclose(
+        np.asarray(mesh.volumes).sum(), 4 * 1.26**2, rtol=1e-12
+    )
+    with open(out + "/0.osh", "rb") as f:
+        parsed = _read_stream(f)
+    cid = np.asarray(parsed["tags"][3]["cell_id"])
+    assert sorted(np.unique(cid).tolist()) == [0, 1, 2, 3]
+    assert cid.shape[0] == mesh.nelems
