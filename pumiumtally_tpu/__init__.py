@@ -23,6 +23,7 @@ Public surface mirrors the reference's three-call protocol
 from pumiumtally_tpu.config import TallyConfig
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.mesh.box import build_box
+from pumiumtally_tpu.mesh.pincell import build_lattice, build_pincell
 from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
@@ -33,6 +34,8 @@ __all__ = [
     "TallyConfig",
     "TetMesh",
     "build_box",
+    "build_lattice",
+    "build_pincell",
     "PumiTally",
     "PartitionedPumiTally",
     "StreamingPartitionedTally",
