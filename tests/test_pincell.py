@@ -3,6 +3,7 @@
 import math
 
 import numpy as np
+import pytest
 
 from pumiumtally_tpu import PumiTally, TallyConfig
 from pumiumtally_tpu.mesh.pincell import build_pincell, pincell_arrays
@@ -134,3 +135,90 @@ def test_lattice_1x1_equals_pincell():
         np.asarray(m1.volumes).sum(), np.asarray(p1.volumes).sum(),
         rtol=1e-12,
     )
+
+
+def test_lattice_partitioned_matches_monolithic():
+    """Partitioned engine over the assembly geometry: RCB ownership of
+    the O-grid cells, migration across curved-ring interfaces; flux
+    matches the monolithic engine exactly."""
+    from pumiumtally_tpu import PartitionedPumiTally, PumiTally, TallyConfig
+    from pumiumtally_tpu.mesh.pincell import build_lattice
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh, region, cid = build_lattice(2, 2, n_theta=8, n_rings_fuel=2,
+                                      n_rings_pad=2, nz=2)
+    dm = make_device_mesh(4)
+    n = 2000
+    pitch = 1.26
+    rng = np.random.default_rng(32)
+    box = np.array([2 * pitch, 2 * pitch, 1.0])
+    src = rng.uniform(0.03, 0.97, (n, 3)) * box
+    dest = rng.uniform(0.03, 0.97, (n, 3)) * box
+
+    par = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=3.0)
+    )
+    par.CopyInitialPosition(src.reshape(-1).copy())
+    par.MoveToNextLocation(None, dest.reshape(-1).copy())
+
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(None, dest.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(par.flux), np.asarray(ref.flux), rtol=1e-11, atol=1e-13
+    )
+
+
+def test_label_reductions_on_lattice():
+    """Per-cell and per-material reductions recover analytic totals."""
+    from pumiumtally_tpu import PumiTally, TallyConfig
+    from pumiumtally_tpu.mesh.pincell import build_lattice
+    from pumiumtally_tpu.utils.postprocess import label_averages, label_totals
+
+    mesh, region, cid = build_lattice(3, 2, n_theta=8, n_rings_fuel=2,
+                                      n_rings_pad=2, nz=2)
+    n = 3000
+    pitch = 1.26
+    rng = np.random.default_rng(33)
+    box = np.array([3 * pitch, 2 * pitch, 1.0])
+    src = rng.uniform(0.03, 0.97, (n, 3)) * box
+    dest = rng.uniform(0.03, 0.97, (n, 3)) * box
+    t = PumiTally(mesh, n, TallyConfig(localization="locate"))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dest.reshape(-1).copy())
+
+    vols = np.asarray(mesh.volumes)
+    nflux = np.asarray(t.normalized_flux())
+    want_total = float(np.linalg.norm(dest - src, axis=1).sum())
+
+    per_cell = label_totals(nflux, vols, cid)
+    assert per_cell.shape[0] == 6
+    np.testing.assert_allclose(per_cell.sum(), want_total, rtol=1e-12)
+    per_mat = label_totals(nflux, vols, region)
+    np.testing.assert_allclose(per_mat.sum(), want_total, rtol=1e-12)
+
+    mean, lab_vols = label_averages(nflux, vols, cid)
+    np.testing.assert_allclose(lab_vols.sum(), vols.sum(), rtol=1e-12)
+    np.testing.assert_allclose(mean * lab_vols, per_cell, rtol=1e-12)
+
+    with pytest.raises(ValueError, match="entries"):
+        label_totals(nflux, vols, cid[:-1])
+    with pytest.raises(ValueError, match="non-negative"):
+        label_totals(nflux, vols, cid - 1)
+
+
+def test_label_reductions_validation_and_minlength():
+    from pumiumtally_tpu.utils.postprocess import label_totals
+
+    flux = np.array([1.0, 2.0])
+    vol = np.array([0.5, 0.5])
+    # float labels with exactly integral values are accepted
+    np.testing.assert_allclose(
+        label_totals(flux, vol, np.array([0.0, 1.0])), [0.5, 1.0]
+    )
+    # non-integral float labels are rejected, not truncated
+    with pytest.raises(ValueError, match="integral"):
+        label_totals(flux, vol, np.array([0.0, 1.5]))
+    # trailing empty labels keep their slots via num_labels
+    out = label_totals(flux, vol, np.array([0, 1]), num_labels=6)
+    assert out.shape[0] == 6 and out[2:].sum() == 0
