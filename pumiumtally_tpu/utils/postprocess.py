@@ -63,9 +63,14 @@ def label_averages(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(volume-weighted mean flux per label, total volume per label).
     Labels with zero volume report a zero mean (not NaN)."""
-    totals = label_totals(flux, volumes, labels, num_labels)
+    flux = np.asarray(flux, np.float64).reshape(-1)
     vol = np.asarray(volumes, np.float64).reshape(-1)
-    lab = _check(labels, vol.shape[0], "labels")
+    lab = _check(labels, flux.shape[0], "labels")
+    if vol.shape[0] != flux.shape[0]:
+        raise ValueError(
+            f"volumes has {vol.shape[0]} entries for {flux.shape[0]} elements"
+        )
+    totals = np.bincount(lab, weights=flux * vol, minlength=num_labels)
     vols = np.bincount(lab, weights=vol, minlength=num_labels)
     mean = np.divide(
         totals, vols, out=np.zeros_like(totals), where=vols > 0

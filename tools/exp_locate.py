@@ -1,0 +1,48 @@
+"""Localization A/B on the current backend: walk-from-centroid vs the
+MXU half-space locate (TallyConfig.localization), at bench scale.
+
+Usage: python tools/exp_locate.py [N] [DIV]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+
+def main():
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+    mesh = build_box(1, 1, 1, DIV, DIV, DIV)
+    rng = np.random.default_rng(0)
+    srcs = [rng.uniform(0.05, 0.95, (N, 3)) for _ in range(3)]
+
+    for how in ("walk", "locate"):
+        t = PumiTally(
+            mesh, N,
+            TallyConfig(localization=how, check_found_all=False),
+        )
+        t.CopyInitialPosition(srcs[0].reshape(-1).copy())  # compile
+        float(jnp.sum(jnp.asarray(t.elem)))  # sync
+        t0 = time.perf_counter()
+        for s in srcs[1:]:
+            t.CopyInitialPosition(s.reshape(-1).copy())
+        float(jnp.sum(jnp.asarray(t.elem)))
+        dt = (time.perf_counter() - t0) / (len(srcs) - 1)
+        print(f"localization={how}: {dt * 1e3:,.1f} ms per "
+              f"{N}-particle CopyInitialPosition "
+              f"({N / dt:,.0f} localizations/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
