@@ -9,8 +9,9 @@ tetrahedral meshes, re-architected for TPU hardware:
   XLA: jitted kernels, ``jax.device_put`` staging, deterministic
   scatter-adds instead of ``Kokkos::atomic_add``;
 - the PUMIPic adjacency-walk search (reference PumiTallyImpl.cpp:454)
-  becomes a masked lock-step ``lax.while_loop`` / Pallas kernel over
-  precomputed face-adjacency arrays;
+  becomes a masked lock-step ``lax.while_loop`` over a precomputed
+  packed walk table (a Pallas variant was analyzed and measured
+  unprofitable — docs/PERF_NOTES.md);
 - the MPI rank parallelism (reference PumiTallyImpl.cpp:111,145) becomes
   SPMD over a ``jax.sharding.Mesh``: particle batches sharded over the
   ``dp`` axis, per-element flux reduced with ``psum`` over ICI.

@@ -37,8 +37,9 @@ Lock-step waste is bounded by **active-particle compaction**: the walk
 runs as a cascade of stages with halving windows. Each stage iterates
 only over the first W particles; when the number of still-active
 particles drops to the next window size, survivors are sorted to the
-front (stable argsort on the done mask — a deterministic, XLA-friendly
-stand-in for the reference's stream compaction inside PUMIPic's rebuild)
+front (stable argsort on (done, element) — a deterministic, XLA-friendly
+stand-in for the reference's stream compaction inside PUMIPic's rebuild;
+the element grouping rides along for free)
 and the window halves. Without this, every iteration pays for the full
 batch while the slowest particle finishes (reference's search loop has
 the same property, SURVEY.md §3.3); with it, total work approaches
