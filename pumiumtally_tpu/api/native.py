@@ -15,6 +15,11 @@ reference selects its Kokkos backend at build time:
     PUMIUMTALLY_CAPACITY_FACTOR   partitioned slot over-provisioning
     PUMIUMTALLY_TOLERANCE         walk tolerance override
     PUMIUMTALLY_OUTPUT            default VTK output path
+    PUMIUMTALLY_LOCALIZATION      walk (default) | locate — see
+                                  TallyConfig.localization
+    PUMIUMTALLY_AUTO_CONTINUE     1 (default) | 0 — host staging dedup
+    PUMIUMTALLY_FENCED_TIMING     1 (default) | 0 — unfenced pipelined
+                                  dispatch
 """
 
 from __future__ import annotations
@@ -43,6 +48,15 @@ def native_create(mesh_filename: str, num_particles: int):
     out = os.environ.get("PUMIUMTALLY_OUTPUT")
     if out:
         kwargs["output_filename"] = out
+    loc = os.environ.get("PUMIUMTALLY_LOCALIZATION")
+    if loc:
+        kwargs["localization"] = loc.lower()
+    auto = os.environ.get("PUMIUMTALLY_AUTO_CONTINUE")
+    if auto is not None and auto != "":
+        kwargs["auto_continue"] = auto not in ("0", "false", "off")
+    fenced = os.environ.get("PUMIUMTALLY_FENCED_TIMING")
+    if fenced is not None and fenced != "":
+        kwargs["fenced_timing"] = fenced not in ("0", "false", "off")
     ndev = os.environ.get("PUMIUMTALLY_DEVICES")
     partitioned = engine in ("partitioned", "streaming_partitioned")
     if ndev or partitioned:

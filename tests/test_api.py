@@ -259,3 +259,24 @@ def test_locate_localization_degenerate_sources_contained():
     got = float(np.sum(np.asarray(t.flux)))
     want = float(np.linalg.norm(dest - src, axis=1).sum())
     assert abs(got - want) / want < 1e-12
+
+
+def test_native_create_new_config_envs(monkeypatch, tmp_path):
+    from pumiumtally_tpu.api.native import native_create
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    mesh_path = str(tmp_path / "m.osh")
+    write_osh(mesh_path, coords, tets)
+    monkeypatch.delenv("PUMIUMTALLY_ENGINE", raising=False)
+    monkeypatch.setenv("PUMIUMTALLY_LOCALIZATION", "locate")
+    monkeypatch.setenv("PUMIUMTALLY_AUTO_CONTINUE", "0")
+    monkeypatch.setenv("PUMIUMTALLY_FENCED_TIMING", "0")
+    t = native_create(mesh_path, 20)
+    assert t.config.localization == "locate"
+    assert t.config.auto_continue is False
+    assert t.config.fenced_timing is False
+    monkeypatch.setenv("PUMIUMTALLY_LOCALIZATION", "bogus")
+    with pytest.raises(ValueError, match="localization"):
+        native_create(mesh_path, 20)
