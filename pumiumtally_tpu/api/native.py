@@ -18,8 +18,13 @@ reference selects its Kokkos backend at build time:
     PUMIUMTALLY_LOCALIZATION      walk (default) | locate — see
                                   TallyConfig.localization
     PUMIUMTALLY_AUTO_CONTINUE     1 (default) | 0 — host staging dedup
-    PUMIUMTALLY_FENCED_TIMING     1 (default) | 0 — unfenced pipelined
-                                  dispatch
+    PUMIUMTALLY_FENCED_TIMING     1 (default) | 0 — 0 enables unfenced
+                                  pipelined dispatch and implies
+                                  CHECK_FOUND_ALL=0 unless that is set
+                                  explicitly (the convergence read-back
+                                  is itself a per-move sync)
+    PUMIUMTALLY_CHECK_FOUND_ALL   1 (default) | 0 — per-move "Not all
+                                  particles are found" check
 """
 
 from __future__ import annotations
@@ -48,15 +53,26 @@ def native_create(mesh_filename: str, num_particles: int):
     out = os.environ.get("PUMIUMTALLY_OUTPUT")
     if out:
         kwargs["output_filename"] = out
+    def env_flag(name: str):
+        v = os.environ.get(name, "").strip().lower()
+        return None if not v else v not in ("0", "false", "off", "no")
+
     loc = os.environ.get("PUMIUMTALLY_LOCALIZATION")
     if loc:
-        kwargs["localization"] = loc.lower()
-    auto = os.environ.get("PUMIUMTALLY_AUTO_CONTINUE")
-    if auto is not None and auto != "":
-        kwargs["auto_continue"] = auto not in ("0", "false", "off")
-    fenced = os.environ.get("PUMIUMTALLY_FENCED_TIMING")
-    if fenced is not None and fenced != "":
-        kwargs["fenced_timing"] = fenced not in ("0", "false", "off")
+        kwargs["localization"] = loc.strip().lower()
+    auto = env_flag("PUMIUMTALLY_AUTO_CONTINUE")
+    if auto is not None:
+        kwargs["auto_continue"] = auto
+    fenced = env_flag("PUMIUMTALLY_FENCED_TIMING")
+    if fenced is not None:
+        kwargs["fenced_timing"] = fenced
+        if not fenced and env_flag("PUMIUMTALLY_CHECK_FOUND_ALL") is None:
+            # Unfenced dispatch only pipelines without the per-move
+            # convergence read-back; imply it off unless asked for.
+            kwargs["check_found_all"] = False
+    check = env_flag("PUMIUMTALLY_CHECK_FOUND_ALL")
+    if check is not None:
+        kwargs["check_found_all"] = check
     ndev = os.environ.get("PUMIUMTALLY_DEVICES")
     partitioned = engine in ("partitioned", "streaming_partitioned")
     if ndev or partitioned:

@@ -280,3 +280,27 @@ def test_native_create_new_config_envs(monkeypatch, tmp_path):
     monkeypatch.setenv("PUMIUMTALLY_LOCALIZATION", "bogus")
     with pytest.raises(ValueError, match="localization"):
         native_create(mesh_path, 20)
+
+
+def test_native_env_flag_spellings(monkeypatch, tmp_path):
+    from pumiumtally_tpu.api.native import native_create
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
+    mesh_path = str(tmp_path / "m.osh")
+    write_osh(mesh_path, coords, tets)
+    monkeypatch.delenv("PUMIUMTALLY_ENGINE", raising=False)
+    # capitalized/padded spellings count as false too
+    monkeypatch.setenv("PUMIUMTALLY_AUTO_CONTINUE", "False")
+    monkeypatch.setenv("PUMIUMTALLY_FENCED_TIMING", " OFF ")
+    t = native_create(mesh_path, 10)
+    assert t.config.auto_continue is False
+    assert t.config.fenced_timing is False
+    # unfenced implies check_found_all off...
+    assert t.config.check_found_all is False
+    # ...unless explicitly requested
+    monkeypatch.setenv("PUMIUMTALLY_CHECK_FOUND_ALL", "1")
+    t = native_create(mesh_path, 10)
+    assert t.config.fenced_timing is False
+    assert t.config.check_found_all is True
