@@ -25,6 +25,10 @@ reference selects its Kokkos backend at build time:
                                   is itself a per-move sync)
     PUMIUMTALLY_CHECK_FOUND_ALL   1 (default) | 0 — per-move "Not all
                                   particles are found" check
+    PUMIUMTALLY_DEVICE_GROUPS     streaming_partitioned only: split the
+                                  device mesh into this many groups
+                                  (dp × part hybrid — see
+                                  TallyConfig.device_groups)
 """
 
 from __future__ import annotations
@@ -64,15 +68,18 @@ def native_create(mesh_filename: str, num_particles: int):
     if auto is not None:
         kwargs["auto_continue"] = auto
     fenced = env_flag("PUMIUMTALLY_FENCED_TIMING")
+    check = env_flag("PUMIUMTALLY_CHECK_FOUND_ALL")
     if fenced is not None:
         kwargs["fenced_timing"] = fenced
-        if not fenced and env_flag("PUMIUMTALLY_CHECK_FOUND_ALL") is None:
+        if not fenced and check is None:
             # Unfenced dispatch only pipelines without the per-move
             # convergence read-back; imply it off unless asked for.
-            kwargs["check_found_all"] = False
-    check = env_flag("PUMIUMTALLY_CHECK_FOUND_ALL")
+            check = False
     if check is not None:
         kwargs["check_found_all"] = check
+    groups = os.environ.get("PUMIUMTALLY_DEVICE_GROUPS")
+    if groups:
+        kwargs["device_groups"] = int(groups)
     ndev = os.environ.get("PUMIUMTALLY_DEVICES")
     partitioned = engine in ("partitioned", "streaming_partitioned")
     if ndev or partitioned:

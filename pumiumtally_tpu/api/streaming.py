@@ -362,26 +362,51 @@ class StreamingPartitionedTally(StreamingTally):
         super().__init__(mesh, num_particles, chunk_size, config)
 
     def _alloc_chunks(self, mesh: TetMesh) -> None:
+        from jax.sharding import Mesh
+
         from pumiumtally_tpu.parallel.partition import (
             PartitionedEngine,
             build_partition,
         )
 
-        part = build_partition(mesh, int(self.device_mesh.devices.size))
-        cache: dict = {}
+        # Device groups: dp × part hybrid. The flat device list splits
+        # into G disjoint sub-meshes; chunks round-robin across them, so
+        # G chunks walk CONCURRENTLY (different devices) while each
+        # group still shards the mesh over its own chips — particle
+        # data parallelism across groups × mesh partitioning within a
+        # group. G=1 (default) is the original single-group pipeline.
+        ngroups = int(self.config.device_groups)  # >=1, validated by config
+        devs = np.asarray(self.device_mesh.devices).reshape(-1)
+        if len(devs) % ngroups:
+            raise ValueError(
+                f"device_groups={ngroups} does not divide the "
+                f"{len(devs)}-device mesh"
+            )
+        per = len(devs) // ngroups
+        ax = self.device_mesh.axis_names[0]
+        group_meshes = [
+            Mesh(devs[g * per : (g + 1) * per], (ax,))
+            for g in range(ngroups)
+        ]
+        # The partition depends only on (mesh, ndev-per-group): build it
+        # once; every group shares the tables. Compiled programs bake
+        # the device mesh, so each group keeps its own jit cache.
+        part = build_partition(mesh, per)
+        caches = [dict() for _ in range(ngroups)]
         # Each engine is sized to its chunk's REAL particle count (a
         # padded slot would otherwise be a live particle piling onto
         # whatever chip owns the repeated pad point).
         self.engines = []
         for k in range(self.nchunks):
             lo, hi = self._chunk_bounds(k)
+            g = k % ngroups
             self.engines.append(PartitionedEngine(
-                mesh, self.device_mesh, hi - lo,
+                mesh, group_meshes[g], hi - lo,
                 capacity_factor=self.config.capacity_factor,
                 tol=self._tol, max_iters=self._max_iters,
                 max_rounds=self.config.max_migration_rounds,
                 check_found_all=self.config.check_found_all,
-                part=part, shared_jit_cache=cache,
+                part=part, shared_jit_cache=caches[g],
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []
@@ -417,7 +442,10 @@ class StreamingPartitionedTally(StreamingTally):
         from pumiumtally_tpu.parallel.partition import OVERFLOW_MESSAGE
 
         ovfs, self._pending_overflows = self._pending_overflows, []
-        if ovfs and bool(jnp.any(jnp.stack(ovfs))):
+        # Per-flag host reads: this IS the batch sync point, and with
+        # device_groups > 1 the flags live on disjoint device sets (a
+        # device-side stack across groups is invalid).
+        if any(bool(o) for o in ovfs):
             raise RuntimeError(OVERFLOW_MESSAGE)
         # Resolve every engine's lost count at this batch sync point:
         # the two-phase revival check in move() then reads a cached int
@@ -458,6 +486,14 @@ class StreamingPartitionedTally(StreamingTally):
 
     @property
     def flux(self) -> jnp.ndarray:
+        if self.config.device_groups > 1:
+            # Engines live on DISJOINT device groups; device-side adds
+            # across committed arrays on different devices are invalid,
+            # so assemble on the host (this is the output path).
+            total = np.zeros(self.mesh.nelems, np.float64)
+            for e in self.engines:
+                total += np.asarray(e.flux_original(), np.float64)
+            return jnp.asarray(total, self.dtype)
         total = self.engines[0].flux_original()
         for e in self.engines[1:]:
             total = total + e.flux_original()

@@ -115,6 +115,13 @@ class TallyConfig:
     device_mesh: Optional[jax.sharding.Mesh] = None
     capacity_factor: float = 1.5
     max_migration_rounds: int = 64
+    # StreamingPartitionedTally only: split the device mesh into this
+    # many disjoint groups — chunks round-robin across them, so G
+    # chunks transport concurrently (particle data parallelism across
+    # groups) while each group shards the mesh over its ndev/G chips
+    # (mesh partitioning within a group). The dp × part hybrid; each
+    # chip then holds tables for E/(ndev/G) owned elements.
+    device_groups: int = 1
     output_filename: str = "fluxresult.vtk"
 
     def __post_init__(self) -> None:
@@ -122,6 +129,10 @@ class TallyConfig:
             raise ValueError(
                 "localization must be 'walk' or 'locate', "
                 f"got {self.localization!r}"
+            )
+        if int(self.device_groups) < 1:
+            raise ValueError(
+                f"device_groups must be >= 1, got {self.device_groups!r}"
             )
 
     def resolved_dtype(self) -> Any:

@@ -304,3 +304,24 @@ def test_native_env_flag_spellings(monkeypatch, tmp_path):
     t = native_create(mesh_path, 10)
     assert t.config.fenced_timing is False
     assert t.config.check_found_all is True
+
+
+def test_native_device_groups_env(monkeypatch, tmp_path):
+    from pumiumtally_tpu.api.native import native_create
+    from pumiumtally_tpu.io.osh import write_osh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    mesh_path = str(tmp_path / "m.osh")
+    write_osh(mesh_path, coords, tets)
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "streaming_partitioned")
+    monkeypatch.setenv("PUMIUMTALLY_DEVICES", "8")
+    monkeypatch.setenv("PUMIUMTALLY_CHUNK_SIZE", "32")
+    monkeypatch.setenv("PUMIUMTALLY_CAPACITY_FACTOR", "6.0")
+    monkeypatch.setenv("PUMIUMTALLY_DEVICE_GROUPS", "2")
+    t = native_create(mesh_path, 64)
+    assert t.config.device_groups == 2
+    assert len({id(e.device_mesh) for e in t.engines}) == 2
+
+    with pytest.raises(ValueError, match="device_groups"):
+        TallyConfig(device_groups=0)

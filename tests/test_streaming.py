@@ -315,3 +315,53 @@ def test_streaming_locate_localization_matches_walk():
     np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
     np.testing.assert_array_equal(out[0][1], out[1][1])
     np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
+
+
+def test_streaming_partitioned_device_groups_matches_single_group():
+    """dp x part hybrid: chunks round-robin over 2 disjoint 4-device
+    groups (each partitioning the mesh over its own chips); flux and
+    state match the single-group engine and the monolithic engine."""
+    from pumiumtally_tpu import (
+        PumiTally,
+        StreamingPartitionedTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    n, chunk = 4000, 1024  # 4 chunks over 2 groups
+    dm = make_device_mesh(8)
+    rng = np.random.default_rng(25)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+
+    out = []
+    for groups in (1, 2):
+        t = StreamingPartitionedTally(
+            mesh, n, chunk_size=chunk,
+            config=TallyConfig(device_mesh=dm, device_groups=groups,
+                               capacity_factor=4.0),
+        )
+        assert len({id(e.device_mesh) for e in t.engines}) == groups
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        out.append((np.asarray(t.flux, np.float64), t.positions, t.elem_ids))
+    np.testing.assert_allclose(out[0][0], out[1][0], rtol=1e-11, atol=1e-13)
+    np.testing.assert_allclose(out[0][1], out[1][1], atol=1e-12)
+    np.testing.assert_array_equal(out[0][2], out[1][2])
+
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(None, d1.reshape(-1).copy())
+    got = float(out[1][0].sum())
+    want = float(np.asarray(ref.flux).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-11)
+
+    # indivisible group count is rejected
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="device_groups"):
+        StreamingPartitionedTally(
+            mesh, n, chunk_size=chunk,
+            config=TallyConfig(device_mesh=dm, device_groups=3),
+        )
