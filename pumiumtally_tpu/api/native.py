@@ -79,6 +79,11 @@ def native_create(mesh_filename: str, num_particles: int):
         kwargs["check_found_all"] = check
     groups = os.environ.get("PUMIUMTALLY_DEVICE_GROUPS")
     if groups:
+        if engine != "streaming_partitioned":
+            raise ValueError(
+                "PUMIUMTALLY_DEVICE_GROUPS applies only to "
+                f"PUMIUMTALLY_ENGINE=streaming_partitioned, not {engine!r}"
+            )
         kwargs["device_groups"] = int(groups)
     ndev = os.environ.get("PUMIUMTALLY_DEVICES")
     partitioned = engine in ("partitioned", "streaming_partitioned")

@@ -382,6 +382,13 @@ class StreamingPartitionedTally(StreamingTally):
                 f"device_groups={ngroups} does not divide the "
                 f"{len(devs)}-device mesh"
             )
+        if ngroups > self.nchunks:
+            # Round-robin can only reach nchunks groups — trailing
+            # groups (and their chips) would silently idle.
+            raise ValueError(
+                f"device_groups={ngroups} exceeds the {self.nchunks} "
+                "chunk(s) of this batch; lower it or shrink chunk_size"
+            )
         per = len(devs) // ngroups
         ax = self.device_mesh.axis_names[0]
         group_meshes = [

@@ -359,9 +359,23 @@ def test_streaming_partitioned_device_groups_matches_single_group():
     np.testing.assert_allclose(got, want, rtol=1e-11)
 
     # indivisible group count is rejected
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="device_groups"):
+    with pytest.raises(ValueError, match="device_groups"):
         StreamingPartitionedTally(
             mesh, n, chunk_size=chunk,
             config=TallyConfig(device_mesh=dm, device_groups=3),
+        )
+
+
+def test_streaming_partitioned_group_misconfig_rejected():
+    from pumiumtally_tpu import StreamingPartitionedTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    dm = make_device_mesh(8)
+    # more groups than chunks -> trailing groups would idle silently
+    with pytest.raises(ValueError, match="chunk"):
+        StreamingPartitionedTally(
+            mesh, 100, chunk_size=100,
+            config=TallyConfig(device_mesh=dm, device_groups=2,
+                               capacity_factor=8.0),
         )
