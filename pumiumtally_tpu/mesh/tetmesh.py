@@ -156,12 +156,16 @@ class TetMesh:
     # -- construction ---------------------------------------------------
     @classmethod
     def from_arrays(
-        cls, coords: np.ndarray, tet2vert: np.ndarray, dtype: Any = None
+        cls, coords: np.ndarray, tet2vert: np.ndarray, dtype: Any = None,
+        force_unpacked: bool = False,
     ) -> "TetMesh":
         """Build a mesh (host-side precompute) from raw connectivity.
 
         Reorders each tet for positive orientation, computes outward face
-        planes, face adjacency, and volumes.
+        planes, face adjacency, and volumes. ``force_unpacked`` keeps
+        the walk arrays separate (the layout meshes past the exact
+        float-id limit fall back to) — for testing that path at small
+        sizes.
         """
         if dtype is None:
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -204,12 +208,12 @@ class TetMesh:
         # stored in the float dtype; exact only below 2^(mantissa+1) —
         # past that the walk falls back to separate gathers.
         ne = tet2vert.shape[0]
-        if ne < _exact_id_limit(dtype):
+        if ne < _exact_id_limit(dtype) and not force_unpacked:
             walk_table = jnp.asarray(
                 _pack_walk_table(np, n, offsets, face_adj), dtype=dtype
             )
             stored_n = stored_off = None
-        else:  # pragma: no cover — mesh too big for exact float ids
+        else:
             walk_table = None
             stored_n = jnp.asarray(n, dtype=dtype)
             stored_off = jnp.asarray(offsets, dtype=dtype)
@@ -244,7 +248,10 @@ class TetMesh:
 
     def astype(self, dtype: Any) -> "TetMesh":
         ne = self.tet2vert.shape[0]
-        if ne < _exact_id_limit(dtype):
+        # A mesh already in the unpacked layout stays unpacked: its ids
+        # may exceed the new dtype's exact range too, and a
+        # force_unpacked test mesh must not silently repack.
+        if self.walk_table is not None and ne < _exact_id_limit(dtype):
             # Rebuild the table from f64 intermediates so adj ids stay
             # exact through the conversion (guarded by the limit check).
             walk_table = _pack_walk_table(

@@ -82,3 +82,40 @@ def test_larger_box_adjacency_counts():
     # boundary faces = 2 triangles per exposed quad
     nbnd = 2 * 2 * (3 * 2 + 2 * 4 + 3 * 4)
     assert (adj == -1).sum() == nbnd
+
+
+def test_unpacked_walk_table_fallback_matches_packed():
+    """Meshes past the exact float-id limit store separate walk arrays
+    (walk_table=None); forced at small size, the full engine must
+    produce bit-identical results to the packed layout."""
+    from pumiumtally_tpu import PumiTally, TetMesh
+    from pumiumtally_tpu.mesh.box import box_arrays
+
+    coords, tets = box_arrays(1, 1, 1, 3, 3, 3)
+    packed = TetMesh.from_arrays(coords, tets)
+    unpacked = TetMesh.from_arrays(coords, tets, force_unpacked=True)
+    assert packed.walk_table is not None and unpacked.walk_table is None
+    np.testing.assert_array_equal(
+        np.asarray(packed.face_adj), np.asarray(unpacked.face_adj)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(packed.face_normals), np.asarray(unpacked.face_normals)
+    )
+    # astype must preserve the unpacked layout (a dtype-differing
+    # TallyConfig would otherwise silently repack the test mesh)
+    assert unpacked.astype(np.float32).walk_table is None
+
+    n = 800
+    rng = np.random.default_rng(41)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(-0.1, 1.1, (n, 3))  # includes boundary exits
+    out = []
+    for mesh in (packed, unpacked):
+        t = PumiTally(mesh, n)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out.append((np.asarray(t.flux), t.positions, t.elem_ids))
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_array_equal(out[0][2], out[1][2])
