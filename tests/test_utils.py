@@ -156,3 +156,44 @@ def test_phase_timer_accumulates():
     with phase_timer(s, "t"):
         pass
     assert s.t >= first
+
+
+def test_checkpoint_restore_into_device_groups_hybrid(tmp_path):
+    """A monolithic checkpoint restores into the dp x part hybrid
+    (device_groups=2) and transport continues identically."""
+    from pumiumtally_tpu import (
+        PumiTally,
+        StreamingPartitionedTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.utils.checkpoint import (
+        load_tally_state,
+        save_tally_state,
+    )
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    n, chunk = 2000, 512
+    rng = np.random.default_rng(77)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    d1 = rng.uniform(0.1, 0.9, (n, 3))
+    d2 = rng.uniform(0.1, 0.9, (n, 3))
+    a = PumiTally(mesh, n)
+    a.CopyInitialPosition(src.reshape(-1).copy())
+    a.MoveToNextLocation(None, d1.reshape(-1).copy())
+    p = str(tmp_path / "ck.npz")
+    save_tally_state(a, p)
+
+    b = StreamingPartitionedTally(
+        mesh, n, chunk_size=chunk,
+        config=TallyConfig(device_mesh=make_device_mesh(8),
+                           device_groups=2, capacity_factor=6.0),
+    )
+    load_tally_state(b, p)
+    b.MoveToNextLocation(None, d2.reshape(-1).copy())
+    a.MoveToNextLocation(None, d2.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(b.flux, np.float64), np.asarray(a.flux, np.float64),
+        rtol=1e-11, atol=1e-13,
+    )
