@@ -161,17 +161,8 @@ def test_phase_timer_accumulates():
 def test_checkpoint_restore_into_device_groups_hybrid(tmp_path):
     """A monolithic checkpoint restores into the dp x part hybrid
     (device_groups=2) and transport continues identically."""
-    from pumiumtally_tpu import (
-        PumiTally,
-        StreamingPartitionedTally,
-        TallyConfig,
-        build_box,
-    )
+    from pumiumtally_tpu import StreamingPartitionedTally
     from pumiumtally_tpu.parallel import make_device_mesh
-    from pumiumtally_tpu.utils.checkpoint import (
-        load_tally_state,
-        save_tally_state,
-    )
 
     mesh = build_box(1, 1, 1, 3, 3, 3)
     n, chunk = 2000, 512
