@@ -38,6 +38,7 @@ from pumiumtally_tpu.api.tally import (
     _localize_step,
     _move_step,
     _move_step_continue,
+    adopt_located,
     host_positions,
     locate_or_committed,
     zero_flying_side_effect,
@@ -255,15 +256,25 @@ class StreamingTally(PumiTally):
     def _chunk_localize(self, k: int, dest: jnp.ndarray):
         """Localize chunk k to staged [chunk,3] destinations; returns
         the chunk's done flags (lazy)."""
+        x, elem = self._x[k], self._elem[k]
         if self.device_mesh is not None:
-            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
+            from pumiumtally_tpu.parallel.sharded import (
+                sharded_locate,
+                sharded_localize_step,
+            )
 
+            if self.config.localization == "locate":
+                x, elem = adopt_located(
+                    x, elem, dest,
+                    sharded_locate(
+                        self.device_mesh, self.mesh, dest, tol=self._tol
+                    ),
+                )
             self._x[k], self._elem[k], done, _ = sharded_localize_step(
-                self.device_mesh, self.mesh, self._x[k], self._elem[k],
+                self.device_mesh, self.mesh, x, elem,
                 dest, tol=self._tol, max_iters=self._max_iters,
             )
             return done
-        x, elem = self._x[k], self._elem[k]
         if self.config.localization == "locate":
             # MXU point location per chunk; unlocated points keep
             # walking from the committed state (shared pre-pass with

@@ -136,17 +136,22 @@ def _locate_step(mesh, pts, *, tol):
     return locate_by_planes(mesh.face_normals, mesh.face_offsets, pts, tol)
 
 
-def locate_or_committed(mesh, x, elem, dest, *, tol):
-    """Shared locate-mode pre-pass (monolithic + streaming facades):
-    MXU point location of ``dest``; located particles adopt (dest,
-    element) so the follow-up masked walk retires them immediately,
-    unlocated ones keep their committed (x, elem) and walk/clamp."""
-    e0 = _locate_step(mesh, dest, tol=tol)
+def adopt_located(x, elem, dest, e0):
+    """Locate-mode adoption rule (every facade): located particles
+    (``e0 >= 0``) adopt (dest, element) so the follow-up masked walk
+    retires them immediately; unlocated ones keep their committed
+    (x, elem) and walk/clamp."""
     missing = e0 < 0
     return (
         jnp.where(missing[:, None], x, dest),
         jnp.where(missing, elem, e0),
     )
+
+
+def locate_or_committed(mesh, x, elem, dest, *, tol):
+    """Shared locate-mode pre-pass (monolithic + streaming facades):
+    MXU point location of ``dest``, then the adoption rule."""
+    return adopt_located(x, elem, dest, _locate_step(mesh, dest, tol=tol))
 
 
 @partial(jax.jit, static_argnames=("tol", "max_iters"))
@@ -414,10 +419,23 @@ class PumiTally:
         scalars (only fetched when check_found_all is on)."""
         dest = self._pad_particles(dest, self.x)
         if self.device_mesh is not None:
-            from pumiumtally_tpu.parallel.sharded import sharded_localize_step
+            from pumiumtally_tpu.parallel.sharded import (
+                sharded_locate,
+                sharded_localize_step,
+            )
 
+            x, elem = self.x, self.elem
+            if self.config.localization == "locate":
+                # Same pre-pass as _localize_by_planes, with the points
+                # sharded over dp and the tables replicated.
+                x, elem = adopt_located(
+                    x, elem, dest,
+                    sharded_locate(
+                        self.device_mesh, self.mesh, dest, tol=self._tol
+                    ),
+                )
             self.x, self.elem, done, exited = sharded_localize_step(
-                self.device_mesh, self.mesh, self.x, self.elem, dest,
+                self.device_mesh, self.mesh, x, elem, dest,
                 tol=self._tol, max_iters=self._max_iters,
             )
             return jnp.all(done), jnp.sum(exited)

@@ -102,12 +102,11 @@ class TallyConfig:
     # retires them immediately), while unlocated points walk from the
     # committed state and clamp exactly as "walk" mode would. Net:
     # O(mesh diameter) walk iterations become one matmul pass. Applies
-    # to the monolithic engine and (chunk-wise) the plain streaming
-    # facade; the sharded facade keeps the walk, the partitioned
-    # facades already locate. NOTE: the N·4E half-space test is
-    # MXU-shaped — on an accelerator it is a few ms; on the CPU backend
-    # it is orders of magnitude slower than the walk (use "walk" for
-    # CPU runs at scale).
+    # to the monolithic and sharded engines and (chunk-wise) the plain
+    # streaming facade; the partitioned facades already locate.
+    # NOTE: the N·4E half-space test is MXU-shaped — on an accelerator
+    # it is a few ms; on the CPU backend it is orders of magnitude
+    # slower than the walk (use "walk" for CPU runs at scale).
     localization: str = "walk"
     # NOTE: the reference's migration cadence (``iter_count % 100``,
     # PumiTallyImpl.cpp:111) has no equivalent knob here: the TPU

@@ -102,6 +102,37 @@ def sharded_localize_step(
     return step(mesh, x, elem, dest)
 
 
+@partial(jax.jit, static_argnames=("device_mesh", "tol"))
+def sharded_locate(
+    device_mesh: Mesh,
+    mesh: TetMesh,
+    pts: jnp.ndarray,
+    *,
+    tol: float,
+):
+    """MXU point location with the points sharded over ``dp`` and the
+    face-plane tables replicated (each chip locates its shard — the
+    locate-mode pre-pass of TallyConfig.localization for the sharded
+    facade). Returns sharded element ids, −1 where unlocated."""
+    from pumiumtally_tpu.ops.geometry import locate_by_planes
+
+    ax = _axis_name(device_mesh)
+    pp = P(ax)
+
+    @partial(
+        shard_map,
+        mesh=device_mesh,
+        in_specs=(P(), pp),
+        out_specs=pp,
+    )
+    def step(mesh_, pts_):
+        return locate_by_planes(
+            mesh_.face_normals, mesh_.face_offsets, pts_, tol
+        )
+
+    return step(mesh, pts)
+
+
 def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux, tol, max_iters):
     """Common shard_map scaffold for the tallied move variants.
 

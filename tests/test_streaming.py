@@ -379,3 +379,28 @@ def test_streaming_partitioned_group_misconfig_rejected():
             config=TallyConfig(device_mesh=dm, device_groups=2,
                                capacity_factor=8.0),
         )
+
+
+def test_streaming_sharded_locate_matches_walk():
+    from pumiumtally_tpu import StreamingTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    dm = make_device_mesh(8)
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n, chunk = 3000, 1024
+    rng = np.random.default_rng(27)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    src[::10] += 2.0
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for how in ("walk", "locate"):
+        t = StreamingTally(
+            mesh, n, chunk_size=chunk,
+            config=TallyConfig(device_mesh=dm, localization=how),
+        )
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        out.append((t.positions, t.elem_ids, np.asarray(t.flux)))
+    np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
