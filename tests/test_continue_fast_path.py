@@ -103,12 +103,16 @@ def test_two_phase_with_echoed_origins_matches_continue_bitwise():
     np.testing.assert_array_equal(results[0][2], results[1][2])
 
 
-@pytest.mark.parametrize("sharded", [False, True])
-def test_auto_continue_fires_on_echo_and_matches_disabled(sharded):
+@pytest.mark.parametrize("facade", ["mono", "sharded", "partitioned"])
+def test_auto_continue_fires_on_echo_and_matches_disabled(facade):
     """Host-side auto-continue (TallyConfig.auto_continue): echoing the
     previous destinations as origins skips the origin upload, with
-    results bit-identical to the optimization turned off."""
-    dm = make_device_mesh(8) if sharded else None
+    results bit-identical to the optimization turned off — on every
+    facade (the partitioned engine treats the substituted device array
+    exactly like fresh origins)."""
+    from pumiumtally_tpu import PartitionedPumiTally
+
+    dm = make_device_mesh(8) if facade != "mono" else None
     mesh = build_box(1, 1, 1, 4, 4, 4)
     rng = np.random.default_rng(11)
     src = rng.uniform(0.05, 0.95, (N, 3))
@@ -117,7 +121,10 @@ def test_auto_continue_fires_on_echo_and_matches_disabled(sharded):
 
     out = []
     for auto in (True, False):
-        t = PumiTally(mesh, N, TallyConfig(device_mesh=dm, auto_continue=auto))
+        cfg = TallyConfig(device_mesh=dm, auto_continue=auto,
+                          capacity_factor=4.0)
+        cls = PartitionedPumiTally if facade == "partitioned" else PumiTally
+        t = cls(mesh, N, cfg)
         t.CopyInitialPosition(src.reshape(-1).copy())
         t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
                              np.ones(N, np.int8), np.ones(N))
