@@ -221,6 +221,29 @@ def preflight_device(max_wait_s: float = 1500.0) -> None:
         time.sleep(30)
 
 
+def measure_link_bandwidth(mb: float = 8.0) -> float | None:
+    """Timed host→device put of an `mb`-MB array, MB/s.
+
+    Recorded so vs_baseline numbers are interpretable across
+    tunnel-quality changes: the staging-bound protocols scale with this.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        buf = np.random.default_rng(2).random(int(mb * 1e6 / 8))
+        # Warm with the IDENTICAL expression: the timed region must not
+        # include jnp.sum's first-call compile.
+        float(jnp.sum(jax.device_put(buf)))
+        t0 = time.perf_counter()
+        float(jnp.sum(jax.device_put(buf)))  # forces the transfer + a sync
+        dt = time.perf_counter() - t0
+        return buf.nbytes / 1e6 / dt
+    except Exception as e:  # noqa: BLE001 — diagnostic only
+        print(f"# link bandwidth probe failed: {e}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
     if os.environ.get("PUMIUMTALLY_BENCH_CPU") == "1":
         # Subprocess mode: CPU baseline on the IDENTICAL workload.
@@ -229,6 +252,7 @@ def main() -> None:
         return
 
     preflight_device()
+    link_mb_s = measure_link_bandwidth()
     two = run_workload(N, MOVES, "two_phase")
     forced = run_workload(N, MOVES, "two_phase_forced")
     cont = run_workload(N, MOVES, "continue")
@@ -260,6 +284,16 @@ def main() -> None:
         "value": cont["moves_per_sec"],
         "unit": "moves/s",
         "vs_baseline": vs_baseline,
+        # Protocol/config semantics of each key, recorded since round 3
+        # so longitudinal comparisons are explicit: two_phase changed
+        # meaning in round 2 (auto_continue on + unfenced pipelining);
+        # the round-1 semantics live in two_phase_forced.
+        "protocol": {
+            "two_phase": "auto_continue=True, fenced_timing=False",
+            "two_phase_forced": "auto_continue=False, fenced_timing=False",
+            "continue": "origins=None, fenced_timing=False",
+        },
+        "link_mb_per_sec": link_mb_s,
         "two_phase_moves_per_sec": two["moves_per_sec"],
         "two_phase_forced_moves_per_sec": forced["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],

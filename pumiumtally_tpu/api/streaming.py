@@ -145,6 +145,7 @@ class StreamingTally(PumiTally):
         t0 = time.perf_counter()
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
+        self._echo_misses = 0  # new batch: re-arm the echo detector
         host = host_positions(init_particle_positions, size, self.num_particles)
         # Dispatch every chunk first (staging of chunk k+1 overlaps the
         # walk of chunk k); evaluate the convergence flags only after.
@@ -181,19 +182,14 @@ class StreamingTally(PumiTally):
         # Origin-echo dedup (TallyConfig.auto_continue), chunk-wise: when
         # the caller's origins equal the previous move's destinations
         # bit-for-bit in the working dtype (same rule as the monolithic
-        # facade — _origins_echo), reuse the device chunks that staged
-        # them instead of re-uploading the whole batch (here
-        # _last_dests_dev is the LIST of per-chunk device arrays).
-        # Guard BEFORE casting: the cast is a full-batch host pass, only
-        # worth paying when an echo is actually possible.
-        echo = (
-            origins_h is not None
-            and self.config.auto_continue
-            and self._last_dests_host is not None
-            and self._origins_echo(
-                self._as_positions_cast(particle_origin, size)
-            )
-        )
+        # facade — _origins_echo_raw), reuse the device chunks that
+        # staged them instead of re-uploading the whole batch (here
+        # _last_dests_dev is the LIST of per-chunk device arrays). The
+        # raw-buffer probe compares a strided sample before any
+        # full-batch cast, so never-echoing drivers pay ~nothing here.
+        # Pass the already-converted flat buffer, not the raw one — a
+        # list/non-f64 input would otherwise convert twice per move.
+        echo = self._origins_echo_raw(origins_h, size)
         fly_h = None if flying is None else np.asarray(flying).reshape(-1)
         w_h = (
             None
@@ -201,7 +197,7 @@ class StreamingTally(PumiTally):
             else np.asarray(weights, np.float64).reshape(-1)
         )
 
-        retain = self.config.auto_continue and origins_h is not None
+        retain = origins_h is not None and self._retain_echo_snapshots()
         oks = []
         dest_chunks = []
         for k in range(self.nchunks):

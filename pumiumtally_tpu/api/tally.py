@@ -88,6 +88,11 @@ class TallyTimes:
         print(f"[TIME] Total PUMI-Tally time   : {total:f} seconds")
 
 
+# Consecutive origin-echo misses after which a facade stops paying for
+# echo snapshots (the driver has proven it resamples every move).
+_ECHO_MISS_LIMIT = 8
+
+
 def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
     """Validate a caller position buffer → flat [3n] float64 host array
     (shared by the monolithic and streaming facades)."""
@@ -322,6 +327,7 @@ class PumiTally:
         self._last_weights_host: Optional[np.ndarray] = None
         self._last_weights_dev = None
         self.auto_continue_hits = 0  # diagnostic: moves that skipped the origin upload
+        self._echo_misses = 0  # consecutive non-echo moves (see _origins_echo_raw)
         return mesh
 
     def _cached_ones(self, kind: str) -> jnp.ndarray:
@@ -358,19 +364,51 @@ class PumiTally:
     def _as_positions_host(self, buf, size: Optional[int]) -> np.ndarray:
         return self._owned(self._as_positions_cast(buf, size))
 
-    def _origins_echo(self, origins_cast: Optional[np.ndarray]) -> bool:
+    def _origins_echo_raw(self, buf, size: Optional[int]) -> bool:
         """Shared echo rule for every facade: the caller's origins,
         cast to the working dtype, equal the previous move's
-        destinations bit-for-bit. Counts the hit."""
+        destinations bit-for-bit. Counts the hit.
+
+        Cheap-first: a 64-point strided sample is cast and compared
+        before any full-batch work, so origin streams that never echo
+        (fresh samples every move) pay ~nothing instead of a
+        full-batch cast + compare per move. After _ECHO_MISS_LIMIT
+        consecutive misses the snapshots are dropped and retention
+        stops (see _retain_echo_snapshots) — the steady state for a
+        never-echoing driver is then a single attribute test."""
         if (
-            origins_cast is not None
-            and self.config.auto_continue
-            and self._last_dests_host is not None
-            and np.array_equal(origins_cast, self._last_dests_host)
+            buf is None
+            or not self.config.auto_continue
+            or self._last_dests_host is None
         ):
+            return False
+        prev = self._last_dests_host  # [n,3] working dtype, owned
+        n = self.num_particles
+        raw = host_positions(buf, size, n).reshape(n, 3)
+        idx = np.linspace(0, n - 1, num=min(n, 64), dtype=np.int64)
+        if np.array_equal(
+            np.asarray(raw[idx], dtype=prev.dtype), prev[idx]
+        ) and np.array_equal(np.asarray(raw, dtype=prev.dtype), prev):
             self.auto_continue_hits += 1
+            self._echo_misses = 0
             return True
+        self._echo_misses += 1
+        if self._echo_misses >= _ECHO_MISS_LIMIT:
+            # This driver resamples origins every move; stop paying
+            # for snapshots it will never hit. CopyInitialPosition
+            # re-arms the detector for the next batch.
+            self._last_dests_host = None
+            self._last_dests_dev = None
         return False
+
+    def _retain_echo_snapshots(self) -> bool:
+        """Whether this move's destinations should be snapshotted for
+        the next move's echo check (only origin-passing drivers that
+        have not proven themselves never-echoing)."""
+        return (
+            self.config.auto_continue
+            and self._echo_misses < _ECHO_MISS_LIMIT
+        )
 
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:
         return jnp.asarray(self._as_positions_host(buf, size))
@@ -389,6 +427,7 @@ class PumiTally:
         t0 = time.perf_counter()
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
+        self._echo_misses = 0  # new batch: re-arm the echo detector
         dest = self._as_positions(init_particle_positions, size)
         found_all, n_exited = self._dispatch_localize(dest)
         if self.config.check_found_all:
@@ -492,17 +531,17 @@ class PumiTally:
                 "(reference invariant, PumiTallyImpl.cpp:437-438)"
             )
         t0 = time.perf_counter()
-        # The cast view is enough for the echo compare; the owned copy
-        # is only materialized on the miss path (where the array is
-        # actually uploaded), so an echo hit pays no [n,3] memcpy.
-        origins_cast = (
+        dests_host = self._as_positions_host(particle_destinations, size)
+        # Convert the origins buffer at most once (a list / non-f64
+        # input would otherwise convert in the echo probe AND again on
+        # the miss-path cast).
+        origins_h = (
             None
             if particle_origin is None
-            else self._as_positions_cast(particle_origin, size)
+            else host_positions(particle_origin, size, self.num_particles)
         )
-        dests_host = self._as_positions_host(particle_destinations, size)
         origins: Optional[jnp.ndarray]
-        if self._origins_echo(origins_cast):
+        if self._origins_echo_raw(origins_h, size):
             # The staged origins echo the previous destinations in the
             # working dtype — substitute the device array that staged
             # them last move instead of uploading the same bytes again.
@@ -511,10 +550,12 @@ class PumiTally:
             # trivial check skips its walk whenever every particle
             # committed its destination. See TallyConfig.auto_continue.
             origins = self._last_dests_dev
-        elif origins_cast is None:
+        elif origins_h is None:
             origins = None
         else:
-            origins = jnp.asarray(self._owned(origins_cast))
+            origins = jnp.asarray(
+                self._owned(self._as_positions_cast(origins_h, size))
+            )
         dests = jnp.asarray(dests_host)
         n = self.num_particles
         if flying is None:
@@ -565,11 +606,12 @@ class PumiTally:
         zero_flying_side_effect(flying, n)
 
         found_all = self._dispatch_move(origins, dests, fly, w)
-        if self.config.auto_continue and origins_cast is not None:
+        if origins_h is not None and self._retain_echo_snapshots():
             # _as_positions_host returned OWNED memory, so these
             # snapshots cannot alias a caller buffer that gets recycled
             # next call. Only retained for origin-passing drivers (the
-            # ones that can echo) — a continue-mode driver would pin an
+            # ones that can echo, and have not proven themselves
+            # never-echoing) — a continue-mode driver would pin an
             # extra [n,3] on device and host for nothing. A stale
             # snapshot is value-correct by construction: the echo
             # substitutes bytes equal to whatever the caller passed.

@@ -32,6 +32,15 @@ def _prep(path, coords, tet2vert):
     return coords, tet2vert
 
 
+def _xml_name(name: str) -> str:
+    """Escape a data-array name for interpolation into an XML attribute
+    (a name containing '"', '<' or '&' would otherwise produce a file
+    every reader rejects)."""
+    from xml.sax.saxutils import escape
+
+    return escape(name, {'"': "&quot;"})
+
+
 def _check_len(name: str, arr: np.ndarray, n: int, kind: str) -> np.ndarray:
     arr = np.asarray(arr, dtype=np.float64).reshape(-1)
     if arr.shape[0] != n:
@@ -161,7 +170,7 @@ def write_vtu(
         name, vtype, ncomp, _ = blocks[i]
         comps = f' NumberOfComponents="{ncomp}"' if ncomp > 1 else ""
         return (
-            f'<DataArray type="{vtype}" Name="{name}"{comps} '
+            f'<DataArray type="{vtype}" Name="{_xml_name(name)}"{comps} '
             f'format="appended" offset="{offsets[i]}"{extra}/>'
         )
 
@@ -284,7 +293,7 @@ def write_pvtu(
     xml.append("</PPoints>")
     xml.append("<PCellData>")
     for name in cell_data:
-        xml.append(f'<PDataArray type="Float64" Name="{name}"/>')
+        xml.append(f'<PDataArray type="Float64" Name="{_xml_name(name)}"/>')
     xml.append("</PCellData>")
     for piece in piece_files:
         xml.append(f'<Piece Source="{piece}"/>')
@@ -349,7 +358,7 @@ def _read_vtk_binary_scalars(data: bytes, name: str) -> np.ndarray:
 def _read_vtu_array(path: str, name: str) -> np.ndarray:
     with open(path, "rb") as f:
         data = f.read()
-    tag = f'Name="{name}"'.encode()
+    tag = f'Name="{_xml_name(name)}"'.encode()
     p = data.find(tag)
     if p < 0:
         raise KeyError(f"array {name!r} not found in {path}")

@@ -8,10 +8,13 @@ equivalents.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax.numpy as jnp
 from jax import lax
+
+_warned_tiny_chunk = False
 
 
 def tet_volumes(coords: jnp.ndarray, tet2vert: jnp.ndarray) -> jnp.ndarray:
@@ -94,6 +97,19 @@ def locate_by_planes(
     # No floor: memory is the binding constraint, so on meshes past ~8M
     # elements the chunk legitimately degrades to one point at a time.
     c = chunk or max(1, min(2048, (1 << 23) // max(ne, 1)))
+    if chunk is None and c < 32:
+        # lax.map then runs N/c sequential tiny matmuls — orders of
+        # magnitude slower than the adjacency walk. Say so once instead
+        # of silently crawling (mirrors the TallyConfig CPU caveat).
+        global _warned_tiny_chunk
+        if not _warned_tiny_chunk:
+            _warned_tiny_chunk = True
+            warnings.warn(
+                f"locate_by_planes: {ne} elements force a chunk of "
+                f"{c} point(s); half-space localization will be very "
+                "slow at this mesh size — prefer localization='walk'.",
+                stacklevel=2,
+            )
     c = min(c, max(n, 1))
     m = -(-n // c) * c
     if m > n:

@@ -53,6 +53,7 @@ here both simply compile a no-tally variant of the loop body.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -68,6 +69,34 @@ from pumiumtally_tpu.mesh.tetmesh import (
 # Smallest compaction window: below this, shrinking the batch no longer
 # pays for the sort (and TPU vector units run underutilized anyway).
 _MIN_WINDOW = 8192
+
+# How the compaction cascade applies the survivor permutation at each
+# stage boundary. All three produce BITWISE-identical results (same
+# values, same scatter order); they differ only in how many random-row
+# gathers the permutation costs — measured the largest cascade
+# component on v5e (docs/PERF_NOTES.md, ~51 ms/stage at 500k for the
+# per-array form):
+#   "arrays"   — permute each carried array separately (8 row gathers).
+#   "packed"   — pack the carry into one float [W,8] + one int [W,3]
+#                row matrix and permute those (2 row gathers; same
+#                trick as the packed walk table, measured ~2.6x over
+#                separate gathers for the row fetch).
+#   "indirect" — never permute the ray data (dest/d0/eff_w): the loop
+#                gathers it per iteration through the carried original
+#                slot index, and the boundary permutes only
+#                s + one int [W,3] (2 small gathers, but adds a [W,8]
+#                gather per walk iteration).
+_PERM_MODES = ("arrays", "packed", "indirect")
+
+
+def _resolve_perm_mode(mode: str) -> str:
+    if mode == "auto":
+        mode = os.environ.get("PUMIUMTALLY_WALK_PERM", "packed")
+    if mode not in _PERM_MODES:
+        raise ValueError(
+            f"perm_mode must be one of {_PERM_MODES} or 'auto', got {mode!r}"
+        )
+    return mode
 
 
 def fused_tally_body(step, cond_every: int, tally: bool):
@@ -151,6 +180,8 @@ def walk(
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
     cond_every: int = 4,
+    window_factor: int = 2,
+    perm_mode: str = "auto",
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -177,6 +208,13 @@ def walk(
     mask is recovered post-loop as ``done & (s < 1)`` (a boundary exit
     always strictly precedes the destination; reaching it exactly
     commits ``s = 1``).
+
+    ``perm_mode`` picks how the cascade applies the stage-boundary
+    permutation (see ``_PERM_MODES``) — all modes are bitwise
+    equivalent; "auto" resolves via ``PUMIUMTALLY_WALK_PERM`` (default
+    "packed"). ``window_factor`` is the cascade's window shrink ratio
+    (2 → halving; larger → fewer, coarser stages — fewer boundary
+    permutations at the cost of more lock-step waste).
     """
     fdtype = x.dtype
     n_total = x.shape[0]
@@ -194,11 +232,11 @@ def walk(
     # change, ~1 ulp).
     eff_w = jnp.where(in_flight.astype(bool), weight * seg_len, 0.0)
 
-    def step(it, s, elem, dest, d0, eff_w, done):
+    def advance(s, elem, dest, d0, eff_w, done):
         """One lock-step iteration over a (possibly windowed) batch.
-        Returns the advanced state plus this crossing's tally pair
-        (element indexed, contribution) — the caller decides how to
-        scatter (per iteration, or fused across an unrolled group)."""
+        Returns the advanced (s, elem, done) plus this crossing's tally
+        pair (element indexed, contribution) — the caller decides how
+        to scatter (per iteration, or fused across an unrolled group)."""
         active = ~done
         fn, fo, adj = _gather_walk_row(mesh, elem)
         # Both ray projections are against walk-constant vectors
@@ -228,10 +266,14 @@ def walk(
         else:
             pair = None
 
-        advance = active & ~reached & ~hit_boundary
-        elem = jnp.where(advance, next_elem, elem)
+        moving = active & ~reached & ~hit_boundary
+        elem = jnp.where(moving, next_elem, elem)
         s = jnp.where(active, s_new, s)
         done = done | reached | hit_boundary
+        return (s, elem, done), pair
+
+    def step(it, s, elem, dest, d0, eff_w, done):
+        (s, elem, done), pair = advance(s, elem, dest, d0, eff_w, done)
         return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
     it0 = jnp.asarray(0, jnp.int32)
@@ -265,14 +307,35 @@ def walk(
         )
 
     # ---- compaction cascade --------------------------------------------
-    # Static window schedule: N, N/2, …, down to min_window.
+    # Static window schedule: N, N/f, …, down to min_window.
+    factor = int(window_factor)
+    if factor < 2:
+        raise ValueError(
+            f"window_factor must be >= 2, got {window_factor!r} "
+            "(use compact=False to disable the cascade)"
+        )
     windows = [n_total]
     while windows[-1] > min_window:
-        windows.append(max(min_window, -(-windows[-1] // 2)))
+        windows.append(max(min_window, -(-windows[-1] // factor)))
 
     # Original slot of the particle currently in each row, so the
-    # compaction permutations can be undone at the end.
+    # compaction permutations can be undone at the end (and, in
+    # "indirect" mode, so the loop can reach the never-permuted ray
+    # data).
     idx = jnp.cumsum(jnp.ones_like(elem)) - 1  # iota, varying under shard_map
+
+    mode = _resolve_perm_mode(perm_mode)
+    imax = jnp.iinfo(jnp.int32).max
+    cat = lambda h, a, w: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
+
+    if mode == "indirect":
+        # Ray data packed ONCE, in original slot order, never permuted:
+        # the loop gathers each window row through `idx`. Padded to 8
+        # columns so the row stride stays power-of-two-aligned.
+        ray = jnp.concatenate(
+            [dest, d0, eff_w[:, None], jnp.zeros_like(eff_w)[:, None]],
+            axis=1,
+        )  # [N,8]
 
     s = s0
     done = done0
@@ -287,14 +350,29 @@ def walk(
             return (it < max_iters) & (n_active > _nxt)
 
         head = lambda a: a[:w]  # noqa: E731 — static-size window slice
-        it, sh, eh, _, _, _, dh, flux = lax.while_loop(
-            cond,
-            body,
-            (
-                it, head(s), head(elem), head(dest), head(d0),
-                head(eff_w), head(done), flux,
-            ),
-        )
+        if mode == "indirect":
+            idx_w = head(idx)
+
+            def step_ind(it, s, elem, done, _idx=idx_w):
+                r = ray[_idx]
+                (s, elem, done), pair = advance(
+                    s, elem, r[:, 0:3], r[:, 3:6], r[:, 6], done
+                )
+                return (it + 1, s, elem, done), pair
+
+            body_i = fused_tally_body(step_ind, cond_every, tally)
+            it, sh, eh, dh, flux = lax.while_loop(
+                cond, body_i, (it, head(s), head(elem), head(done), flux)
+            )
+        else:
+            it, sh, eh, _, _, _, dh, flux = lax.while_loop(
+                cond,
+                body,
+                (
+                    it, head(s), head(elem), head(dest), head(d0),
+                    head(eff_w), head(done), flux,
+                ),
+            )
         # NOTE: these window write-backs deliberately use concatenate,
         # NOT `a.at[:w].set(a[:w][perm])`: the in-place form miscompiles
         # under jit when the dynamic-update-slice is fused with a gather
@@ -306,26 +384,53 @@ def walk(
             # the front AND are grouped by element — deterministic, and
             # the sort is the price of the compaction itself. Only rows
             # [:w] can be active, so sorting the window alone suffices
-            # and the sort shrinks with the cascade. The write-back and
-            # the permutation fuse into ONE concatenate per array.
-            key = jnp.where(dh, jnp.iinfo(jnp.int32).max, eh)
+            # and the sort shrinks with the cascade.
+            key = jnp.where(dh, imax, eh)
             perm = jnp.argsort(key, stable=True)
-            upd = lambda a, h: jnp.concatenate([h[perm], a[w:]], axis=0)  # noqa: E731
-            s = upd(s, sh)
-            elem = upd(elem, eh)
-            done = upd(done, dh)
-            dest = upd(dest, dest[:w])
-            d0 = upd(d0, d0[:w])
-            eff_w = upd(eff_w, eff_w[:w])
-            idx = upd(idx, idx[:w])
+            if mode == "arrays":
+                # Round-2 form: one row gather per carried array.
+                upd = lambda a, h: cat(h[perm], a, w)  # noqa: E731
+                s = upd(s, sh)
+                elem = upd(elem, eh)
+                done = upd(done, dh)
+                dest = upd(dest, dest[:w])
+                d0 = upd(d0, d0[:w])
+                eff_w = upd(eff_w, eff_w[:w])
+                idx = upd(idx, idx[:w])
+            else:
+                ipack = jnp.stack(
+                    [eh, idx[:w], dh.astype(jnp.int32)], axis=1
+                )[perm]  # [w,3] — one row gather for all int carries
+                elem = cat(ipack[:, 0], elem, w)
+                idx = cat(ipack[:, 1], idx, w)
+                done = cat(ipack[:, 2].astype(bool), done, w)
+                if mode == "indirect":
+                    s = cat(sh[perm], s, w)
+                else:  # "packed"
+                    fpack = jnp.concatenate(
+                        [sh[:, None], dest[:w], d0[:w], eff_w[:w, None]],
+                        axis=1,
+                    )[perm]  # [w,8] — one row gather for all float carries
+                    s = cat(fpack[:, 0], s, w)
+                    dest = cat(fpack[:, 1:4], dest, w)
+                    d0 = cat(fpack[:, 4:7], d0, w)
+                    eff_w = cat(fpack[:, 7], eff_w, w)
         else:
-            tail = lambda a, h: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
-            s = tail(s, sh)
-            elem = tail(elem, eh)
-            done = tail(done, dh)
+            s = cat(sh, s, w)
+            elem = cat(eh, elem, w)
+            done = cat(dh, done, w)
 
     # Undo the accumulated permutation: row i holds original slot idx[i].
     inv = jnp.argsort(idx, stable=True)
+    if mode == "indirect":
+        # dest/d0 were never permuted — restore the particle carries to
+        # original order and materialize positions there directly.
+        s, elem, done = s[inv], elem[inv], done[inv]
+        exited = done & (s < one)
+        return WalkResult(
+            x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
+            exited=exited, flux=flux, iters=it,
+        )
     exited = done & (s < one)
     x_fin = final_x(s, done, exited, dest, d0)
     return WalkResult(

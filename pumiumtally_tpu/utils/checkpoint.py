@@ -99,6 +99,7 @@ def load_tally_state(tally, path: str) -> None:
     if hasattr(tally, "_last_dests_host"):
         tally._last_dests_host = None
         tally._last_dests_dev = None
+        tally._echo_misses = 0
 
     kind = _engine_kind(tally)
     with np.load(path) as z:

@@ -323,3 +323,57 @@ def test_sharded_locate_localization_matches_walk():
     np.testing.assert_allclose(out[0][0], out[1][0], atol=1e-12)
     np.testing.assert_array_equal(out[0][1], out[1][1])
     np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
+
+
+def test_echo_disarm_state_machine():
+    """The never-echoing-driver disarm (api/tally.py _ECHO_MISS_LIMIT):
+    after 8 consecutive misses the facade drops its snapshots and stops
+    retaining; a hit resets the streak; CopyInitialPosition re-arms.
+    Results stay correct throughout (a miss only costs an upload)."""
+    from pumiumtally_tpu.api.tally import _ECHO_MISS_LIMIT
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 300
+    rng = np.random.default_rng(21)
+    t = PumiTally(mesh, n, TallyConfig())
+    pts = rng.uniform(0.05, 0.95, (n, 3))
+    t.CopyInitialPosition(pts.reshape(-1).copy())
+
+    def move(origins, dests):
+        t.MoveToNextLocation(origins.reshape(-1).copy(),
+                             dests.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+
+    # Resampling driver: every move passes freshly sampled origins, so
+    # they never equal the previous move's destinations.
+    for i in range(_ECHO_MISS_LIMIT + 2):
+        origins = rng.uniform(0.05, 0.95, (n, 3))
+        dests = rng.uniform(0.05, 0.95, (n, 3))
+        move(origins, dests)
+        if i == 0:
+            # First move can't miss (no snapshot yet) and must retain.
+            assert t._echo_misses == 0 and t._last_dests_host is not None
+    assert t.auto_continue_hits == 0
+    # Disarmed: snapshots dropped, retention off.
+    assert t._echo_misses >= _ECHO_MISS_LIMIT
+    assert t._last_dests_host is None and t._last_dests_dev is None
+    move(rng.uniform(0.05, 0.95, (n, 3)), rng.uniform(0.05, 0.95, (n, 3)))
+    assert t._last_dests_host is None  # stays off for this batch
+
+    # CopyInitialPosition re-arms the detector.
+    t.CopyInitialPosition(pts.reshape(-1).copy())
+    assert t._echo_misses == 0
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    move(pts, d1)
+    assert t._last_dests_host is not None  # retaining again
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+    move(d1, d2)  # echo!
+    assert t.auto_continue_hits == 1
+    assert t._echo_misses == 0  # hit reset the streak
+
+    # A miss streak broken by hits never disarms.
+    for _ in range(_ECHO_MISS_LIMIT):
+        d3 = rng.uniform(0.05, 0.95, (n, 3))
+        move(d2, d3)  # echo hit every other move
+        d2 = d3
+    assert t._last_dests_host is not None

@@ -157,3 +157,45 @@ def test_cond_every_k_is_exact():
                                   np.asarray(outs[1].elem))
     np.testing.assert_array_equal(np.asarray(outs[0].x),
                                   np.asarray(outs[1].x))
+
+
+def test_perm_modes_bitwise_identical():
+    """The three stage-boundary permutation strategies ("arrays",
+    "packed", "indirect" — ops/walk.py _PERM_MODES) are implementation
+    details of the SAME computation: identical values gathered/permuted
+    through different layouts, identical scatter order. Results must be
+    BITWISE equal, flux included."""
+    mesh, x, elem, dest, fly, w = _setup(seed=7)
+    flux0 = jnp.zeros((mesh.nelems,))
+    outs = {
+        mode: walk(mesh, x, elem, dest, fly, w, flux0,
+                   tally=True, tol=1e-12, max_iters=4096,
+                   compact=True, min_window=256, perm_mode=mode)
+        for mode in ("arrays", "packed", "indirect")
+    }
+    a = outs["arrays"]
+    assert bool(jnp.all(a.done))
+    for mode in ("packed", "indirect"):
+        b = outs[mode]
+        np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+        np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(b.elem))
+        np.testing.assert_array_equal(np.asarray(a.done), np.asarray(b.done))
+        np.testing.assert_array_equal(
+            np.asarray(a.exited), np.asarray(b.exited))
+        np.testing.assert_array_equal(np.asarray(a.flux), np.asarray(b.flux))
+
+
+def test_window_factor_matches_halving():
+    """A coarser cascade (window_factor=4) changes stage boundaries but
+    not per-particle results; flux agrees up to scatter-order FP."""
+    mesh, x, elem, dest, fly, w = _setup(seed=8)
+    flux0 = jnp.zeros((mesh.nelems,))
+    a = walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-12,
+             max_iters=4096, min_window=256, window_factor=2)
+    b = walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-12,
+             max_iters=4096, min_window=256, window_factor=4)
+    np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+    np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(b.elem))
+    np.testing.assert_allclose(
+        np.asarray(a.flux), np.asarray(b.flux), rtol=1e-12, atol=1e-12
+    )
