@@ -230,12 +230,11 @@ class StreamingTally(PumiTally):
         zero_flying_side_effect(flying, n)
         if retain:
             # Snapshot in the working dtype (the compare representation
-            # _origins_echo uses), owned so a recycled caller buffer
-            # cannot fool the next compare. Only retained for
-            # origin-passing drivers (see tally.py).
-            self._last_dests_host = self._as_positions_host(
-                particle_destinations, size
-            )
+            # _origins_echo_raw uses), owned so a recycled caller buffer
+            # cannot fool the next compare. Reuse the already-converted
+            # flat buffer — a list/non-f64 input must not convert twice.
+            # Only retained for origin-passing drivers (see tally.py).
+            self._last_dests_host = self._as_positions_host(dests_h, size)
             self._last_dests_dev = dest_chunks
         self.iter_count += 1
         self._after_chunk_dispatch()

@@ -33,6 +33,26 @@ def _load(path: str):
     raise SystemExit(f"unsupported mesh format: {path!r} (.msh or .osh)")
 
 
+def _save(path: str, coords, tets, elem_tags=None) -> None:
+    """Write by output extension: ``.msh`` → Gmsh 2.2 ASCII, anything
+    else → ``.osh`` directory. (Generators previously always wrote
+    ``.osh``, silently producing an .osh DIRECTORY at a ``.msh`` path.)"""
+    p = path.rstrip("/")
+    if p.endswith(".msh"):
+        from pumiumtally_tpu.io.gmsh import write_gmsh
+
+        elem_tags = elem_tags or {}
+        write_gmsh(p, coords, tets, physical=elem_tags.get("class_id"))
+        dropped = sorted(set(elem_tags) - {"class_id"})
+        if dropped:
+            print(f"note: tags {dropped} not representable in MSH 2.2; "
+                  "use an .osh output to keep them")
+        return
+    from pumiumtally_tpu.io.osh import write_osh
+
+    write_osh(path, coords, tets, elem_tags=elem_tags)
+
+
 def cmd_msh2osh(args) -> None:
     from pumiumtally_tpu.io.osh import write_osh
 
@@ -53,20 +73,17 @@ def cmd_describe(args) -> None:
 
 
 def cmd_scale(args) -> None:
-    from pumiumtally_tpu.io.osh import write_osh
-
     coords, tets = _load(args.input)
-    write_osh(args.output, coords * args.factor, tets)
+    _save(args.output, coords * args.factor, tets)
     print(f"wrote {args.output}: scaled by {args.factor}")
 
 
 def cmd_box(args) -> None:
-    from pumiumtally_tpu.io.osh import write_osh
     from pumiumtally_tpu.mesh.box import box_arrays
 
     coords, tets = box_arrays(args.lx, args.ly, args.lz,
                               args.nx, args.ny, args.nz)
-    write_osh(args.output, coords, tets)
+    _save(args.output, coords, tets)
     print(f"wrote {args.output}: {coords.shape[0]} vertices, "
           f"{len(tets)} tets")
 
@@ -75,7 +92,6 @@ def cmd_pincell(args) -> None:
     """Generate the pincell benchmark geometry (BASELINE configs[0-1])
     as an .osh directory — the reference obtains this via Gmsh +
     msh2osh (reference README.md:115-125)."""
-    from pumiumtally_tpu.io.osh import write_osh
     from pumiumtally_tpu.mesh.pincell import pincell_arrays
 
     coords, tets, region = pincell_arrays(
@@ -85,8 +101,8 @@ def cmd_pincell(args) -> None:
     )
     # Material classification rides along as the class_id element tag
     # (the tag name Omega_h meshes carry for geometric classification).
-    write_osh(args.output, coords, tets,
-              elem_tags={"class_id": region.astype(np.int32)})
+    _save(args.output, coords, tets,
+          elem_tags={"class_id": region.astype(np.int32)})
     nf = int((region == 0).sum())
     print(f"wrote {args.output}: {coords.shape[0]} vertices, "
           f"{len(tets)} tets ({nf} fuel / {len(tets) - nf} moderator)")
@@ -96,7 +112,6 @@ def cmd_lattice(args) -> None:
     """Generate an nx×ny pincell assembly (BASELINE configs[1-2] scale
     class) as an .osh directory with class_id (material) and cell_id
     element tags."""
-    from pumiumtally_tpu.io.osh import write_osh
     from pumiumtally_tpu.mesh.pincell import lattice_arrays
 
     coords, tets, region, cell_id = lattice_arrays(
@@ -105,9 +120,9 @@ def cmd_lattice(args) -> None:
         n_theta=args.n_theta, n_rings_fuel=args.rings_fuel,
         n_rings_pad=args.rings_pad, nz=args.nz,
     )
-    write_osh(args.output, coords, tets,
-              elem_tags={"class_id": region.astype(np.int32),
-                         "cell_id": cell_id.astype(np.int32)})
+    _save(args.output, coords, tets,
+          elem_tags={"class_id": region.astype(np.int32),
+                     "cell_id": cell_id.astype(np.int32)})
     print(f"wrote {args.output}: {coords.shape[0]} vertices, "
           f"{len(tets)} tets, {args.nx}x{args.ny} cells")
 

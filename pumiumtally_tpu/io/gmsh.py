@@ -268,3 +268,44 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
         np.concatenate(tets, axis=0) if tets else np.zeros((0, 4), np.int64)
     )
     return _finish(coords, ids, all_tets)
+
+
+def write_gmsh(
+    path: str,
+    coords: np.ndarray,
+    tet2vert: np.ndarray,
+    physical: np.ndarray | None = None,
+) -> None:
+    """Write a Gmsh MSH 2.2 ASCII file (tets only, 1-based node ids).
+
+    The inverse of the v2 reader above — lets the mesh generators emit
+    ``.msh`` for Gmsh-toolchain interop (the reference consumes Gmsh
+    output, README.md:115-125; this writer produces it). ``physical``
+    optionally carries a per-element integer id into the standard
+    physical-group tag (how Gmsh meshes carry material classification).
+    """
+    coords = np.asarray(coords, np.float64)
+    tets = np.asarray(tet2vert, np.int64) + 1
+    phys = (
+        np.zeros(tets.shape[0], np.int64)
+        if physical is None
+        else np.asarray(physical, np.int64).reshape(-1)
+    )
+    if phys.shape[0] != tets.shape[0]:
+        raise ValueError(
+            f"physical has {phys.shape[0]} values for {tets.shape[0]} tets"
+        )
+    lines = ["$MeshFormat", "2.2 0 8", "$EndMeshFormat",
+             "$Nodes", str(coords.shape[0])]
+    lines.extend(
+        f"{i + 1} {x!r} {y!r} {z!r}"
+        for i, (x, y, z) in enumerate(coords.tolist())
+    )
+    lines.extend(["$EndNodes", "$Elements", str(tets.shape[0])])
+    lines.extend(
+        f"{i + 1} 4 2 {int(p)} {int(p)} {a} {b} {c} {d}"
+        for i, ((a, b, c, d), p) in enumerate(zip(tets.tolist(), phys.tolist()))
+    )
+    lines.extend(["$EndElements", ""])
+    with open(path, "w") as f:
+        f.write("\n".join(lines))

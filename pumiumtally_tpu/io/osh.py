@@ -10,9 +10,15 @@ without re-running conversion.
 Layout implemented here (reconstructed from the public Omega_h sources
 — ``Omega_h_file.cpp`` for the stream framing, ``Omega_h_simplex.hpp``
 for the canonical downward templates, ``Omega_h_align.hpp`` for the
-alignment codes; there is no Omega_h build in this environment, so the
-codec is validated by self-round-trip and structural sanity checks, and
-every parse failure degrades to an actionable error):
+alignment codes). There is no Omega_h build in this environment (no
+network), so validation is: self-round-trip, structural sanity checks,
+and the ``tests/data/cube_omega*.osh`` fixtures — streams produced by
+an INDEPENDENT byte-level writer (``tools/make_osh_fixture.py``) that
+follows Omega_h's own derivation conventions (first-appearance entity
+numbering, child vertex order from the defining parent, nontrivial
+alignment codes, msh2osh-style tags, shared-vertex owners). Agreement
+with bytes from a genuine Omega_h binary remains unproven; every parse
+failure degrades to an actionable error:
 
     mesh.osh/
       nparts      ASCII int   — number of rank files

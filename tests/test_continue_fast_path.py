@@ -103,7 +103,10 @@ def test_two_phase_with_echoed_origins_matches_continue_bitwise():
     np.testing.assert_array_equal(results[0][2], results[1][2])
 
 
-@pytest.mark.parametrize("facade", ["mono", "sharded", "partitioned"])
+@pytest.mark.parametrize("facade", [
+    "mono", "sharded",
+    pytest.param("partitioned", marks=pytest.mark.slow),
+])
 def test_auto_continue_fires_on_echo_and_matches_disabled(facade):
     """Host-side auto-continue (TallyConfig.auto_continue): echoing the
     previous destinations as origins skips the origin upload, with
@@ -371,9 +374,19 @@ def test_echo_disarm_state_machine():
     assert t.auto_continue_hits == 1
     assert t._echo_misses == 0  # hit reset the streak
 
-    # A miss streak broken by hits never disarms.
-    for _ in range(_ECHO_MISS_LIMIT):
-        d3 = rng.uniform(0.05, 0.95, (n, 3))
-        move(d2, d3)  # echo hit every other move
-        d2 = d3
-    assert t._last_dests_host is not None
+    # A NONZERO miss streak is reset by a hit, so interleaved
+    # resample/echo drivers never disarm.
+    for _ in range(_ECHO_MISS_LIMIT - 2):
+        move(rng.uniform(0.05, 0.95, (n, 3)),
+             rng.uniform(0.05, 0.95, (n, 3)))  # real misses
+    assert 0 < t._echo_misses < _ECHO_MISS_LIMIT
+    d2 = t.positions.reshape(n, 3).copy()  # committed == last dests here
+    d3 = rng.uniform(0.05, 0.95, (n, 3))
+    hits_before = t.auto_continue_hits
+    move(d2, d3)  # echo hit with a live miss streak
+    assert t.auto_continue_hits == hits_before + 1
+    assert t._echo_misses == 0  # the hit reset the nonzero streak
+    for _ in range(_ECHO_MISS_LIMIT - 1):
+        move(rng.uniform(0.05, 0.95, (n, 3)),
+             rng.uniform(0.05, 0.95, (n, 3)))
+    assert t._last_dests_host is not None  # still armed: streak < limit

@@ -15,6 +15,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 
 def _free_port() -> int:
@@ -23,6 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_distributed_tally():
     # Bounded by the workers' communicate(timeout=280) below.
     import tempfile

@@ -106,6 +106,7 @@ def test_partitioned_matches_single_chip(continue_mode):
     )
 
 
+@pytest.mark.slow
 def test_partitioned_phase_a_migration_keeps_weights_aligned():
     """Resampled origins far from committed positions force phase-A
     migrations that permute slots; phase B must still tally each
@@ -198,6 +199,7 @@ def test_partitioned_split_adjacency_matches_packed():
                                rtol=1e-12, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_partitioned_stress_forced_migrations():
     """Load test: 8 chips, 100k particles, 6k tets, long steps forcing
     heavy cross-partition traffic; conservation must hold exactly (no
@@ -321,6 +323,7 @@ def test_partitioned_exit_and_hold_semantics():
     np.testing.assert_allclose(total, expect, rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_partitioned_scale_48k_tets_100k_particles():
     """VERDICT-scale stress: 48k-tet mesh (bench geometry) partitioned
     over 8 chips with 100k particles — localization and a long-step

@@ -109,6 +109,7 @@ def test_streaming_accumulates_and_writes(tmp_path):
     assert open(out, "rb").readline().startswith(b"# vtk")
 
 
+@pytest.mark.slow
 def test_streaming_partitioned_composition():
     """Chunked batches through the PARTITIONED engine (mesh sharded,
     particles migrate) must reproduce the monolithic flux — BASELINE
@@ -317,6 +318,7 @@ def test_streaming_locate_localization_matches_walk():
     np.testing.assert_allclose(out[0][2], out[1][2], rtol=1e-12, atol=1e-14)
 
 
+@pytest.mark.slow
 def test_streaming_partitioned_device_groups_matches_single_group():
     """dp x part hybrid: chunks round-robin over 2 disjoint 4-device
     groups (each partitioning the mesh over its own chips); flux and

@@ -55,6 +55,7 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(t2.flux), np.asarray(t.flux))
 
 
+@pytest.mark.slow
 def test_checkpoint_cross_engine_roundtrip(tmp_path):
     """A checkpoint is canonical: save from one engine kind, resume in
     another, and the continued tally matches exactly."""
@@ -158,6 +159,7 @@ def test_phase_timer_accumulates():
     assert s.t >= first
 
 
+@pytest.mark.slow
 def test_checkpoint_restore_into_device_groups_hybrid(tmp_path):
     """A monolithic checkpoint restores into the dp x part hybrid
     (device_groups=2) and transport continues identically."""

@@ -7,6 +7,7 @@ summation order in the scatter-add).
 """
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from pumiumtally_tpu import build_box
@@ -121,6 +122,7 @@ def test_cascade_respects_max_iter_budget():
     assert int(rk.iters) <= 3 + 3
 
 
+@pytest.mark.slow
 def test_cond_every_k_is_exact():
     """k-unrolled cond evaluation: per-particle results are bitwise
     identical (the s-parametrized step math is window-independent);

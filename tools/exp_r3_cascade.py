@@ -1,0 +1,96 @@
+"""Round-3 cascade A/B on the current backend: stage-boundary
+permutation mode ("arrays" r2 form / "packed" / "indirect") ×
+window_factor × cond_every at bench scale.
+
+The stage-boundary perm-apply was measured the largest cascade
+component on v5e (~51 ms/stage for the 8-array form at 500k,
+docs/PERF_NOTES.md); "packed" collapses it to 2 row gathers,
+"indirect" trades it for a per-iteration [W,8] ray gather, and
+window_factor > 2 halves the number of boundaries outright.
+
+Usage: python tools/exp_r3_cascade.py [N] [DIV] [MOVES]
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import build_box
+from pumiumtally_tpu.ops.walk import walk
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+DIV = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+MOVES = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+
+def main():
+    mesh = build_box(1, 1, 1, DIV, DIV, DIV, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    pts = [rng.uniform(0.05, 0.95, (N, 3)).astype(np.float32)]
+    for _ in range(MOVES + 1):
+        step = rng.normal(scale=0.25 / np.sqrt(3), size=(N, 3))
+        pts.append(np.clip(pts[-1] + step, 0.02, 0.98).astype(np.float32))
+
+    from pumiumtally_tpu.api.tally import _localize_step
+
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    x0, e0, done, _ = _localize_step(
+        mesh, jnp.broadcast_to(c0, (N, 3)), jnp.zeros((N,), jnp.int32),
+        jnp.asarray(pts[0]), tol=1e-6, max_iters=8192,
+    )
+    assert bool(jnp.all(done))
+    fly = jnp.ones((N,), jnp.int8)
+    w = jnp.ones((N,), jnp.float32)
+
+    results = []
+    sweeps = [
+        # (perm_mode, window_factor, cond_every)
+        ("arrays", 2, 4),    # round-2 configuration (control)
+        ("packed", 2, 4),    # new default
+        ("indirect", 2, 4),
+        ("packed", 4, 4),
+        ("packed", 8, 4),
+        ("indirect", 4, 4),
+        ("packed", 4, 8),
+        ("packed", 2, 8),
+        ("packed", 2, 16),
+    ]
+    for mode, wf, ce in sweeps:
+        g = jax.jit(partial(
+            walk, tally=True, tol=1e-6, max_iters=8192,
+            perm_mode=mode, window_factor=wf, cond_every=ce,
+        ))
+        # warmup move (compile)
+        r = g(mesh, x0, e0, jnp.asarray(pts[1]), fly, w,
+              jnp.zeros((mesh.nelems,), jnp.float32))
+        float(jnp.sum(r.flux))
+        x, e = r.x, r.elem
+        flux = r.flux
+        t0 = time.perf_counter()
+        for m in range(2, MOVES + 2):
+            r = g(mesh, x, e, jnp.asarray(pts[m]), fly, w, flux)
+            x, e, flux = r.x, r.elem, r.flux
+        total = float(jnp.sum(flux))
+        dt = time.perf_counter() - t0
+        rate = N * MOVES / dt
+        results.append((mode, wf, ce, rate, total))
+        print(f"perm={mode:8s} wf={wf} cond_every={ce:2d}: "
+              f"{rate/1e6:.3f}M moves/s  (sum flux {total:.1f})")
+
+    best = max(results, key=lambda r: r[3])
+    print(f"\nbest: perm={best[0]} wf={best[1]} cond_every={best[2]} "
+          f"at {best[3]/1e6:.3f}M moves/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,353 @@
+"""VMEM-resident walk prototype for small partitions (round-3 item 5).
+
+The production walk's floor is the HBM row gather (~80 B/crossing at
+the measured ~4-5 GB/s row-granularity DMA — docs/PERF_NOTES.md). For
+a PARTITION of L <= ~4k tets the packed [L,32] table (~0.5 MB) fits
+VMEM (~16 MB/core on v5e), so the gather can become a one-hot MXU
+matmul executed entirely on-chip:
+
+    row[W,32]  = onehot(elem)[W,L] @ table[L,32]      (row fetch)
+    flux[L]   += contrib[1,W] @ onehot(elem)[W,L]     (tally scatter)
+
+Two implementations, bitwise-checked against ops.walk.walk:
+
+- ``walk_onehot_jnp``: the same lock-step loop in plain jnp with the
+  one-hot matmuls. XLA may or may not fuse the [W,L] one-hot into the
+  dot; if it materializes in HBM this LOSES to the gather (4·L bytes
+  vs 80 per crossing) — measuring that is part of the experiment.
+- ``walk_vmem_pallas``: ONE pallas kernel per particle tile: the
+  table is pinned in VMEM, the whole while-loop runs inside the
+  kernel (no per-iteration XLA op boundaries, no HBM round-trips for
+  the carries), the one-hot lives only in VMEM scratch, and the tile's
+  flux partial accumulates in VMEM and is written once.
+
+Cost model (why only small L can win): the MXU work is
+2·W·L·128 FLOPs per iteration per tile regardless of how many lanes
+are still active, i.e. ~2·L·128/f FLOPs per crossing at active
+fraction f. At L=512 and f~0.5 that is ~6-10 ns/crossing on a v5e
+MXU — ~3-5x under the measured gather path; at L=4096 it is a wash.
+The partitioned engine hands each chip E/ndev elements, so this is a
+win exactly when partitions are (or are sub-split to) a few thousand
+tets — the sub-splitting pause/migrate overhead is NOT modeled here
+and must come off the top of any measured win.
+
+Usage:
+  python tools/exp_r3_vmem.py check     # CPU: semantics vs walk()
+  python tools/exp_r3_vmem.py bench [N] # TPU: rate sweep over L, W
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pumiumtally_tpu import build_box
+from pumiumtally_tpu.ops.walk import walk
+
+W_TILE = 256  # particles per pallas tile / jnp chunk
+
+
+def _padded_table(mesh):
+    """[L,32] f32: 12 normals, 4 offsets, 4 adjacency ids, 12 zeros."""
+    t = np.asarray(mesh.walk_table, np.float32)
+    L = t.shape[0]
+    out = np.zeros((L, 32), np.float32)
+    out[:, : t.shape[1]] = t
+    return jnp.asarray(out)
+
+
+def _advance_cols(row, s, elem, dest, d0, eff_w, done, tol, one):
+    """The walk's per-iteration math from a fetched [W,32] row, written
+    column-wise (no [W,4,3] reshape — pallas/Mosaic friendly). Mirrors
+    ops/walk.py::advance exactly; bitwise-identical given equal rows."""
+    active = ~done
+    # a_f = n_f . d0, b_f = off_f - n_f . x0   (x0 = dest - d0)
+    a_list, b_list = [], []
+    for f in range(4):
+        nx, ny, nz = row[:, 3 * f], row[:, 3 * f + 1], row[:, 3 * f + 2]
+        a_f = nx * d0[:, 0] + ny * d0[:, 1] + nz * d0[:, 2]
+        ndest = nx * dest[:, 0] + ny * dest[:, 1] + nz * dest[:, 2]
+        b_f = row[:, 12 + f] - ndest + a_f
+        a_list.append(a_f)
+        b_list.append(b_f)
+    inf = jnp.asarray(jnp.inf, s.dtype)
+    s_fs = []
+    for f in range(4):
+        crossing = a_list[f] * (one - s) > tol
+        s_f = jnp.where(crossing, b_list[f] / jnp.where(crossing, a_list[f], one), inf)
+        s_fs.append(jnp.maximum(s_f, s))
+    # min + argmin over the 4 faces, unrolled
+    s_exit = jnp.minimum(jnp.minimum(s_fs[0], s_fs[1]),
+                         jnp.minimum(s_fs[2], s_fs[3]))
+    adj = [row[:, 16 + f].astype(jnp.int32) for f in range(4)]
+    next_elem = adj[3]
+    for f in (2, 1, 0):  # first minimal face wins (matches argmin)
+        next_elem = jnp.where(s_fs[f] == s_exit, adj[f], next_elem)
+    reached = s_exit >= one
+    s_new = jnp.where(reached, one, s_exit)
+    hit_boundary = (~reached) & (next_elem == -1)
+    contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
+    moving = active & ~reached & ~hit_boundary
+    elem = jnp.where(moving, next_elem, elem)
+    s = jnp.where(active, s_new, s)
+    done = done | reached | hit_boundary
+    return s, elem, done, contrib
+
+
+def walk_onehot_jnp(mesh, x, elem, dest, in_flight, weight, flux, *,
+                    tol, max_iters):
+    """Lock-step walk with one-hot-MXU row fetch + flux accumulation
+    (no compaction cascade; per-chunk loop keeps the one-hot at
+    [W_TILE, L])."""
+    L = mesh.nelems
+    table = _padded_table(mesh)
+    one = jnp.asarray(1.0, x.dtype)
+    n = x.shape[0]
+    pad = (-n) % W_TILE
+    def padv(a, fill):
+        return jnp.concatenate([a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)])
+    if pad:
+        x, dest = padv(x, 0.0), padv(dest, 0.0)
+        elem = padv(elem, 0)
+        in_flight = padv(in_flight, 0)
+        weight = padv(weight, 0.0)
+    d0 = dest - x
+    seg = jnp.linalg.norm(d0, axis=1)
+    eff_w = jnp.where(in_flight.astype(bool), weight * seg, 0.0)
+    done0 = in_flight != in_flight
+    # hold particles (dest == x) finish on iteration 1 like walk()
+    T = (n + pad) // W_TILE
+    shp = lambda a: a.reshape(T, W_TILE, *a.shape[1:])
+    s0 = jnp.zeros_like(seg)
+
+    def chunk(args):
+        s, elem, done, dest_c, d0_c, effw_c = args
+        iota = jnp.arange(L, dtype=jnp.int32)
+
+        def body(carry):
+            it, s, elem, done, fl = carry
+            oh = (elem[:, None] == iota[None, :]).astype(table.dtype)
+            row = oh @ table  # [W,32]
+            s, elem, done, contrib = _advance_cols(
+                row, s, elem, dest_c, d0_c, effw_c, done, tol, one
+            )
+            fl = fl + contrib[None, :] @ oh  # [1,L]
+            return it + 1, s, elem, done, fl
+
+        def cond(carry):
+            it, _, _, done, _ = carry
+            return (it < max_iters) & jnp.any(~done)
+
+        it, s, elem, done, fl = lax.while_loop(
+            cond, body,
+            (jnp.asarray(0, jnp.int32), s, elem, done,
+             jnp.zeros((1, L), x.dtype)),
+        )
+        return s, elem, done, fl[0]
+
+    s, elem, done, fparts = lax.map(
+        chunk,
+        (shp(s0), shp(elem), shp(done0), shp(dest), shp(d0), shp(eff_w)),
+    )
+    s, elem, done = s.reshape(-1)[:n], elem.reshape(-1)[:n], done.reshape(-1)[:n]
+    dest, d0 = dest[:n], d0[:n]
+    flux = flux + jnp.sum(fparts, axis=0)
+    exited = done & (s < one)
+    x_fin = jnp.where((done & ~exited)[:, None], dest,
+                      dest + (s - one)[:, None] * d0)
+    return x_fin, elem, done, exited, flux
+
+
+def walk_vmem_pallas(mesh, x, elem, dest, in_flight, weight, flux, *,
+                     tol, max_iters, interpret=False):
+    """One pallas kernel per W_TILE particles: table in VMEM, the whole
+    while-loop inside the kernel, flux partial in VMEM scratch."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L = mesh.nelems
+    table = _padded_table(mesh)
+    fdtype = x.dtype
+    one = jnp.asarray(1.0, fdtype)
+    n = x.shape[0]
+    pad = (-n) % W_TILE
+    def padv(a, fill):
+        return jnp.concatenate([a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)])
+    if pad:
+        x, dest = padv(x, 0.0), padv(dest, 0.0)
+        elem = padv(elem, 0)
+        in_flight = padv(in_flight, 0)
+        weight = padv(weight, 0.0)
+    d0 = dest - x
+    seg = jnp.linalg.norm(d0, axis=1)
+    eff_w = jnp.where(in_flight.astype(bool), weight * seg, 0.0)
+    done0 = (in_flight == in_flight) & False
+    T = (n + pad) // W_TILE
+    max_iters = int(max_iters)  # static inside the kernel
+
+    def kernel(table_ref, s_ref, elem_ref, done_ref, dest_ref, d0_ref,
+               effw_ref, s_out, elem_out, done_out, flux_out, fl_scr):
+        table_v = table_ref[:]
+        dest_c = dest_ref[:]
+        d0_c = d0_ref[:]
+        effw_c = effw_ref[:]
+        one_k = jnp.asarray(1.0, s_ref.dtype)  # kernel-local constant
+        iota = lax.broadcasted_iota(jnp.int32, (W_TILE, L), 1)
+
+        def body(carry):
+            it, s, elem, done, fl = carry
+            oh = (elem[:, None] == iota).astype(table_v.dtype)
+            row = jnp.dot(oh, table_v, preferred_element_type=jnp.float32)
+            s, elem, done, contrib = _advance_cols(
+                row, s, elem, dest_c, d0_c, effw_c, done, tol, one_k
+            )
+            fl = fl + jnp.dot(contrib[None, :], oh,
+                              preferred_element_type=jnp.float32)
+            return it + jnp.int32(1), s, elem, done, fl
+
+        def cond(carry):
+            it, _, _, done, _ = carry
+            return (it < max_iters) & jnp.any(~done)
+
+        it0 = jnp.int32(0)
+        _, s, elem, done, fl = lax.while_loop(
+            cond, body,
+            (it0, s_ref[:], elem_ref[:], done_ref[:] != 0,
+             jnp.zeros((1, L), jnp.float32)),
+        )
+        s_out[:] = s
+        elem_out[:] = elem
+        done_out[:] = done.astype(jnp.int8)
+        flux_out[:] = fl
+
+    tile = lambda: pl.BlockSpec((W_TILE,), lambda t: (t,))
+    tile3 = lambda: pl.BlockSpec((W_TILE, 3), lambda t: (t, 0))
+    full = pl.BlockSpec((L, 32), lambda t: (0, 0))
+    s_o, elem_o, done_o, fparts = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[full, tile(), tile(), tile(), tile3(), tile3(), tile()],
+        out_specs=[tile(), tile(), tile(),
+                   pl.BlockSpec((1, L), lambda t: (t, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((T * W_TILE,), fdtype),
+            jax.ShapeDtypeStruct((T * W_TILE,), jnp.int32),
+            jax.ShapeDtypeStruct((T * W_TILE,), jnp.int8),
+            jax.ShapeDtypeStruct((T, L), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, L), jnp.float32)],
+        interpret=interpret,
+    )(table, jnp.zeros_like(seg), elem, done0.astype(jnp.int8),
+      dest, d0, eff_w)
+    s_o, elem_o = s_o[:n], elem_o[:n]
+    done = done_o[:n] != 0
+    dest, d0 = dest[:n], d0[:n]
+    flux = flux + jnp.sum(fparts, axis=0).astype(flux.dtype)
+    exited = done & (s_o < one)
+    x_fin = jnp.where((done & ~exited)[:, None], dest,
+                      dest + (s_o - one)[:, None] * d0)
+    return x_fin, elem_o, done, exited, flux
+
+
+# ---------------------------------------------------------------------------
+
+def _setup(divs, n, seed=0):
+    mesh = build_box(1, 1, 1, divs, divs, divs, dtype=jnp.float32)
+    rng = np.random.default_rng(seed)
+    src = rng.uniform(0.05, 0.95, (n, 3)).astype(np.float32)
+    dest = np.clip(
+        src + rng.normal(scale=0.25 / np.sqrt(3), size=(n, 3)), 0.02, 0.98
+    ).astype(np.float32)
+    from pumiumtally_tpu.api.tally import _localize_step
+
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    x, elem, done, _ = _localize_step(
+        mesh, jnp.broadcast_to(c0, (n, 3)), jnp.zeros((n,), jnp.int32),
+        jnp.asarray(src), tol=1e-6, max_iters=4096,
+    )
+    assert bool(jnp.all(done))
+    return mesh, x, elem, jnp.asarray(dest)
+
+
+def check():
+    n = 2000
+    for divs in (3, 5):
+        mesh, x, elem, dest = _setup(divs, n)
+        fly = jnp.ones((n,), jnp.int8)
+        w = jnp.asarray(np.random.default_rng(1).uniform(0.5, 1.5, n),
+                        jnp.float32)
+        f0 = jnp.zeros((mesh.nelems,), jnp.float32)
+        ref = walk(mesh, x, elem, dest, fly, w, f0, tally=True, tol=1e-6,
+                   max_iters=4096)
+        for name, fn in (
+            ("onehot_jnp", walk_onehot_jnp),
+            ("pallas_interpret",
+             partial(walk_vmem_pallas, interpret=True)),
+        ):
+            xf, ef, df, exf, fl = fn(mesh, x, elem, dest, fly, w, f0,
+                                     tol=1e-6, max_iters=4096)
+            assert bool(jnp.all(df)), name
+            # The column-wise dot products round differently from the
+            # einsum, so a destination ON a tet face may resolve to the
+            # face-adjacent neighbor (same benign class as partitioned
+            # mode) — bound the fraction instead of requiring equality.
+            mism = float(np.mean(np.asarray(ef) != np.asarray(ref.elem)))
+            assert mism < 0.01, (name, mism)
+            np.testing.assert_allclose(np.asarray(xf), np.asarray(ref.x),
+                                       atol=2e-6, err_msg=name)
+            np.testing.assert_allclose(
+                np.asarray(fl), np.asarray(ref.flux), rtol=2e-4, atol=1e-5,
+                err_msg=name)
+            print(f"divs={divs} {name}: OK "
+                  f"(sum flux {float(jnp.sum(fl)):.4f} "
+                  f"vs {float(jnp.sum(ref.flux)):.4f})")
+
+
+def bench(n):
+    for divs in (5, 6, 7, 8):  # L = 750, 1296, 2058, 3072
+        mesh, x, elem, dest = _setup(divs, n)
+        L = mesh.nelems
+        fly = jnp.ones((n,), jnp.int8)
+        w = jnp.ones((n,), jnp.float32)
+        f0 = jnp.zeros((L,), jnp.float32)
+        rows = {}
+        for name, fn in (
+            ("walk_gather", partial(walk, tally=True)),
+            ("onehot_jnp", walk_onehot_jnp),
+            ("pallas_vmem", walk_vmem_pallas),
+        ):
+            try:
+                g = jax.jit(partial(fn, tol=1e-6, max_iters=4096))
+                out = g(mesh, x, elem, dest, fly, w, f0)
+                fl = out.flux if hasattr(out, "flux") else out[4]
+                float(jnp.sum(fl))  # sync
+                t0 = time.perf_counter()
+                reps = 3
+                for _ in range(reps):
+                    out = g(mesh, x, elem, dest, fly, w, f0)
+                fl = out.flux if hasattr(out, "flux") else out[4]
+                float(jnp.sum(fl))
+                dt = (time.perf_counter() - t0) / reps
+                rows[name] = n / dt
+            except Exception as e:  # noqa: BLE001 — lowering may fail
+                rows[name] = f"FAILED: {type(e).__name__}: {str(e)[:200]}"
+        print(f"L={L}:")
+        for k, v in rows.items():
+            print(f"  {k:14s} "
+                  f"{v if isinstance(v, str) else f'{v/1e6:.2f}M moves/s'}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "bench":
+        bench(int(sys.argv[2]) if len(sys.argv) > 2 else 500_000)
+    else:
+        check()

@@ -1,0 +1,238 @@
+"""Generate the checked-in ``tests/data/*.osh`` fixtures.
+
+These fixtures validate ``pumiumtally_tpu.io.osh`` against an
+INDEPENDENT implementation of the Omega_h stream layout (reference
+PumiTallyImpl.cpp:562 reads real ``msh2osh`` output): every byte here
+is written by fresh ``struct.pack`` code sharing nothing with
+``io/osh.py``, and the mesh-derivation conventions deliberately differ
+from that module's writer in the ways genuine Omega_h differs:
+
+- entities (edges/triangles) are numbered by FIRST APPEARANCE while
+  iterating parents in order — Omega_h's ``reflect_down`` derivation —
+  not by sorted-unique key;
+- a triangle/edge stores its vertices in the order induced by the
+  FIRST parent that defined it, not ascending — so the tet→tri and
+  tri→edge alignment codes are nontrivial (rotations and flips appear,
+  computed per ``Omega_h_align.hpp``: ``code = rotation << 1 | flip``),
+  exercising the reader's claim that its vertex-set composition is
+  insensitive to them;
+- streams carry the tag set ``msh2osh`` output carries (``class_id`` /
+  ``class_dim`` on every dimension, ``global`` ids) and RIB hints are
+  present in the single-part stream;
+- the 2-part fixture has realistically SHARED interface vertices with
+  owner arrays pointing at the lower rank (not the fully-owned layout
+  io/osh.py's writer emits).
+
+What this cannot prove: agreement with bytes produced by a genuine
+Omega_h build (none is obtainable in this environment — no network).
+It does prove the reader decodes a stream written from the documented
+layout by code that cannot share a systematic bug with it.
+
+Run from the repo root:  python tools/make_osh_fixture.py
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+import zlib
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data")
+
+MAGIC = b"\xa1\x1a"
+VERSION = 9
+
+# Omega_h_simplex.hpp templates (same constants the reader documents).
+TET_FACES = [[0, 2, 1], [0, 1, 3], [1, 2, 3], [2, 0, 3]]
+TRI_EDGES = [[0, 1], [1, 2], [2, 0]]
+
+# The unit cube split into 6 tets around the main diagonal v0-v6.
+CUBE_COORDS = np.array([
+    [0, 0, 0], [1, 0, 0], [1, 1, 0], [0, 1, 0],
+    [0, 0, 1], [1, 0, 1], [1, 1, 1], [0, 1, 1],
+], dtype=np.float64)
+CUBE_TETS = np.array([
+    [0, 1, 2, 6], [0, 2, 3, 6], [0, 3, 7, 6],
+    [0, 7, 4, 6], [0, 4, 5, 6], [0, 5, 1, 6],
+], dtype=np.int64)
+
+
+def wv(f, fmt, v):
+    f.write(struct.pack(">" + fmt, v))
+
+
+def warr(f, arr, dtype):
+    a = np.ascontiguousarray(arr, dtype=dtype)
+    wv(f, "i", a.size)
+    z = zlib.compress(a.tobytes(), 6)
+    wv(f, "q", len(z))
+    f.write(z)
+
+
+def wstr(f, s):
+    b = s.encode()
+    wv(f, "i", len(b))
+    f.write(b)
+
+
+def align_code(stored, wanted):
+    """Omega_h_align.hpp: code = (rotation << 1) | is_flipped, for the
+    transformation taking the stored vertex tuple onto the parent's
+    template-induced tuple."""
+    stored = list(stored)
+    n = len(stored)
+    for flip in (0, 1):
+        t = stored if not flip else (
+            [stored[0]] + stored[1:][::-1] if n == 3 else stored[::-1]
+        )
+        for rot in range(n):
+            if t[rot:] + t[:rot] == list(wanted):
+                return (rot << 1) | flip
+    raise AssertionError(f"no alignment maps {stored} onto {wanted}")
+
+
+def derive_down(parents, templates):
+    """First-appearance child numbering; stored child vertex order from
+    the first defining parent (Omega_h reflect_down convention).
+    Returns (child_verts [C,k], parent2child [P,t], codes [P*t])."""
+    child_of = {}
+    child_verts = []
+    p2c = np.zeros((len(parents), len(templates)), np.int64)
+    codes = np.zeros((len(parents), len(templates)), np.int8)
+    for p, pv in enumerate(parents):
+        for t, tmpl in enumerate(templates):
+            induced = [int(pv[i]) for i in tmpl]
+            key = tuple(sorted(induced))
+            if key not in child_of:
+                child_of[key] = len(child_verts)
+                child_verts.append(induced)  # stored = creator's order
+            c = child_of[key]
+            p2c[p, t] = c
+            codes[p, t] = align_code(child_verts[c], induced)
+    return np.array(child_verts, np.int64), p2c, codes.reshape(-1)
+
+
+def write_stream(path, coords, tets, comm_size=1, comm_rank=0,
+                 vert_global=None, elem_global=None, owners=None,
+                 hints=False):
+    tri_verts, tet2tri, tet_codes = derive_down(tets, TET_FACES)
+    edge_verts, tri2edge, tri_codes = derive_down(tri_verts, TRI_EDGES)
+    nv, ned, ntr, nte = (coords.shape[0], edge_verts.shape[0],
+                         tri_verts.shape[0], tets.shape[0])
+    nents = [nv, ned, ntr, nte]
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        wv(f, "i", VERSION)
+        wv(f, "b", 1)          # compressed
+        wv(f, "b", 0)          # family: simplex
+        wv(f, "b", 3)          # dim
+        wv(f, "i", comm_size)
+        wv(f, "i", comm_rank)
+        wv(f, "b", 2)          # parting: elem-based
+        wv(f, "i", 0)          # nghost_layers
+        if hints:
+            wv(f, "b", 1)
+            wv(f, "i", 2)      # naxes
+            f.write(struct.pack(">6d", *([0.5] * 6)))  # 2 axes x 3 x f64
+        else:
+            wv(f, "b", 0)
+        wv(f, "i", nv)
+        warr(f, edge_verts.reshape(-1), ">i4")
+        warr(f, tri2edge.reshape(-1), ">i4")
+        warr(f, tri_codes, ">i1")
+        warr(f, tet2tri.reshape(-1), ">i4")
+        warr(f, tet_codes, ">i1")
+        for d in range(4):
+            tags = []
+            if d == 0:
+                tags.append(("coordinates", 3, 5, coords.reshape(-1), ">f8"))
+                if vert_global is not None:
+                    tags.append(("global", 1, 3, vert_global, ">i8"))
+            if d == 3 and elem_global is not None:
+                tags.append(("global", 1, 3, elem_global, ">i8"))
+            # the classification tags msh2osh output carries
+            tags.append(("class_id", 1, 2,
+                         np.full(nents[d], 73, np.int64), ">i4"))
+            tags.append(("class_dim", 1, 0,
+                         np.full(nents[d], 3, np.int64), ">i1"))
+            wv(f, "i", len(tags))
+            for name, ncomps, typ, data, dt in tags:
+                wstr(f, name)
+                wv(f, "b", ncomps)
+                wv(f, "b", typ)
+                warr(f, data, dt)
+            if comm_size > 1:
+                ranks, idxs = owners[d]
+                warr(f, ranks, ">i4")
+                warr(f, idxs, ">i4")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+
+    # -- single-part fixture ------------------------------------------
+    d1 = os.path.join(OUT, "cube_omega1.osh")
+    os.makedirs(d1, exist_ok=True)
+    with open(os.path.join(d1, "nparts"), "w") as f:
+        f.write("1\n")
+    with open(os.path.join(d1, "version"), "w") as f:
+        f.write(f"{VERSION}\n")
+    write_stream(os.path.join(d1, "0.osh"), CUBE_COORDS, CUBE_TETS,
+                 vert_global=np.arange(8), elem_global=np.arange(6),
+                 hints=True)
+
+    # -- two-part fixture (shared interface vertices, real owners) ----
+    d2 = os.path.join(OUT, "cube_omega2.osh")
+    os.makedirs(d2, exist_ok=True)
+    with open(os.path.join(d2, "nparts"), "w") as f:
+        f.write("2\n")
+    with open(os.path.join(d2, "version"), "w") as f:
+        f.write(f"{VERSION}\n")
+    split = [CUBE_TETS[:3], CUBE_TETS[3:]]
+    rank_gverts = []
+    rank_local = []
+    for rtets in split:
+        gv, inv = np.unique(rtets, return_inverse=True)  # local numbering
+        rank_gverts.append(gv)
+        rank_local.append(inv.reshape(rtets.shape))
+    for rank in range(2):
+        gv = rank_gverts[rank]
+        # owners: a shared vertex belongs to the LOWER rank that stores
+        # it; idx = its local id on the owner rank.
+        ranks = np.zeros(gv.size, np.int64)
+        idxs = np.zeros(gv.size, np.int64)
+        other = rank_gverts[0]
+        for i, g in enumerate(gv):
+            if rank == 1 and g in other:
+                ranks[i] = 0
+                idxs[i] = int(np.searchsorted(other, g))
+            else:
+                ranks[i] = rank
+                idxs[i] = i
+        nloc_e = split[rank].shape[0]
+        tri_verts = derive_down(rank_local[rank], TET_FACES)[0]
+        nloc_t = tri_verts.shape[0]
+        nloc_ed = derive_down(tri_verts, TRI_EDGES)[0].shape[0]
+        owners = {
+            0: (ranks, idxs),
+            1: (np.full(nloc_ed, rank), np.arange(nloc_ed)),
+            2: (np.full(nloc_t, rank), np.arange(nloc_t)),
+            3: (np.full(nloc_e, rank), np.arange(nloc_e)),
+        }
+        write_stream(
+            os.path.join(d2, f"{rank}.osh"),
+            CUBE_COORDS[gv], rank_local[rank],
+            comm_size=2, comm_rank=rank,
+            vert_global=gv.astype(np.int64),
+            elem_global=np.arange(3 * rank, 3 * rank + 3, dtype=np.int64),
+            owners=owners,
+        )
+    print(f"wrote {d1} and {d2}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
