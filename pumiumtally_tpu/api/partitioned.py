@@ -56,6 +56,7 @@ class PartitionedPumiTally(PumiTally):
             max_iters=self._max_iters,
             max_rounds=self.config.max_migration_rounds,
             check_found_all=self.config.check_found_all,
+            cond_every=self.config.resolved_cond_every(),
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

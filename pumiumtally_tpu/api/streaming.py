@@ -268,6 +268,7 @@ class StreamingTally(PumiTally):
             self._x[k], self._elem[k], done, _ = sharded_localize_step(
                 self.device_mesh, self.mesh, x, elem,
                 dest, tol=self._tol, max_iters=self._max_iters,
+                walk_kw=self._walk_kw,
             )
             return done
         if self.config.localization == "locate":
@@ -280,6 +281,7 @@ class StreamingTally(PumiTally):
         self._x[k], self._elem[k], done, _ = _localize_step(
             self.mesh, x, elem, dest,
             tol=self._tol, max_iters=self._max_iters,
+            walk_kw=self._walk_kw,
         )
         return done
 
@@ -299,6 +301,7 @@ class StreamingTally(PumiTally):
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], dest, fly, w, self._flux[k],
                     tol=self._tol, max_iters=self._max_iters,
+                    walk_kw=self._walk_kw,
                 )
             else:
                 (
@@ -307,16 +310,19 @@ class StreamingTally(PumiTally):
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], orig, dest, fly, w, self._flux[k],
                     tol=self._tol, max_iters=self._max_iters,
+                    walk_kw=self._walk_kw,
                 )
         elif orig is None:
             self._x[k], self._elem[k], self._flux[k], ok = _move_step_continue(
                 self.mesh, self._x[k], self._elem[k], dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
+                walk_kw=self._walk_kw,
             )
         else:
             self._x[k], self._elem[k], self._flux[k], ok = _move_step(
                 self.mesh, self._x[k], self._elem[k], orig, dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
+                walk_kw=self._walk_kw,
             )
         return ok
 
@@ -420,6 +426,7 @@ class StreamingPartitionedTally(StreamingTally):
                 max_rounds=self.config.max_migration_rounds,
                 check_found_all=self.config.check_found_all,
                 part=part, shared_jit_cache=caches[g],
+                cond_every=self.config.resolved_cond_every(),
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

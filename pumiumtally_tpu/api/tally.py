@@ -159,20 +159,21 @@ def locate_or_committed(mesh, x, elem, dest, *, tol):
     return adopt_located(x, elem, dest, _locate_step(mesh, dest, tol=tol))
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iters"))
-def _localize_step(mesh, x, elem, dest, *, tol, max_iters):
+@partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))
+def _localize_step(mesh, x, elem, dest, *, tol, max_iters, walk_kw=()):
     n = x.shape[0]
     in_flight = jnp.ones((n,), jnp.int8)
     weight = jnp.zeros((n,), x.dtype)
     # A tally=False walk never touches flux — zero-size dummy.
     r = walk(
         mesh, x, elem, dest, in_flight, weight, jnp.zeros((0,), x.dtype),
-        tally=False, tol=tol, max_iters=max_iters,
+        tally=False, tol=tol, max_iters=max_iters, **dict(walk_kw),
     )
     return r.x, r.elem, r.done, r.exited
 
 
-def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol, max_iters):
+def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
+                       max_iters, walk_kw=()):
     """Phase-B-only move: transport from the COMMITTED state straight to
     the destinations, tallying. Semantically identical to ``move_step``
     when the caller's origins equal the committed positions — the common
@@ -184,12 +185,13 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol, max_
     dest_b = jnp.where(is_flying, dests, x)  # stopped → hold (cpp:100-103)
     rb = walk(
         mesh, x, elem, dest_b, flying, weights, flux,
-        tally=True, tol=tol, max_iters=max_iters,
+        tally=True, tol=tol, max_iters=max_iters, **dict(walk_kw),
     )
     return rb.x, rb.elem, rb.flux, jnp.all(rb.done)
 
 
-def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_iters):
+def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
+              max_iters, walk_kw=()):
     """One full MoveToNextLocation: phase A (relocate, no tally) then
     phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149.
 
@@ -217,7 +219,7 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_
         ra = walk(
             mesh, x_, elem_, dest_a, in_flight, zero_w,
             jnp.zeros((0,), x_.dtype),
-            tally=False, tol=tol, max_iters=max_iters,
+            tally=False, tol=tol, max_iters=max_iters, **dict(walk_kw),
         )
         return ra.x, ra.elem, jnp.all(ra.done)
 
@@ -233,14 +235,16 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol, max_
     # Phase B is exactly the continue-mode move from the relocated state.
     x2, elem2, flux2, ok_b = move_step_continue(
         mesh, xa, ea, dests, flying, weights, flux,
-        tol=tol, max_iters=max_iters,
+        tol=tol, max_iters=max_iters, walk_kw=walk_kw,
     )
     return x2, elem2, flux2, ok_a & ok_b
 
 
-_move_step = partial(jax.jit, static_argnames=("tol", "max_iters"))(move_step)
+_move_step = partial(
+    jax.jit, static_argnames=("tol", "max_iters", "walk_kw")
+)(move_step)
 _move_step_continue = partial(
-    jax.jit, static_argnames=("tol", "max_iters")
+    jax.jit, static_argnames=("tol", "max_iters", "walk_kw")
 )(move_step_continue)
 
 
@@ -308,6 +312,7 @@ class PumiTally:
         self.num_particles = int(num_particles)
         self._tol = self.config.resolved_tolerance(self.dtype)
         self._max_iters = self.config.resolved_max_iters(mesh.nelems)
+        self._walk_kw = self.config.walk_kwargs()  # static jit arg
         self.iter_count = 0
         self.is_initialized = False
         self.tally_times = TallyTimes()
@@ -476,6 +481,7 @@ class PumiTally:
             self.x, self.elem, done, exited = sharded_localize_step(
                 self.device_mesh, self.mesh, x, elem, dest,
                 tol=self._tol, max_iters=self._max_iters,
+                walk_kw=self._walk_kw,
             )
             return jnp.all(done), jnp.sum(exited)
         if self.config.localization == "locate":
@@ -483,6 +489,7 @@ class PumiTally:
         self.x, self.elem, done, exited = _localize_step(
             self.mesh, self.x, self.elem, dest,
             tol=self._tol, max_iters=self._max_iters,
+            walk_kw=self._walk_kw,
         )
         return jnp.all(done), jnp.sum(exited)
 
@@ -501,6 +508,7 @@ class PumiTally:
         self.x, self.elem, done, exited = _localize_step(
             self.mesh, x, elem, dest,
             tol=self._tol, max_iters=self._max_iters,
+            walk_kw=self._walk_kw,
         )
         return jnp.all(done), jnp.sum(exited)
 
@@ -657,7 +665,8 @@ class PumiTally:
                 _move_step, self.mesh, self.x, self.elem, origins, dests
             )
         self.x, self.elem, self.flux, found_all = step(
-            fly, w, self.flux, tol=self._tol, max_iters=self._max_iters
+            fly, w, self.flux, tol=self._tol, max_iters=self._max_iters,
+            walk_kw=self._walk_kw,
         )
         return found_all
 

@@ -117,6 +117,23 @@ class TallyConfig:
     device_mesh: Optional[jax.sharding.Mesh] = None
     capacity_factor: float = 1.5
     max_migration_rounds: int = 64
+    # Walk-kernel tuning knobs (ops/walk.py) — exposed so a deployment
+    # can adopt the best measured configuration for its chip without
+    # code changes. Defaults = the kernel's own defaults (None = leave
+    # the kernel default in place, keeping jit cache keys identical to
+    # an untuned config). cond_every: unrolled iterations per while
+    # step; perm_mode: cascade stage-boundary permutation strategy
+    # ("arrays"/"packed"/"indirect"; "auto" resolves via
+    # PUMIUMTALLY_WALK_PERM); window_factor: cascade shrink ratio;
+    # min_window: smallest compaction window. The partitioned engines'
+    # ownership-restricted walk has NO compaction cascade (rounds are
+    # migration-bounded), so on the partitioned facades only
+    # cond_every applies; the other three knobs affect the
+    # monolithic/sharded/streaming walks.
+    walk_cond_every: Optional[int] = None
+    walk_perm_mode: Optional[str] = None
+    walk_window_factor: Optional[int] = None
+    walk_min_window: Optional[int] = None
     # StreamingPartitionedTally only: split the device mesh into this
     # many disjoint groups — chunks round-robin across them, so G
     # chunks transport concurrently (particle data parallelism across
@@ -136,6 +153,45 @@ class TallyConfig:
             raise ValueError(
                 f"device_groups must be >= 1, got {self.device_groups!r}"
             )
+        if self.walk_perm_mode is not None and self.walk_perm_mode not in (
+            "auto", "arrays", "packed", "indirect"
+        ):
+            raise ValueError(
+                "walk_perm_mode must be auto/arrays/packed/indirect, "
+                f"got {self.walk_perm_mode!r}"
+            )
+        if self.walk_window_factor is not None and int(
+            self.walk_window_factor
+        ) < 2:
+            raise ValueError(
+                f"walk_window_factor must be >= 2, "
+                f"got {self.walk_window_factor!r}"
+            )
+        if self.walk_cond_every is not None and int(self.walk_cond_every) < 1:
+            raise ValueError(
+                f"walk_cond_every must be >= 1, got {self.walk_cond_every!r}"
+            )
+
+    def resolved_cond_every(self) -> int:
+        """cond_every with the kernel default applied (the one knob the
+        partitioned engines consume directly)."""
+        return 4 if self.walk_cond_every is None else int(self.walk_cond_every)
+
+    def walk_kwargs(self) -> tuple:
+        """The non-default walk-kernel knobs as a hashable tuple of
+        (name, value) pairs — passed as a STATIC argument through the
+        jitted step functions (an untuned config yields ``()``, so its
+        jit cache keys match pre-knob builds)."""
+        out = []
+        if self.walk_cond_every is not None:
+            out.append(("cond_every", int(self.walk_cond_every)))
+        if self.walk_perm_mode is not None:
+            out.append(("perm_mode", self.walk_perm_mode))
+        if self.walk_window_factor is not None:
+            out.append(("window_factor", int(self.walk_window_factor)))
+        if self.walk_min_window is not None:
+            out.append(("min_window", int(self.walk_min_window)))
+        return tuple(out)
 
     def resolved_dtype(self) -> Any:
         return self.dtype if self.dtype is not None else default_float_dtype()

@@ -446,6 +446,7 @@ class PartitionedEngine:
         check_found_all: bool = True,
         part: Optional[MeshPartition] = None,
         shared_jit_cache: Optional[dict] = None,
+        cond_every: int = 4,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -470,6 +471,7 @@ class PartitionedEngine:
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
+        self.cond_every = int(cond_every)
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.ndev * self.part.L,), dtype)
         # Initial layout: particle pid occupies slot pid (chips get
@@ -644,7 +646,7 @@ class PartitionedEngine:
         # fully identical configuration (chunked engines differ in the
         # last, smaller chunk's capacity).
         key = ("phase", tally, self.cap_per_chip, self.max_rounds,
-               self.max_iters, self.tol, id(self.part))
+               self.max_iters, self.tol, self.cond_every, id(self.part))
         if key in self._jit_cache:
             return self._jit_cache[key]
         pp = P(self.axis)
@@ -652,6 +654,7 @@ class PartitionedEngine:
         part_L, ndev, cpc = self.part.L, self.ndev, self.cap_per_chip
         tol, max_iters = self.tol, self.max_iters
         max_rounds = self.max_rounds
+        cond_every = self.cond_every
         has_adj = self.part.adj_int is not None
 
         def round_kernel(table, *rest):
@@ -663,6 +666,7 @@ class PartitionedEngine:
             x, lelem, done, exited, pending, flux, _ = walk_local(
                 table, x, lelem, dest, fly, w, done, exited, flux,
                 tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
+                cond_every=cond_every,
             )
             # Global round status computed in-program (one psum each) so
             # the while_loop can branch on them without leaving the

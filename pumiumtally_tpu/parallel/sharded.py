@@ -56,7 +56,7 @@ _axis_name = axis_name
 
 @partial(
     jax.jit,
-    static_argnames=("device_mesh", "tol", "max_iters"),
+    static_argnames=("device_mesh", "tol", "max_iters", "walk_kw"),
 )
 def sharded_localize_step(
     device_mesh: Mesh,
@@ -67,6 +67,7 @@ def sharded_localize_step(
     *,
     tol: float,
     max_iters: int,
+    walk_kw: tuple = (),
 ):
     """Non-tallying localization walk, particles sharded over ``dp``.
 
@@ -96,6 +97,7 @@ def sharded_localize_step(
             tally=False,
             tol=tol,
             max_iters=max_iters,
+            **dict(walk_kw),
         )
         return r.x, r.elem, r.done, r.exited
 
@@ -133,7 +135,8 @@ def sharded_locate(
     return step(mesh, pts)
 
 
-def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux, tol, max_iters):
+def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux,
+                        tol, max_iters, walk_kw=()):
     """Common shard_map scaffold for the tallied move variants.
 
     ``particle_args`` are sharded over the particle axis; the tet mesh
@@ -156,7 +159,8 @@ def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux, tol, ma
         *pargs, flux_ = rest
         zero_flux = _pvary(jnp.zeros_like(flux_), ax)
         x2, elem2, dflux, local_ok = step_fn(
-            mesh_, *pargs, zero_flux, tol=tol, max_iters=max_iters
+            mesh_, *pargs, zero_flux, tol=tol, max_iters=max_iters,
+            walk_kw=walk_kw,
         )
         flux_out = flux_ + lax.psum(dflux, ax)
         found_all = (
@@ -169,7 +173,7 @@ def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux, tol, ma
 
 @partial(
     jax.jit,
-    static_argnames=("device_mesh", "tol", "max_iters"),
+    static_argnames=("device_mesh", "tol", "max_iters", "walk_kw"),
 )
 def sharded_move_step(
     device_mesh: Mesh,
@@ -184,6 +188,7 @@ def sharded_move_step(
     *,
     tol: float,
     max_iters: int,
+    walk_kw: tuple = (),
 ):
     """One two-phase MoveToNextLocation over the device mesh."""
     from pumiumtally_tpu.api.tally import move_step
@@ -191,12 +196,13 @@ def sharded_move_step(
     return _sharded_tally_step(
         device_mesh, move_step, mesh,
         (x, elem, origins, dests, flying, weights), flux, tol, max_iters,
+        walk_kw=walk_kw,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("device_mesh", "tol", "max_iters"),
+    static_argnames=("device_mesh", "tol", "max_iters", "walk_kw"),
 )
 def sharded_move_step_continue(
     device_mesh: Mesh,
@@ -210,6 +216,7 @@ def sharded_move_step_continue(
     *,
     tol: float,
     max_iters: int,
+    walk_kw: tuple = (),
 ):
     """Phase-B-only sharded move: transport straight from the committed
     (sharded) state — the ``origins=None`` fast path of the API (see
@@ -219,4 +226,5 @@ def sharded_move_step_continue(
     return _sharded_tally_step(
         device_mesh, move_step_continue, mesh,
         (x, elem, dests, flying, weights), flux, tol, max_iters,
+        walk_kw=walk_kw,
     )

@@ -325,3 +325,73 @@ def test_native_device_groups_env(monkeypatch, tmp_path):
 
     with pytest.raises(ValueError, match="device_groups"):
         TallyConfig(device_groups=0)
+
+
+def test_walk_tuning_knobs_reach_all_facades():
+    """TallyConfig.walk_* knobs flow through every facade's jitted
+    dispatch as static args; a tuned config reproduces the untuned
+    flux/positions exactly (perm modes are bitwise-identical; cascade
+    shape changes only reorder the scatter within FP tolerance). On
+    the partitioned facade only cond_every reaches the engine (its
+    walk has no cascade — see TallyConfig); the equality here checks
+    that the remaining knobs are at least harmless there."""
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        StreamingTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 1500
+    rng = np.random.default_rng(31)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    tuned = dict(walk_cond_every=2, walk_perm_mode="indirect",
+                 walk_window_factor=4, walk_min_window=256)
+    dm = make_device_mesh(8)
+
+    for cls, base_kw in (
+        (PumiTally, {}),
+        (PumiTally, {"device_mesh": dm}),
+        (StreamingTally, {}),
+        (PartitionedPumiTally,
+         {"device_mesh": dm, "capacity_factor": 8.0}),
+    ):
+        out = []
+        for knobs in ({}, tuned):
+            cfg = TallyConfig(**base_kw, **knobs)
+            if cls is StreamingTally:
+                t = cls(mesh, n, chunk_size=512, config=cfg)
+            else:
+                t = cls(mesh, n, cfg)
+            assert t._walk_kw == cfg.walk_kwargs()
+            t.CopyInitialPosition(src.reshape(-1).copy())
+            t.MoveToNextLocation(None, d1.reshape(-1).copy())
+            out.append((np.asarray(t.flux, np.float64), t.positions))
+        np.testing.assert_allclose(out[0][0], out[1][0],
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_array_equal(out[0][1], out[1][1])
+
+    with pytest.raises(ValueError):
+        TallyConfig(walk_perm_mode="bogus")
+    with pytest.raises(ValueError):
+        TallyConfig(walk_window_factor=1)
+
+
+def test_partitioned_engine_consumes_cond_every():
+    """The one walk knob the partitioned engines support must actually
+    reach the engine (and an invalid value must be rejected)."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    t = PartitionedPumiTally(
+        mesh, 64,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
+                    walk_cond_every=2),
+    )
+    assert t.engine.cond_every == 2
+    with pytest.raises(ValueError):
+        TallyConfig(walk_cond_every=0)
