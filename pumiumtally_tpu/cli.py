@@ -127,6 +127,28 @@ def cmd_lattice(args) -> None:
           f"{len(tets)} tets, {args.nx}x{args.ny} cells")
 
 
+def cmd_autotune(args) -> None:
+    """Measure the walk-kernel tuning knobs on the CURRENT backend for
+    the given mesh and print the winning TallyConfig settings (see
+    utils/autotune.py — the deployment-measures-instead-of-guesses
+    counterpart of the reference's hard-coded Kokkos launch params)."""
+    from pumiumtally_tpu.mesh.tetmesh import TetMesh
+    from pumiumtally_tpu.utils.autotune import autotune_walk
+
+    coords, tets = _load(args.mesh)
+    mesh = TetMesh.from_arrays(coords, tets)
+    cfg, report = autotune_walk(
+        mesh, n_particles=args.particles, moves=args.moves, verbose=True,
+    )
+    kw = cfg.walk_kwargs()  # normalized: () when the winner == defaults
+    settings = (
+        ", ".join(f"walk_{k}={v!r}" for k, v in kw)
+        if kw else "<defaults — no knob beats them on this backend>"
+    )
+    print(f"\nbest: {report[0]['moves_per_sec'] / 1e6:.3f}M moves/s with "
+          f"TallyConfig({settings})")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         prog="pumiumtally",
@@ -185,6 +207,15 @@ def main(argv=None) -> None:
     c.add_argument("--nx", type=int, default=17)
     c.add_argument("--ny", type=int, default=17)
     c.set_defaults(fn=cmd_lattice)
+
+    c = sub.add_parser(
+        "autotune",
+        help="measure walk-kernel knobs on this backend, print the best",
+    )
+    c.add_argument("mesh")
+    c.add_argument("--particles", type=int, default=200_000)
+    c.add_argument("--moves", type=int, default=3)
+    c.set_defaults(fn=cmd_autotune)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -175,7 +175,13 @@ class TallyConfig:
     def resolved_cond_every(self) -> int:
         """cond_every with the kernel default applied (the one knob the
         partitioned engines consume directly)."""
-        return 4 if self.walk_cond_every is None else int(self.walk_cond_every)
+        from pumiumtally_tpu.ops.walk import COND_EVERY_DEFAULT
+
+        return (
+            COND_EVERY_DEFAULT
+            if self.walk_cond_every is None
+            else int(self.walk_cond_every)
+        )
 
     def walk_kwargs(self) -> tuple:
         """The non-default walk-kernel knobs as a hashable tuple of

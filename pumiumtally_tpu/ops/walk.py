@@ -70,6 +70,12 @@ from pumiumtally_tpu.mesh.tetmesh import (
 # pays for the sort (and TPU vector units run underutilized anyway).
 _MIN_WINDOW = 8192
 
+# Kernel defaults, exported so config resolution / autotuning /
+# partitioned-engine plumbing reference ONE source of truth (these have
+# already been retuned from measurement once — cond_every 1→4).
+COND_EVERY_DEFAULT = 4
+WINDOW_FACTOR_DEFAULT = 2
+
 # How the compaction cascade applies the survivor permutation at each
 # stage boundary. All three produce BITWISE-identical results (same
 # values, same scatter order); they differ only in how many random-row
@@ -179,8 +185,8 @@ def walk(
     max_iters: int,
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
-    cond_every: int = 4,
-    window_factor: int = 2,
+    cond_every: int = COND_EVERY_DEFAULT,
+    window_factor: int = WINDOW_FACTOR_DEFAULT,
     perm_mode: str = "auto",
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.

@@ -63,7 +63,7 @@ from pumiumtally_tpu.mesh.tetmesh import (
     WALK_TABLE_OFFSETS,
 )
 from pumiumtally_tpu.ops.geometry import locate_chunk_by_planes
-from pumiumtally_tpu.ops.walk import fused_tally_body
+from pumiumtally_tpu.ops.walk import COND_EVERY_DEFAULT, fused_tally_body
 from pumiumtally_tpu.parallel.sharded import _axis_name
 
 try:  # jax >= 0.8
@@ -223,7 +223,7 @@ def walk_local(
     tol: float,
     max_iters: int,
     adj_int: Optional[jnp.ndarray] = None,  # [L,4] when ids don't fit the float
-    cond_every: int = 4,
+    cond_every: int = COND_EVERY_DEFAULT,
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
@@ -446,7 +446,7 @@ class PartitionedEngine:
         check_found_all: bool = True,
         part: Optional[MeshPartition] = None,
         shared_jit_cache: Optional[dict] = None,
-        cond_every: int = 4,
+        cond_every: int = COND_EVERY_DEFAULT,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the

@@ -1,10 +1,13 @@
-"""Aux subsystems: logging, profiling, checkpoint/resume (SURVEY.md §5)."""
+"""Aux subsystems: logging, profiling, checkpoint/resume, autotuning
+(SURVEY.md §5)."""
 
+from pumiumtally_tpu.utils.autotune import autotune_walk
 from pumiumtally_tpu.utils.logging import get_logger, set_verbosity
 from pumiumtally_tpu.utils.profiling import phase_timer, trace
 from pumiumtally_tpu.utils.checkpoint import load_tally_state, save_tally_state
 
 __all__ = [
+    "autotune_walk",
     "get_logger",
     "set_verbosity",
     "phase_timer",

@@ -1,0 +1,166 @@
+"""Walk-kernel autotuner: measure, pick, return a tuned ``TallyConfig``.
+
+The walk kernel's throughput knobs (``TallyConfig.walk_*`` —
+``cond_every`` unroll depth, cascade permutation strategy, window
+shrink ratio, smallest window) have no universally best setting: the
+optimum depends on the backend (TPU generation vs CPU), the mesh size
+(gather-table locality), and the step-length distribution (how fast
+the active set decays). The reference hard-codes its equivalents
+(Kokkos launch parameters); here the deployment can measure instead of
+guess — the same philosophy as XLA's own gemm autotuning.
+
+``autotune_walk`` times a short, synthetic-but-representative workload
+(same shape as bench.py's: uniform interior sources, clipped gaussian
+steps) for each candidate configuration ON THE CURRENT BACKEND and
+returns the fastest as a ready-to-use ``TallyConfig``. Results are
+correctness-invariant by construction: every candidate runs the same
+bitwise-specified walk (permutation modes are bitwise-identical;
+cond_every/window changes only reorder the flux scatter within FP
+tolerance), so tuning can never change physics.
+
+Typical use (once per deployment/mesh class, ~a minute on a TPU):
+
+    from pumiumtally_tpu.utils.autotune import autotune_walk
+    cfg, report = autotune_walk(mesh, n_particles=200_000)
+    tally = PumiTally(mesh, n, cfg)
+
+Pass ``candidates=`` to sweep a custom grid, and ``base=`` to tune on
+top of an existing config (device mesh, tolerances etc. are preserved).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pumiumtally_tpu.config import TallyConfig
+
+# Default grid: the configurations that showed up as winners or
+# near-winners in the round-2/3 measurements (docs/PERF_NOTES.md).
+# Small on purpose — autotuning pays one jit compile per entry.
+DEFAULT_CANDIDATES: Tuple[dict, ...] = (
+    {"walk_perm_mode": "packed", "walk_cond_every": 4},
+    {"walk_perm_mode": "packed", "walk_cond_every": 8},
+    {"walk_perm_mode": "indirect", "walk_cond_every": 4},
+    {"walk_perm_mode": "packed", "walk_cond_every": 4,
+     "walk_window_factor": 4},
+    {"walk_perm_mode": "indirect", "walk_cond_every": 4,
+     "walk_window_factor": 4},
+    {"walk_perm_mode": "arrays", "walk_cond_every": 4},
+)
+
+
+def _workload(mesh, n: int, moves: int, mean_step: float, seed: int):
+    """bench.py-shaped trajectory strictly inside the mesh's bbox."""
+    import jax.numpy as jnp
+
+    coords = np.asarray(mesh.coords, np.float64)
+    lo, hi = coords.min(axis=0), coords.max(axis=0)
+    span = hi - lo
+    rng = np.random.default_rng(seed)
+    pts = [lo + rng.uniform(0.05, 0.95, (n, 3)) * span]
+    for _ in range(moves + 1):
+        step = rng.normal(scale=mean_step / np.sqrt(3.0), size=(n, 3)) * span
+        pts.append(np.clip(pts[-1] + step, lo + 0.02 * span, hi - 0.02 * span))
+    dt = mesh.coords.dtype
+    return [jnp.asarray(p, dt) for p in pts]
+
+
+def autotune_walk(
+    mesh,
+    n_particles: int = 200_000,
+    moves: int = 3,
+    mean_step: float = 0.25,
+    candidates: Optional[Sequence[dict]] = None,
+    base: Optional[TallyConfig] = None,
+    seed: int = 0,
+    verbose: bool = False,
+) -> Tuple[TallyConfig, List[dict]]:
+    """Measure each candidate's continue-mode walk rate on the current
+    backend; return (best TallyConfig, full report).
+
+    ``mesh`` is a ``TetMesh`` (or anything ``build_box`` etc. return).
+    The report is a list of ``{"knobs", "moves_per_sec"}`` dicts sorted
+    fastest-first; entry 0 produced the returned config. The sweep uses
+    the raw kernel (``ops.walk.walk``) — no facade/staging noise — with
+    one warmup (compile) move per candidate and ``moves`` timed moves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.api.tally import _localize_step
+    from pumiumtally_tpu.ops.walk import walk
+
+    cands = list(candidates if candidates is not None else DEFAULT_CANDIDATES)
+    base = base if base is not None else TallyConfig()
+    pts = _workload(mesh, n_particles, moves, mean_step, seed)
+
+    # One shared localization (identical start state for every candidate).
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    tol = base.resolved_tolerance(mesh.coords.dtype)
+    max_iters = base.resolved_max_iters(mesh.nelems)
+    x0, e0, done, _ = _localize_step(
+        mesh,
+        jnp.broadcast_to(c0, (n_particles, 3)),
+        jnp.zeros((n_particles,), jnp.int32),
+        pts[0], tol=tol, max_iters=max_iters,
+    )
+    if not bool(jnp.all(done)):
+        raise RuntimeError("autotune workload failed to localize")
+    fly = jnp.ones((n_particles,), jnp.int8)
+    w = jnp.ones((n_particles,), mesh.coords.dtype)
+
+    report = []
+    for knobs in cands:
+        cfg = dataclasses.replace(base, **knobs)
+        kw = dict(cfg.walk_kwargs())
+        g = jax.jit(partial(
+            walk, tally=True, tol=tol, max_iters=max_iters, **kw
+        ))
+        flux0 = jnp.zeros((mesh.nelems,), mesh.coords.dtype)
+        r = g(mesh, x0, e0, pts[1], fly, w, flux0)  # warmup/compile
+        float(jnp.sum(r.flux))  # sync (block_until_ready is lazy on
+        x, e, flux = r.x, r.elem, r.flux  # some remote backends)
+        t0 = time.perf_counter()
+        for m in range(2, moves + 2):
+            r = g(mesh, x, e, pts[m], fly, w, flux)
+            x, e, flux = r.x, r.elem, r.flux
+        float(jnp.sum(flux))
+        rate = n_particles * moves / (time.perf_counter() - t0)
+        report.append({"knobs": dict(knobs), "moves_per_sec": rate})
+        if verbose:
+            print(f"autotune: {knobs} -> {rate / 1e6:.3f}M moves/s")
+
+    report.sort(key=lambda r: -r["moves_per_sec"])
+    best = dataclasses.replace(base, **_drop_defaults(report[0]["knobs"]))
+    return best, report
+
+
+def _drop_defaults(knobs: dict) -> dict:
+    """Strip knobs whose value equals the kernel default: the returned
+    config must keep ``walk_kwargs() == ()`` whenever the winner is
+    computationally identical to untuned (config.py engineered that so
+    tuned and untuned tallies share jit cache entries)."""
+    from pumiumtally_tpu.ops.walk import (
+        _MIN_WINDOW,
+        _resolve_perm_mode,
+        COND_EVERY_DEFAULT,
+        WINDOW_FACTOR_DEFAULT,
+    )
+
+    out = dict(knobs)
+    if out.get("walk_cond_every") == COND_EVERY_DEFAULT:
+        out.pop("walk_cond_every")
+    if out.get("walk_window_factor") == WINDOW_FACTOR_DEFAULT:
+        out.pop("walk_window_factor")
+    if out.get("walk_min_window") == _MIN_WINDOW:
+        out.pop("walk_min_window")
+    if "walk_perm_mode" in out and out["walk_perm_mode"] == _resolve_perm_mode(
+        "auto"
+    ):
+        out.pop("walk_perm_mode")
+    return out

@@ -395,3 +395,46 @@ def test_partitioned_engine_consumes_cond_every():
     assert t.engine.cond_every == 2
     with pytest.raises(ValueError):
         TallyConfig(walk_cond_every=0)
+
+
+def test_walk_kw_actually_reaches_kernel(monkeypatch):
+    """Regression guard for the ~10 dispatch call sites: record the
+    kwargs the walk kernel RECEIVES (the knobs are performance-only, so
+    output parity alone cannot detect a dropped walk_kw argument)."""
+    import pumiumtally_tpu.api.tally as tally_mod
+    import pumiumtally_tpu.parallel.sharded as sharded_mod
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.ops.walk import walk as real_walk
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    seen = []
+
+    def recorder(*a, **kw):
+        seen.append({k: kw.get(k) for k in
+                     ("cond_every", "perm_mode", "min_window")})
+        return real_walk(*a, **kw)
+
+    monkeypatch.setattr(tally_mod, "walk", recorder)
+    monkeypatch.setattr(sharded_mod, "walk", recorder)
+
+    # Unique static values so the jitted steps cannot hit a cached
+    # trace from another test (tracing is when the recorder fires).
+    knobs = dict(walk_cond_every=3, walk_perm_mode="packed",
+                 walk_min_window=333)
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    n = 200
+    rng = np.random.default_rng(51)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    d1 = rng.uniform(0.1, 0.9, (n, 3))
+
+    for dm in (None, make_device_mesh(8)):
+        seen.clear()
+        t = PumiTally(mesh, n, TallyConfig(device_mesh=dm, **knobs))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), d1.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        t.MoveToNextLocation(None, src.reshape(-1).copy())
+        assert len(seen) >= 3  # localize + phase A/B + continue
+        for s in seen:
+            assert s == {"cond_every": 3, "perm_mode": "packed",
+                         "min_window": 333}, (dm, s)

@@ -190,3 +190,37 @@ def test_checkpoint_restore_into_device_groups_hybrid(tmp_path):
         np.asarray(b.flux, np.float64), np.asarray(a.flux, np.float64),
         rtol=1e-11, atol=1e-13,
     )
+
+
+def test_autotune_walk_returns_valid_tuned_config():
+    """The autotuner sweeps its grid on the current backend, returns a
+    usable TallyConfig whose tuned engine reproduces the untuned flux,
+    and preserves non-walk fields of the base config."""
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.utils import autotune_walk
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    base = TallyConfig(check_found_all=False)
+    cfg, report = autotune_walk(
+        mesh, n_particles=2000, moves=2,
+        candidates=[
+            {"walk_perm_mode": "packed"},
+            {"walk_perm_mode": "indirect", "walk_window_factor": 4},
+        ],
+        base=base,
+    )
+    assert len(report) == 2
+    assert report[0]["moves_per_sec"] >= report[1]["moves_per_sec"] > 0
+    assert cfg.walk_kwargs() != () and cfg.check_found_all is False
+
+    n = 800
+    rng = np.random.default_rng(41)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for c in (TallyConfig(), cfg):
+        t = PumiTally(mesh, n, c)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        out.append(np.asarray(t.flux, np.float64))
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-12, atol=1e-12)
