@@ -113,6 +113,45 @@ def check_conservation(total_flux: float, pts, first_move: int, last_move: int):
     return rel
 
 
+_TUNED_KNOBS: dict | None = None
+
+
+def tuned_knobs() -> dict:
+    """Walk-kernel knobs measured ONCE on this backend for the bench
+    mesh (utils/autotune.py; disable with PUMIUMTALLY_BENCH_AUTOTUNE=0).
+    Tuning cannot change physics — every candidate runs the same
+    bitwise-specified walk — so the conservation gate still applies
+    unchanged to the tuned engine."""
+    global _TUNED_KNOBS
+    if _TUNED_KNOBS is None:
+        if os.environ.get("PUMIUMTALLY_BENCH_AUTOTUNE", "1") == "0":
+            _TUNED_KNOBS = {}
+        else:
+            try:
+                from pumiumtally_tpu import build_box
+                from pumiumtally_tpu.utils.autotune import autotune_walk
+
+                mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
+                cfg, report = autotune_walk(
+                    mesh, n_particles=min(N, 200_000), moves=2,
+                    mean_step=MEAN_STEP,
+                )
+                _TUNED_KNOBS = {
+                    "walk_cond_every": cfg.walk_cond_every,
+                    "walk_perm_mode": cfg.walk_perm_mode,
+                    "walk_window_factor": cfg.walk_window_factor,
+                    "walk_min_window": cfg.walk_min_window,
+                }
+                print(f"# autotuned: {dict(cfg.walk_kwargs())} "
+                      f"({report[0]['moves_per_sec'] / 1e6:.2f}M moves/s in "
+                      "the sweep)", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — tuning is best-effort
+                print(f"# autotune failed, using default knobs: {e}",
+                      file=sys.stderr)
+                _TUNED_KNOBS = {}
+    return _TUNED_KNOBS
+
+
 def run_workload(n: int, moves: int, mode: str) -> dict:
     """Timed rates for `moves` tallied move steps of n particles.
 
@@ -129,6 +168,7 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
         check_found_all=False,
         auto_continue=(mode != "two_phase_forced"),
         fenced_timing=False,  # let moves pipeline; timed_moves syncs at the end
+        **tuned_knobs(),
     )
     t = PumiTally(mesh, n, cfg)
     rng = np.random.default_rng(0)
@@ -163,6 +203,9 @@ def run_pincell(n: int, moves: int) -> dict:
         pitch=pitch, height=height, n_theta=32, n_rings_fuel=5,
         n_rings_pad=5, nz=12,
     )
+    # Deliberately UNTUNED: the knobs were measured on the box mesh and
+    # the optimum is mesh-dependent; pincell stays on kernel defaults
+    # so its number compares round-over-round.
     t = PumiTally(mesh, n, TallyConfig(check_found_all=False, fenced_timing=False))
     rng = np.random.default_rng(1)
     pts = make_trajectory(rng, n, moves + 1, box=[pitch, pitch, height])
@@ -264,6 +307,9 @@ def main() -> None:
         env = dict(os.environ)
         env["PUMIUMTALLY_BENCH_CPU"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
+        # Baseline stays UNTUNED so vs_baseline's denominator keeps the
+        # semantics of earlier rounds (default-knob CPU engine).
+        env["PUMIUMTALLY_BENCH_AUTOTUNE"] = "0"
         # Don't let the child's interpreter-startup hook try to claim
         # the TPU tunnel the parent may be holding (it would block).
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -292,8 +338,13 @@ def main() -> None:
             "two_phase": "auto_continue=True, fenced_timing=False",
             "two_phase_forced": "auto_continue=False, fenced_timing=False",
             "continue": "origins=None, fenced_timing=False",
+            "tuning": "box workloads use autotuned_knobs (since r3); "
+                      "pincell and the CPU baseline stay on defaults",
         },
         "link_mb_per_sec": link_mb_s,
+        "autotuned_knobs": {
+            k: v for k, v in tuned_knobs().items() if v is not None
+        },
         "two_phase_moves_per_sec": two["moves_per_sec"],
         "two_phase_forced_moves_per_sec": forced["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],
