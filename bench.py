@@ -338,8 +338,13 @@ def main() -> None:
             "two_phase": "auto_continue=True, fenced_timing=False",
             "two_phase_forced": "auto_continue=False, fenced_timing=False",
             "continue": "origins=None, fenced_timing=False",
-            "tuning": "box workloads use autotuned_knobs (since r3); "
-                      "pincell and the CPU baseline stay on defaults",
+            "tuning": (
+                "box workloads used autotuned_knobs (since r3); "
+                "pincell and the CPU baseline stay on defaults"
+                if tuned_knobs()
+                else "autotune off/failed/default-equal: ALL workloads "
+                     "ran default knobs this round"
+            ),
         },
         "link_mb_per_sec": link_mb_s,
         "autotuned_knobs": {

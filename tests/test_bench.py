@@ -1,0 +1,60 @@
+"""The benchmark itself is a deliverable — pin its machinery.
+
+Runs bench.py's workload functions at a tiny scale on the CPU backend
+(the preflight device probe is NOT exercised — it exists precisely for
+environments where the accelerator may hang). Covers: the three
+protocol modes, the conservation gate, the trajectory generator's
+bounds, and the autotune integration (including its opt-out).
+"""
+
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_N", "4000")
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_DIV", "6")
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_MOVES", "2")
+    monkeypatch.syspath_prepend(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench as mod
+
+    # Reload per test: picks up the env-sized workload and resets the
+    # module's autotune memo (every user of bench goes through this
+    # fixture, so no restore pass is needed).
+    yield importlib.reload(mod)
+
+
+def test_trajectory_stays_inside_box(bench):
+    rng = np.random.default_rng(0)
+    pts = bench.make_trajectory(rng, 1000, 5, box=[2.0, 1.0, 1.0])
+    for p in pts:
+        assert p.min() >= 0.0 and (p <= [2.0, 1.0, 1.0]).all()
+
+
+def test_workload_protocols_and_conservation(bench, monkeypatch):
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_AUTOTUNE", "0")
+    rates = {}
+    for mode in ("two_phase", "two_phase_forced", "continue"):
+        res = bench.run_workload(bench.N, bench.MOVES, mode)
+        assert res["moves_per_sec"] > 0
+        assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+        rates[mode] = res["moves_per_sec"]
+    assert bench.tuned_knobs() == {}  # opt-out honored
+
+
+def test_autotune_integration_and_conservation(bench):
+    assert isinstance(bench.tuned_knobs(), dict)  # sweep ran (or fell back)
+    res = bench.run_workload(bench.N, bench.MOVES, "two_phase")
+    assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+
+
+def test_pincell_workload(bench):
+    res = bench.run_pincell(2000, 2)
+    assert res["moves_per_sec"] > 0
+    assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL

@@ -201,10 +201,13 @@ def test_autotune_walk_returns_valid_tuned_config():
 
     mesh = build_box(1, 1, 1, 3, 3, 3)
     base = TallyConfig(check_found_all=False)
+    # Candidates whose knobs are ALL non-default: whichever wins the
+    # timing race, the normalized config must keep a visible knob
+    # (a default-equal winner would legitimately normalize to ()).
     cfg, report = autotune_walk(
         mesh, n_particles=2000, moves=2,
         candidates=[
-            {"walk_perm_mode": "packed"},
+            {"walk_cond_every": 8},
             {"walk_perm_mode": "indirect", "walk_window_factor": 4},
         ],
         base=base,
