@@ -136,11 +136,12 @@ def tuned_knobs() -> dict:
                     mesh, n_particles=min(N, 200_000), moves=2,
                     mean_step=MEAN_STEP,
                 )
+                # walk_kwargs() is already normalized (default-equal
+                # knobs dropped), so a winner identical to the kernel
+                # defaults yields {} here — and the provenance string
+                # below then reports the run as untuned.
                 _TUNED_KNOBS = {
-                    "walk_cond_every": cfg.walk_cond_every,
-                    "walk_perm_mode": cfg.walk_perm_mode,
-                    "walk_window_factor": cfg.walk_window_factor,
-                    "walk_min_window": cfg.walk_min_window,
+                    f"walk_{k}": v for k, v in cfg.walk_kwargs()
                 }
                 print(f"# autotuned: {dict(cfg.walk_kwargs())} "
                       f"({report[0]['moves_per_sec'] / 1e6:.2f}M moves/s in "
@@ -347,9 +348,7 @@ def main() -> None:
             ),
         },
         "link_mb_per_sec": link_mb_s,
-        "autotuned_knobs": {
-            k: v for k, v in tuned_knobs().items() if v is not None
-        },
+        "autotuned_knobs": tuned_knobs(),
         "two_phase_moves_per_sec": two["moves_per_sec"],
         "two_phase_forced_moves_per_sec": forced["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],

@@ -39,17 +39,31 @@ def test_trajectory_stays_inside_box(bench):
 
 def test_workload_protocols_and_conservation(bench, monkeypatch):
     monkeypatch.setenv("PUMIUMTALLY_BENCH_AUTOTUNE", "0")
-    rates = {}
     for mode in ("two_phase", "two_phase_forced", "continue"):
         res = bench.run_workload(bench.N, bench.MOVES, mode)
         assert res["moves_per_sec"] > 0
         assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
-        rates[mode] = res["moves_per_sec"]
     assert bench.tuned_knobs() == {}  # opt-out honored
 
 
 def test_autotune_integration_and_conservation(bench):
-    assert isinstance(bench.tuned_knobs(), dict)  # sweep ran (or fell back)
+    """The sweep must actually RUN (not silently fall back): force a
+    sweep whose only candidate is non-default, so the memoized knobs
+    prove the autotuner executed and its winner reached the config."""
+    import pumiumtally_tpu.utils.autotune as at
+
+    bench._TUNED_KNOBS = None
+    orig = at.autotune_walk
+
+    def pinned(mesh, **kw):
+        return orig(mesh, candidates=[{"walk_cond_every": 8}], **kw)
+
+    at.autotune_walk = pinned
+    try:
+        assert bench.tuned_knobs() == {"walk_cond_every": 8}
+    finally:
+        at.autotune_walk = orig
+        bench._TUNED_KNOBS = None
     res = bench.run_workload(bench.N, bench.MOVES, "two_phase")
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
 
