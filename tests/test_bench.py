@@ -63,7 +63,9 @@ def test_autotune_integration_and_conservation(bench):
         assert bench.tuned_knobs() == {"walk_cond_every": 8}
     finally:
         at.autotune_walk = orig
-        bench._TUNED_KNOBS = None
+    # The pinned memo stays in place: run_workload exercises the
+    # conservation gate UNDER the tuned config without re-sweeping
+    # (the fixture's reload isolates other tests).
     res = bench.run_workload(bench.N, bench.MOVES, "two_phase")
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
 

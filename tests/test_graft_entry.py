@@ -27,6 +27,10 @@ def test_dryrun_multichip_self_provisions_cpu_mesh():
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # not "cpu": forces the subprocess path
     env.pop("XLA_FLAGS", None)
+    # A blackhole tunnel address: any child that fails to strip this
+    # and dials it hangs, tripping the timeout below — the regression
+    # class that lost two rounds.
+    env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
     proc = subprocess.run(
         [sys.executable, "-c",
          "import __graft_entry__ as g; g.dryrun_multichip(2)"],
@@ -39,8 +43,7 @@ def test_dryrun_multichip_self_provisions_cpu_mesh():
 def test_entry_returns_jittable_step():
     """entry() must yield (fn, args) that jit-compiles and runs on the
     test backend (the driver compile-checks the same contract on a real
-    chip)."""
-    sys.path.insert(0, _ROOT)
+    chip). Repo root is already importable (tests/conftest.py)."""
     import jax
 
     import __graft_entry__ as g
