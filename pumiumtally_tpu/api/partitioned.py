@@ -57,6 +57,7 @@ class PartitionedPumiTally(PumiTally):
             max_rounds=self.config.max_migration_rounds,
             check_found_all=self.config.check_found_all,
             cond_every=self.config.resolved_cond_every(),
+            min_window=self.config.resolved_min_window(),
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

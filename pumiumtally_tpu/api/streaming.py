@@ -427,6 +427,7 @@ class StreamingPartitionedTally(StreamingTally):
                 check_found_all=self.config.check_found_all,
                 part=part, shared_jit_cache=caches[g],
                 cond_every=self.config.resolved_cond_every(),
+                min_window=self.config.resolved_min_window(),
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

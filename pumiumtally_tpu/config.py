@@ -126,10 +126,10 @@ class TallyConfig:
     # ("arrays"/"packed"/"indirect"; "auto" resolves via
     # PUMIUMTALLY_WALK_PERM); window_factor: cascade shrink ratio;
     # min_window: smallest compaction window. The partitioned engines'
-    # ownership-restricted walk has NO compaction cascade (rounds are
-    # migration-bounded), so on the partitioned facades only
-    # cond_every applies; the other three knobs affect the
-    # monolithic/sharded/streaming walks.
+    # ownership-restricted walk runs its own in-round cascade (indirect
+    # form, parallel/partition.py walk_local) and consumes cond_every
+    # and min_window; perm_mode/window_factor apply to the
+    # monolithic/sharded/streaming walks only.
     walk_cond_every: Optional[int] = None
     walk_perm_mode: Optional[str] = None
     walk_window_factor: Optional[int] = None
@@ -171,6 +171,21 @@ class TallyConfig:
             raise ValueError(
                 f"walk_cond_every must be >= 1, got {self.walk_cond_every!r}"
             )
+        if self.walk_min_window is not None and int(self.walk_min_window) < 1:
+            raise ValueError(
+                f"walk_min_window must be >= 1, got {self.walk_min_window!r}"
+            )
+
+    def resolved_min_window(self) -> int:
+        """min_window with the kernel default applied (consumed, with
+        cond_every, by the partitioned engines)."""
+        from pumiumtally_tpu.ops.walk import _MIN_WINDOW
+
+        return (
+            _MIN_WINDOW
+            if self.walk_min_window is None
+            else int(self.walk_min_window)
+        )
 
     def resolved_cond_every(self) -> int:
         """cond_every with the kernel default applied (the one knob the

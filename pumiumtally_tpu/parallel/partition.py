@@ -63,7 +63,11 @@ from pumiumtally_tpu.mesh.tetmesh import (
     WALK_TABLE_OFFSETS,
 )
 from pumiumtally_tpu.ops.geometry import locate_chunk_by_planes
-from pumiumtally_tpu.ops.walk import COND_EVERY_DEFAULT, fused_tally_body
+from pumiumtally_tpu.ops.walk import (
+    _MIN_WINDOW,
+    COND_EVERY_DEFAULT,
+    fused_tally_body,
+)
 from pumiumtally_tpu.parallel.sharded import _axis_name
 
 try:  # jax >= 0.8
@@ -224,6 +228,8 @@ def walk_local(
     max_iters: int,
     adj_int: Optional[jnp.ndarray] = None,  # [L,4] when ids don't fit the float
     cond_every: int = COND_EVERY_DEFAULT,
+    compact: bool = True,
+    min_window: int = _MIN_WINDOW,
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
@@ -246,23 +252,34 @@ def walk_local(
     ``cond_every`` mirrors ops.walk.walk: k masked iterations per while
     step with the group's tally pairs fused into one scatter-add
     (done/paused particles are inert under the active mask).
+
+    ``compact`` bounds lock-step waste within a round with the same
+    window cascade as the replicated walk (ops/walk.py), in its
+    "indirect" form: the per-slot ray invariants (x0, d0, eff_w) are
+    packed once and never permuted — the loop gathers them through the
+    carried original-slot index — and each stage boundary permutes only
+    s plus one packed int row (lelem, pending, idx, done/exited bits).
+    Inert slots here include PAUSED ones (they wait for migration), so
+    the cascade retires both early finishers and early pausers. Outputs
+    are restored to original slot order (migration depends on the slot
+    → chip layout).
     """
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
     flying_b = flying.astype(bool)
+    n_slots = x.shape[0]
     x0 = x
     d0 = dest - x0
     seg_len = jnp.linalg.norm(d0, axis=1)
     s0 = jnp.zeros_like(seg_len)
+    # flying/weight/seg_len enter the loop only through the tally
+    # contribution — premultiply once (associativity-only, ~1 ulp).
+    eff_w = jnp.where(flying_b, weight * seg_len, 0.0)
     # Derived from an input so it carries the varying type under
     # shard_map (a literal constant would break the while carry).
     pending0 = (lelem - lelem) - 1
 
-    def cond(state):
-        it, _s, _lelem, done, _exited, pending, _flux = state
-        return (it < max_iters) & jnp.any(~done & (pending < 0))
-
-    def step(it, s, lelem, done, exited, pending):
+    def advance(s, lelem, done, exited, pending, x0_c, d0_c, eff_c):
         active = ~done & (pending < 0)
         row = table[lelem]
         n = row.shape[0]
@@ -272,7 +289,7 @@ def walk_local(
             adj = adj_int[lelem]
         else:
             adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
-        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, x0], axis=-1))
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0_c, x0_c], axis=-1))
         a = both[..., 0]
         b = fo - both[..., 1]
         crossing = a * (one - s)[:, None] > tol
@@ -287,32 +304,108 @@ def walk_local(
         goes_remote = (~reached) & (nxt <= -2)
 
         if tally:
-            contrib = jnp.where(
-                active & flying_b, (s_new - s) * seg_len * weight, 0.0
-            )
+            contrib = jnp.where(active, (s_new - s) * eff_c, 0.0)
             pair = (lelem, contrib)
         else:
             pair = None
 
-        advance = active & ~reached & ~hit_boundary & ~goes_remote
-        lelem = jnp.where(advance, nxt, lelem)
+        moving = active & ~reached & ~hit_boundary & ~goes_remote
+        lelem = jnp.where(moving, nxt, lelem)
         s = jnp.where(active, s_new, s)
         pending = jnp.where(active & goes_remote, -nxt - 2, pending)
         done = done | (active & (reached | hit_boundary))
         exited = exited | (active & hit_boundary)
-        return (it + 1, s, lelem, done, exited, pending), pair
-
-    body = fused_tally_body(step, cond_every, tally)
+        return (s, lelem, done, exited, pending), pair
 
     it0 = jnp.asarray(0, jnp.int32)
-    it, s, lelem, done, exited, pending, flux = lax.while_loop(
-        cond, body, (it0, s0, lelem, done, exited, pending0, flux)
-    )
-    # Reached particles commit dest bit-exactly (continue-mode
-    # contract); leavers/pausers commit the intersection point.
-    x_fin = jnp.where(
-        (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
-    )
+
+    min_window = max(1, int(min_window))  # same clamp as ops/walk.py
+    if not compact or n_slots <= min_window:
+        def step(it, s, lelem, done, exited, pending):
+            st, pair = advance(s, lelem, done, exited, pending, x0, d0, eff_w)
+            return (it + 1, *st), pair
+
+        def cond(state):
+            it, _s, _lelem, done, _exited, pending, _flux = state
+            return (it < max_iters) & jnp.any(~done & (pending < 0))
+
+        body = fused_tally_body(step, cond_every, tally)
+        it, s, lelem, done, exited, pending, flux = lax.while_loop(
+            cond, body, (it0, s0, lelem, done, exited, pending0, flux)
+        )
+        x_fin = jnp.where(
+            (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
+        )
+        return x_fin, lelem, done, exited, pending, flux, it
+
+    # ---- compaction cascade (indirect form) ----------------------------
+    windows = [n_slots]
+    while windows[-1] > min_window:
+        windows.append(max(min_window, -(-windows[-1] // 2)))
+    # Ray invariants in ORIGINAL slot order, never permuted; padded to 8
+    # columns to keep the row stride aligned.
+    ray = jnp.concatenate(
+        [x0, d0, eff_w[:, None], jnp.zeros_like(eff_w)[:, None]], axis=1
+    )  # [S,8]
+    idx = jnp.cumsum(jnp.ones_like(lelem)) - 1  # varying under shard_map
+    imax = jnp.iinfo(jnp.int32).max
+    cat = lambda h, a, w: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
+
+    s, done, exited, pending, it = s0, done, exited, pending0, it0
+    for si, w in enumerate(windows):
+        nxt_w = windows[si + 1] if si + 1 < len(windows) else 0
+        head = lambda a: a[:w]  # noqa: E731 — static window slice
+        idx_w = head(idx)
+
+        def step(it, s, lelem, done, exited, pending, _idx=idx_w):
+            r = ray[_idx]
+            st, pair = advance(
+                s, lelem, done, exited, pending, r[:, 0:3], r[:, 3:6], r[:, 6]
+            )
+            return (it + 1, *st), pair
+
+        def cond(state, _nxt=nxt_w):
+            it = state[0]
+            done, pending = state[3], state[5]
+            return (it < max_iters) & (jnp.sum(~done & (pending < 0)) > _nxt)
+
+        body = fused_tally_body(step, cond_every, tally)
+        it, sh, eh, dh, exh, ph, flux = lax.while_loop(
+            cond, body,
+            (it, head(s), head(lelem), head(done), head(exited),
+             head(pending), flux),
+        )
+        # Window write-backs use concatenate, not at[].set — see the
+        # miscompile note in ops/walk.py's cascade.
+        if nxt_w:
+            inert = dh | (ph >= 0)  # done OR paused: both wait out the round
+            key = jnp.where(inert, imax, eh)
+            perm = jnp.argsort(key, stable=True)
+            ip = jnp.stack(
+                [eh, ph, idx[:w], dh.astype(jnp.int32)
+                 + 2 * exh.astype(jnp.int32)],
+                axis=1,
+            )[perm]  # [w,4] — one row gather for the int carries
+            s = cat(sh[perm], s, w)
+            lelem = cat(ip[:, 0], lelem, w)
+            pending = cat(ip[:, 1], pending, w)
+            idx = cat(ip[:, 2], idx, w)
+            done = cat((ip[:, 3] & 1) == 1, done, w)
+            exited = cat(ip[:, 3] >= 2, exited, w)
+        else:
+            s = cat(sh, s, w)
+            lelem = cat(eh, lelem, w)
+            done = cat(dh, done, w)
+            exited = cat(exh, exited, w)
+            pending = cat(ph, pending, w)
+
+    # Restore original slot order (migration depends on the slot→chip
+    # layout); x materializes directly in original order since x0/d0
+    # were never permuted.
+    inv = jnp.argsort(idx, stable=True)
+    s, lelem = s[inv], lelem[inv]
+    done, exited, pending = done[inv], exited[inv], pending[inv]
+    x_fin = jnp.where((done & ~exited)[:, None], dest, x0 + s[:, None] * d0)
     return x_fin, lelem, done, exited, pending, flux, it
 
 
@@ -447,6 +540,7 @@ class PartitionedEngine:
         part: Optional[MeshPartition] = None,
         shared_jit_cache: Optional[dict] = None,
         cond_every: int = COND_EVERY_DEFAULT,
+        min_window: int = _MIN_WINDOW,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -472,6 +566,7 @@ class PartitionedEngine:
         self.max_iters = max_iters
         self.max_rounds = max_rounds
         self.cond_every = int(cond_every)
+        self.min_window = int(min_window)
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.ndev * self.part.L,), dtype)
         # Initial layout: particle pid occupies slot pid (chips get
@@ -646,7 +741,8 @@ class PartitionedEngine:
         # fully identical configuration (chunked engines differ in the
         # last, smaller chunk's capacity).
         key = ("phase", tally, self.cap_per_chip, self.max_rounds,
-               self.max_iters, self.tol, self.cond_every, id(self.part))
+               self.max_iters, self.tol, self.cond_every, self.min_window,
+               id(self.part))
         if key in self._jit_cache:
             return self._jit_cache[key]
         pp = P(self.axis)
@@ -655,6 +751,7 @@ class PartitionedEngine:
         tol, max_iters = self.tol, self.max_iters
         max_rounds = self.max_rounds
         cond_every = self.cond_every
+        min_window = self.min_window
         has_adj = self.part.adj_int is not None
 
         def round_kernel(table, *rest):
@@ -666,7 +763,7 @@ class PartitionedEngine:
             x, lelem, done, exited, pending, flux, _ = walk_local(
                 table, x, lelem, dest, fly, w, done, exited, flux,
                 tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
-                cond_every=cond_every,
+                cond_every=cond_every, min_window=min_window,
             )
             # Global round status computed in-program (one psum each) so
             # the while_loop can branch on them without leaving the
