@@ -413,6 +413,59 @@ def walk_local(
 # Global migration (jit-level; XLA inserts the collectives)
 # ---------------------------------------------------------------------------
 
+def _pack_state(state: dict, defaults: dict):
+    """Split a particle-state dict into ONE float matrix and ONE int32
+    matrix (plus the metadata to undo it), so a permutation/scatter of
+    the whole state costs two row operations instead of ~10 per-array
+    ones — the same packing trick as the walk table and the cascade's
+    stage boundaries. Ids stay in the int pack (int32-exact), never in
+    floats, so no 2^24 exactness ceiling applies."""
+    fcols, icols, layout = [], [], []
+    foff = ioff = 0  # COLUMN offsets into each pack
+    for k in sorted(state):
+        v = state[k]
+        cols = v.reshape(v.shape[0], -1) if v.ndim > 1 else v[:, None]
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            layout.append((k, "f", foff, cols.shape[1], v.dtype, v.shape[1:]))
+            fcols.append(cols)
+            foff += cols.shape[1]
+        else:
+            layout.append((k, "i", ioff, cols.shape[1], v.dtype, v.shape[1:]))
+            icols.append(cols.astype(jnp.int32))
+            ioff += cols.shape[1]
+    fpack = jnp.concatenate(fcols, axis=1) if fcols else None
+    ipack = jnp.concatenate(icols, axis=1) if icols else None
+    fdef, idef = _pack_defaults(defaults, layout)
+    return fpack, ipack, fdef, idef, layout
+
+
+def _pack_defaults(defaults: dict, layout):
+    fcols = {}
+    icols = {}
+    for k, kind, start, ncols, dtype, _tail in layout:
+        v = defaults[k]
+        cols = v.reshape(v.shape[0], -1) if v.ndim > 1 else v[:, None]
+        if kind == "f":
+            fcols[start] = cols
+        else:
+            icols[start] = cols.astype(jnp.int32)
+    f = (jnp.concatenate([fcols[s] for s in sorted(fcols)], axis=1)
+         if fcols else None)
+    i = (jnp.concatenate([icols[s] for s in sorted(icols)], axis=1)
+         if icols else None)
+    return f, i
+
+
+def _unpack_state(fpack, ipack, layout) -> dict:
+    out = {}
+    for k, kind, start, ncols, dtype, tail in layout:
+        src = fpack if kind == "f" else ipack
+        cols = src[:, start:start + ncols]
+        v = cols[:, 0] if not tail else cols.reshape(cols.shape[0], *tail)
+        out[k] = v.astype(dtype) if v.dtype != dtype else v
+    return out
+
+
 def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     """Trace-level body of ``migrate`` (see below) — also inlined into
     the jitted phase round loop so walk+migrate rounds compile as ONE
@@ -437,11 +490,16 @@ def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict):
         key_s < ndev, key_s * cap_per_chip + rank, cap
     )  # dead -> out of bounds, dropped by the scatter
 
-    new_state = {}
-    defaults = _default_state(cap, state)
-    for k, v in state.items():
-        moved = v[perm]
-        new_state[k] = defaults[k].at[dest_slot].set(moved, mode="drop")
+    # Move the WHOLE state as two packed matrices (one float, one int)
+    # instead of ~10 per-array gather+scatter pairs.
+    fpack, ipack, fdef, idef, layout = _pack_state(
+        state, _default_state(cap, state)
+    )
+    if fpack is not None:
+        fpack = fdef.at[dest_slot].set(fpack[perm], mode="drop")
+    if ipack is not None:
+        ipack = idef.at[dest_slot].set(ipack[perm], mode="drop")
+    new_state = _unpack_state(fpack, ipack, layout)
     # Migrated particles resume inside their new chip's local mesh.
     arrived = new_state["pending"] >= 0
     new_state["lelem"] = jnp.where(
