@@ -545,3 +545,20 @@ def test_cli_generators_dispatch_msh_output(tmp_path, capsys):
     c2, t2 = read_gmsh(p2)
     np.testing.assert_allclose(c2, coords)
     np.testing.assert_array_equal(t2, tets)
+
+
+@pytest.mark.slow
+def test_cli_autotune_verb(tmp_path, capsys):
+    """`pumiumtally autotune mesh.osh` sweeps the knob grid on the test
+    backend and prints a usable best-config line."""
+    from pumiumtally_tpu.cli import main as cli
+    from pumiumtally_tpu.utils.autotune import DEFAULT_CANDIDATES
+
+    out = str(tmp_path / "m.osh")
+    cli(["box", "--nx", "3", "--ny", "3", "--nz", "3", out])
+    capsys.readouterr()
+    cli(["autotune", out, "--particles", "1500", "--moves", "2"])
+    text = capsys.readouterr().out
+    assert "best:" in text and "TallyConfig(" in text
+    # every default candidate measured (one "->" line each)
+    assert text.count("->") >= len(DEFAULT_CANDIDATES)

@@ -395,3 +395,33 @@ def test_walk_local_cascade_matches_plain():
     np.testing.assert_array_equal(np.asarray(a[4]), np.asarray(b[4]))  # pending
     np.testing.assert_allclose(
         np.asarray(a[5]), np.asarray(b[5]), rtol=1e-12, atol=1e-13)  # flux
+
+
+def test_migrate_state_pack_round_trip():
+    """_pack_state/_unpack_state move the particle state as two packed
+    matrices; every dtype (float, int32, int8, bool) and trailing shape
+    (1D, [*,3], and a 3D [*,2,3] future-field case) must round-trip
+    exactly through pack -> permute -> unpack."""
+    from pumiumtally_tpu.parallel.partition import (
+        _pack_state,
+        _unpack_state,
+    )
+
+    rng = np.random.default_rng(71)
+    cap = 64
+    state = {
+        "x": jnp.asarray(rng.random((cap, 3))),
+        "w": jnp.asarray(rng.random(cap)),
+        "hist": jnp.asarray(rng.random((cap, 2, 3))),  # 3D trailing shape
+        "lelem": jnp.asarray(rng.integers(0, 100, cap, dtype=np.int32)),
+        "fly": jnp.asarray(rng.integers(0, 2, cap).astype(np.int8)),
+        "alive": jnp.asarray(rng.integers(0, 2, cap).astype(bool)),
+    }
+    defaults = {k: jnp.zeros_like(v) for k, v in state.items()}
+    fpack, ipack, fdef, idef, layout = _pack_state(state, defaults)
+    perm = jnp.asarray(rng.permutation(cap))
+    out = _unpack_state(fpack[perm], ipack[perm], layout)
+    for k, v in state.items():
+        got = out[k]
+        assert got.dtype == v.dtype and got.shape == v.shape, k
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(v[perm]), k)
