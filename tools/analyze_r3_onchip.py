@@ -1,0 +1,108 @@
+"""Summarize the r3 on-chip suite logs into a PERF_NOTES-ready digest.
+
+The detached recovery loop (/tmp/r3_probe_loop.sh) runs the suite once
+when the TPU tunnel answers and mirrors logs into tools/r3_onchip/.
+This script condenses them: cascade sweep table, VMEM-prototype
+win/kill per mesh size, protocol A/B rates, locate A/B, the native
+bench_host row, and the final bench JSON — so whoever picks up the
+logs (this session, the round driver's auto-commit, or the next
+session) gets the numbers without re-reading raw logs.
+
+Usage: python tools/analyze_r3_onchip.py [logdir]   (default: tools/r3_onchip)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+
+def section(title: str) -> None:
+    print(f"\n## {title}")
+
+
+def show_matching(path: str, patterns, max_lines=40) -> bool:
+    if not os.path.exists(path):
+        print(f"(missing: {os.path.basename(path)})")
+        return False
+    shown = 0
+    rx = re.compile("|".join(patterns))
+    with open(path, errors="replace") as f:
+        for line in f:
+            if rx.search(line):
+                print(line.rstrip())
+                shown += 1
+                if shown >= max_lines:
+                    print("... (truncated)")
+                    break
+    if not shown:
+        print(f"(no matching lines in {os.path.basename(path)} — "
+              "tail follows)")
+        with open(path, errors="replace") as f:
+            for line in f.readlines()[-10:]:
+                print(" ", line.rstrip())
+    return shown > 0
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "r3_onchip" if os.path.basename(os.getcwd()) == "tools"
+        else "tools/r3_onchip",
+    )
+    status = os.path.join(d, "status")
+    if not os.path.exists(status):
+        print(f"no suite run found under {d!r} (status file missing)")
+        return
+    print("# r3 on-chip suite digest")
+    with open(status) as f:
+        print(f.read().strip())
+
+    section("cascade sweep (perm_mode x window_factor x cond_every)")
+    show_matching(os.path.join(d, "cascade.log"),
+                  [r"perm=", r"^best:"])
+    section("VMEM one-hot/pallas prototype (win or kill per L)")
+    show_matching(os.path.join(d, "vmem.log"),
+                  [r"^L=", r"walk_gather", r"onehot", r"pallas", r"FAILED"])
+    section("API protocol A/B (two_phase / forced / continue)")
+    show_matching(os.path.join(d, "api_ab.log"),
+                  [r"moves/s", r"two_phase", r"continue", r"rate"])
+    section("locate vs walk localization")
+    show_matching(os.path.join(d, "locate_ab.log"),
+                  [r"locate", r"walk", r"ms", r"x\b"])
+    section("component profile")
+    show_matching(os.path.join(d, "profile.log"),
+                  [r"ms", r"gather", r"scatter", r"perm", r"argsort"])
+    section("native C-ABI host")
+    show_matching(os.path.join(d, "native.log"),
+                  [r"native_two_phase_moves_per_sec", r"error", r"FAIL"])
+    section("bench.py JSON")
+    bench_log = os.path.join(d, "bench.log")
+    if os.path.exists(bench_log):
+        found = False
+        with open(bench_log, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        j = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    found = True
+                    for k in ("value", "vs_baseline",
+                              "two_phase_moves_per_sec",
+                              "continue_moves_per_sec",
+                              "autotuned_knobs", "link_mb_per_sec",
+                              "conservation_rel_err"):
+                        if k in j:
+                            print(f"  {k}: {j[k]}")
+        if not found:
+            show_matching(bench_log, [r"FATAL", r"probe", r"#"])
+    else:
+        print("(missing: bench.log)")
+
+
+if __name__ == "__main__":
+    main()
