@@ -637,6 +637,8 @@ class PartitionedEngine:
         self._jit_cache = cache
         self._n_lost_dev = None
         self._n_lost_cache = 0
+        self._last_rounds_dev = None
+        self._last_rounds_cache = 0
         self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
             "x": jnp.zeros((self.cap, 3), dtype),
@@ -780,6 +782,23 @@ class PartitionedEngine:
         return jnp.all(found), 0
 
     @property
+    def last_walk_rounds(self) -> int:
+        """Walk rounds of the most recent phase (== migrations + 1).
+
+        Diagnostic for tuning ``capacity_factor`` and judging partition
+        quality: a phase whose rounds approach
+        ``TallyConfig.max_migration_rounds`` is migrating too much
+        (elongated partitions or long steps). Reading it fetches one
+        device scalar (a sync) — do not read it inside a pipelined
+        inner loop."""
+        if self._last_rounds_cache is None:
+            self._last_rounds_cache = (
+                0 if self._last_rounds_dev is None
+                else int(self._last_rounds_dev)
+            )
+        return self._last_rounds_cache
+
+    @property
     def _n_lost(self) -> int:
         if self._n_lost_cache is None:
             self._n_lost_cache = (
@@ -892,7 +911,9 @@ class PartitionedEngine:
                  jnp.asarray(False)),
             )
             found_all = (n_nd == 0) & (n_p == 0)
-            return st, fx, found_all, ovf
+            # `it` counts walk rounds (== migrations + 1): a cheap
+            # diagnostic for capacity_factor / partition-quality tuning.
+            return st, fx, found_all, ovf, it
 
         self._jit_cache[key] = phase
         return phase
@@ -911,9 +932,14 @@ class PartitionedEngine:
         on overflow the state is corrupt, which is acceptable because
         the raise abandons the run."""
         phase = self._phase_program(tally)
-        st, fx, found_all, ovf = phase(
+        st, fx, found_all, ovf, rounds = phase(
             self.part.table, self.part.adj_int, self.state, self.flux_padded
         )
+        # Lazy device scalar; fetched only if someone reads the
+        # last_walk_rounds diagnostic (a fetch is a sync; the host int
+        # is cached after the first read, like _n_lost).
+        self._last_rounds_dev = rounds
+        self._last_rounds_cache = None
         if defer_sync:
             self.state = st
             self.flux_padded = fx

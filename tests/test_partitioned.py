@@ -425,3 +425,27 @@ def test_migrate_state_pack_round_trip():
         got = out[k]
         assert got.dtype == v.dtype and got.shape == v.shape, k
         np.testing.assert_array_equal(np.asarray(got), np.asarray(v[perm]), k)
+
+
+def test_last_walk_rounds_diagnostic():
+    """last_walk_rounds reports the phase's walk rounds: 1 when no
+    particle crosses a partition (no migration), >1 when crossings
+    force migrations."""
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    dm = make_device_mesh(8)
+    n = 400
+    t = PartitionedPumiTally(
+        mesh, n, TallyConfig(device_mesh=dm, capacity_factor=8.0)
+    )
+    rng = np.random.default_rng(81)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+
+    # Tiny steps that stay within an element: one round, no migration.
+    t.MoveToNextLocation(None, (src + 1e-4).reshape(-1).copy())
+    assert t.engine.last_walk_rounds == 1
+
+    # Long diagonal steps: crossings force migrations -> several rounds.
+    far = np.clip(src + 0.6, 0.05, 0.95)
+    t.MoveToNextLocation(None, far.reshape(-1).copy())
+    assert t.engine.last_walk_rounds > 1
