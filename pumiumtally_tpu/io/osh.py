@@ -128,22 +128,65 @@ def _write_value(f: BinaryIO, fmt: str, v) -> None:
     f.write(struct.pack(">" + fmt, v))
 
 
+def _remaining(f: BinaryIO) -> Optional[int]:
+    """Bytes left in a seekable stream (None if not seekable) — bounds
+    corrupt count fields before they drive giant allocations."""
+    try:
+        pos = f.tell()
+        end = f.seek(0, 2)
+        f.seek(pos)
+        return end - pos
+    except (OSError, AttributeError):  # pragma: no cover — pipes etc.
+        return None
+
+
 def _read_array(f: BinaryIO, dtype: np.dtype, compressed: bool) -> np.ndarray:
     count = _read_value(f, "i")
     if count < 0:
         raise OshFormatError(f"negative array count {count} in .osh stream")
     nbytes = count * dtype.itemsize
+    left = _remaining(f)
     if compressed:
         zbytes = _read_value(f, "q")
         if zbytes < 0:
             raise OshFormatError("negative zlib byte count in .osh stream")
-        raw = zlib.decompress(_read_exact(f, zbytes))
+        # Plausibility bounds from the actual file size: a corrupt
+        # count/zbytes field must produce a clean error, not a
+        # multi-gigabyte allocation attempt. (zlib tops out around
+        # ~1000:1 on real data; 4096 leaves margin.)
+        if left is not None and (
+            zbytes > left or nbytes > 4096 * max(left, 1)
+        ):
+            raise OshFormatError(
+                f"array header implausible for the file size "
+                f"(count={count}, zbytes={zbytes}, {left} bytes left)"
+            )
+        try:
+            # Cap the DECOMPRESSED size too: a payload that inflates
+            # past the declared count must error, not allocate.
+            dec = zlib.decompressobj()
+            raw = dec.decompress(_read_exact(f, zbytes), nbytes + 1)
+            if len(raw) > nbytes or dec.unconsumed_tail:
+                raise OshFormatError(
+                    f"zlib payload inflates past the declared "
+                    f"{nbytes} bytes"
+                )
+            raw += dec.flush()
+        except zlib.error as e:
+            # A corrupt payload must surface as the documented clean
+            # error, not a raw zlib exception.
+            raise OshFormatError(f"corrupt zlib array payload: {e}") from e
         if len(raw) != nbytes:
             raise OshFormatError(
                 f"zlib payload decompressed to {len(raw)} bytes, "
                 f"expected {nbytes}"
             )
     else:
+        if left is not None and nbytes > left:
+            raise OshFormatError(
+                f"array header implausible for the file size "
+                f"(count={count}, {left} bytes left)"
+            )
         raw = _read_exact(f, nbytes)
     return np.frombuffer(raw, dtype=dtype).copy()
 

@@ -126,7 +126,7 @@ def walk_onehot_jnp(mesh, x, elem, dest, in_flight, weight, flux, *,
     done0 = in_flight != in_flight
     # hold particles (dest == x) finish on iteration 1 like walk()
     T = (n + pad) // W_TILE
-    shp = lambda a: a.reshape(T, W_TILE, *a.shape[1:])
+    shp = lambda a: a.reshape(T, W_TILE, *a.shape[1:])  # noqa: E731
     s0 = jnp.zeros_like(seg)
 
     def chunk(args):
@@ -229,8 +229,8 @@ def walk_vmem_pallas(mesh, x, elem, dest, in_flight, weight, flux, *,
         done_out[:] = done.astype(jnp.int8)
         flux_out[:] = fl
 
-    tile = lambda: pl.BlockSpec((W_TILE,), lambda t: (t,))
-    tile3 = lambda: pl.BlockSpec((W_TILE, 3), lambda t: (t, 0))
+    tile = lambda: pl.BlockSpec((W_TILE,), lambda t: (t,))  # noqa: E731
+    tile3 = lambda: pl.BlockSpec((W_TILE, 3), lambda t: (t, 0))  # noqa: E731
     full = pl.BlockSpec((L, 32), lambda t: (0, 0))
     s_o, elem_o, done_o, fparts = pl.pallas_call(
         kernel,
