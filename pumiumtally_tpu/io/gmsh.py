@@ -60,26 +60,33 @@ def read_gmsh(path: str) -> Tuple[np.ndarray, np.ndarray]:
             f"{path}: MSH format {head[0].decode()} (4.0) not supported; "
             "re-export as 4.1 or 2.2"
         )
-    if file_type == 0:
-        text = data.decode("utf-8", "replace")
-        sections = _text_sections(text)
+    try:
+        if file_type == 0:
+            text = data.decode("utf-8", "replace")
+            sections = _text_sections(text)
+            if version >= 4.0:
+                return _parse_v4(sections)
+            return _parse_v2(sections)
+        # Binary: endianness from the probe int after the format line.
+        nl = fmt.find(b"\n")
+        probe = fmt[nl + 1: nl + 5]
+        if len(probe) < 4:
+            raise ValueError(f"{path}: truncated binary $MeshFormat")
+        if struct.unpack("<i", probe)[0] == 1:
+            end = "<"
+        elif struct.unpack(">i", probe)[0] == 1:
+            end = ">"
+        else:
+            raise ValueError(f"{path}: cannot determine binary endianness")
         if version >= 4.0:
-            return _parse_v4(sections)
-        return _parse_v2(sections)
-    # Binary: endianness from the probe int written after the format line.
-    nl = fmt.find(b"\n")
-    probe = fmt[nl + 1: nl + 5]
-    if len(probe) < 4:
-        raise ValueError(f"{path}: truncated binary $MeshFormat")
-    if struct.unpack("<i", probe)[0] == 1:
-        end = "<"
-    elif struct.unpack(">i", probe)[0] == 1:
-        end = ">"
-    else:
-        raise ValueError(f"{path}: cannot determine binary endianness")
-    if version >= 4.0:
-        return _parse_v4_binary(data, end)
-    return _parse_v2_binary(data, end)
+            return _parse_v4_binary(data, end)
+        return _parse_v2_binary(data, end)
+    except (IndexError, KeyError, struct.error) as e:
+        # Truncated/corrupt files must fail with the documented clean
+        # error, not a raw parser exception (fuzz-found: a cut ASCII
+        # $Nodes line raised bare IndexError; a cut-off section raised
+        # bare KeyError).
+        raise ValueError(f"{path}: malformed .msh stream: {e!r}") from e
 
 
 def _text_sections(text: str) -> dict:
@@ -120,9 +127,22 @@ def _finish(coords: np.ndarray, ids: np.ndarray, tet_ids: np.ndarray):
 # ASCII
 # ---------------------------------------------------------------------------
 
+def _check_count(n, bound, what: str) -> int:
+    """Validate a count field parsed from the stream: non-negative and
+    plausible against the data actually present, so a corrupt header
+    raises cleanly instead of allocating gigabytes or looping forever
+    (fuzz-found classes)."""
+    n = int(n)
+    if n < 0 or n > bound:
+        raise ValueError(
+            f"implausible {what} count {n} (bound {bound}) in .msh stream"
+        )
+    return n
+
+
 def _parse_v2(sections) -> Tuple[np.ndarray, np.ndarray]:
     nodes = sections["Nodes"]
-    nn = int(nodes[0])
+    nn = _check_count(nodes[0], len(nodes), "node")
     ids = np.empty(nn, np.int64)
     coords = np.empty((nn, 3), np.float64)
     for k in range(nn):
@@ -131,7 +151,7 @@ def _parse_v2(sections) -> Tuple[np.ndarray, np.ndarray]:
         coords[k] = [float(parts[1]), float(parts[2]), float(parts[3])]
 
     elems = sections["Elements"]
-    ne = int(elems[0])
+    ne = _check_count(elems[0], len(elems), "element")
     tets: List[List[int]] = []
     for k in range(ne):
         parts = elems[1 + k].split()
@@ -147,13 +167,14 @@ def _parse_v2(sections) -> Tuple[np.ndarray, np.ndarray]:
 def _parse_v4(sections) -> Tuple[np.ndarray, np.ndarray]:
     nodes = sections["Nodes"]
     header = nodes[0].split()
-    num_blocks, nn = int(header[0]), int(header[1])
+    num_blocks = _check_count(header[0], len(nodes), "node block")
+    nn = _check_count(header[1], len(nodes), "node")
     ids = np.empty(nn, np.int64)
     coords = np.empty((nn, 3), np.float64)
     row, k = 1, 0
     for _ in range(num_blocks):
         bh = nodes[row].split()
-        nblock = int(bh[3])
+        nblock = _check_count(bh[3], len(nodes), "node block size")
         row += 1
         for b in range(nblock):
             ids[k + b] = int(nodes[row + b])
@@ -166,12 +187,13 @@ def _parse_v4(sections) -> Tuple[np.ndarray, np.ndarray]:
 
     elems = sections["Elements"]
     header = elems[0].split()
-    num_blocks = int(header[0])
+    num_blocks = _check_count(header[0], len(elems), "element block")
     row = 1
     tets: List[List[int]] = []
     for _ in range(num_blocks):
         bh = elems[row].split()
-        etype, nblock = int(bh[2]), int(bh[3])
+        etype = int(bh[2])
+        nblock = _check_count(bh[3], len(elems), "element block size")
         row += 1
         if etype == 4:
             for b in range(nblock):
@@ -188,8 +210,8 @@ def _parse_v4(sections) -> Tuple[np.ndarray, np.ndarray]:
 def _parse_v2_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
     sec = _section(data, "Nodes")
     nl = sec.find(b"\n")
-    nn = int(sec[:nl])
     rec = np.dtype([("id", end + "i4"), ("xyz", end + "f8", (3,))])
+    nn = _check_count(sec[:nl], len(sec) // rec.itemsize, "node")
     body = sec[nl + 1: nl + 1 + nn * rec.itemsize]
     nodes = np.frombuffer(body, dtype=rec, count=nn)
     ids = nodes["id"].astype(np.int64)
@@ -197,7 +219,7 @@ def _parse_v2_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
 
     sec = _section(data, "Elements")
     nl = sec.find(b"\n")
-    ne = int(sec[:nl])
+    ne = _check_count(sec[:nl], len(sec) // 4, "element")
     off = nl + 1
     i4 = np.dtype(end + "i4")
     tets: List[np.ndarray] = []
@@ -208,6 +230,10 @@ def _parse_v2_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
         if etype not in _NODES_PER_ELEM_TYPE:
             raise ValueError(f"unsupported binary v2 element type {etype}")
         npn = _NODES_PER_ELEM_TYPE[etype]
+        nfollow = _check_count(nfollow, (len(sec) - off) // 4, "block")
+        ntags = _check_count(ntags, 1024, "tag")
+        if nfollow == 0:
+            raise ValueError("empty element block in binary v2 stream")
         stride = 1 + ntags + npn
         block = np.frombuffer(
             sec, dtype=i4, count=nfollow * stride, offset=off
@@ -227,6 +253,8 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
     off = 0
     num_blocks, nn, _minT, _maxT = struct.unpack_from(end + "4q", sec, off)
     off += 32
+    num_blocks = _check_count(num_blocks, len(sec) // 20, "node block")
+    nn = _check_count(nn, len(sec) // 32, "node")
     ids = np.empty(nn, np.int64)
     coords = np.empty((nn, 3), np.float64)
     k = 0
@@ -237,6 +265,7 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
         off += 20
         if parametric:
             raise ValueError("parametric nodes not supported")
+        nblock = _check_count(nblock, (len(sec) - off) // 32, "node block size")
         ids[k: k + nblock] = np.frombuffer(
             sec, dtype=end + "i8", count=nblock, offset=off
         )
@@ -251,6 +280,7 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
     off = 0
     num_blocks, _ne, _minT, _maxT = struct.unpack_from(end + "4q", sec, off)
     off += 32
+    num_blocks = _check_count(num_blocks, len(sec) // 20, "element block")
     tets: List[np.ndarray] = []
     for _ in range(num_blocks):
         _dim, _tag, etype, nblock = struct.unpack_from(end + "iiiq", sec, off)
@@ -258,6 +288,9 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
         if etype not in _NODES_PER_ELEM_TYPE:
             raise ValueError(f"unsupported binary v4 element type {etype}")
         stride = 1 + _NODES_PER_ELEM_TYPE[etype]
+        nblock = _check_count(
+            nblock, (len(sec) - off) // (8 * stride) + 1, "element block size"
+        )
         block = np.frombuffer(
             sec, dtype=end + "i8", count=nblock * stride, offset=off
         ).reshape(nblock, stride)

@@ -601,14 +601,93 @@ def test_osh_truncation_fuzz():
                 assert coords.shape[1] == 3 and tets.shape[1] == 4
             except ValueError:
                 pass  # the expected outcome
-        # crafted inflate bomb: small declared count, huge payload
+        # crafted inflate bomb: small declared count, huge payload —
+        # a minimal self-contained stream (no fixture-layout coupling)
         import struct
         import zlib
 
         bomb = zlib.compress(b"\x00" * 100_000)
-        hdr = data[: 2 + 4 + 1 + 1 + 1 + 4 + 4 + 1 + 4 + (1 + 4 + 48) + 4]
+        hdr = (b"\xa1\x1a" + struct.pack(">i", 9)      # magic, version
+               + struct.pack(">b", 1)                   # compressed
+               + struct.pack(">b", 0)                   # family simplex
+               + struct.pack(">b", 3)                   # dim
+               + struct.pack(">ii", 1, 0)               # comm size/rank
+               + struct.pack(">b", 0)                   # parting
+               + struct.pack(">i", 0)                   # nghost
+               + struct.pack(">b", 0)                   # no hints
+               + struct.pack(">i", 4))                  # nverts
         with open(os.path.join(d, "0.osh"), "wb") as f:
             f.write(hdr + struct.pack(">i", 10)
                     + struct.pack(">q", len(bomb)) + bomb)
         with pytest.raises(ValueError, match="inflates past"):
             read_osh(d)
+
+
+def test_gmsh_truncation_fuzz(tmp_path):
+    """Truncations and byte flips of every .msh flavor must fail with a
+    clean ValueError (or parse to sane shapes) — never leak raw parser
+    exceptions (fuzz-found: a cut ASCII $Nodes line raised IndexError)."""
+    from pumiumtally_tpu.io.gmsh import read_gmsh, write_gmsh
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    writers = {
+        "ascii_v2": lambda p: write_gmsh(p, coords, tets),
+        "bin_v2": lambda p: _write_msh_v2_binary(p, coords, tets),
+        "bin_v4": lambda p: _write_msh_v4_binary(p, coords, tets),
+    }
+    rng = np.random.default_rng(93)
+    for name, writer in writers.items():
+        src = str(tmp_path / f"{name}.msh")
+        writer(src)
+        with open(src, "rb") as f:
+            data = f.read()
+        q = str(tmp_path / "t.msh")
+        for cut in {int(c) for c in rng.integers(0, len(data), 25)}:
+            with open(q, "wb") as f:
+                f.write(data[:cut])
+            try:
+                c2, t2 = read_gmsh(q)
+                assert c2.shape[1] == 3 and t2.shape[1] == 4, (name, cut)
+            except ValueError:
+                pass
+        for _ in range(10):
+            b = bytearray(data)
+            b[int(rng.integers(20, len(data)))] ^= 0xFF
+            with open(q, "wb") as f:
+                f.write(bytes(b))
+            try:
+                c2, t2 = read_gmsh(q)
+                assert c2.shape[1] == 3 and t2.shape[1] == 4, name
+            except ValueError:
+                pass
+
+
+def test_gmsh_hostile_headers_rejected(tmp_path):
+    """Crafted count fields must fail cleanly: a negative binary-v2
+    block count previously spun the parser forever, and a 2^31 node
+    count attempted a 16 GiB allocation."""
+    import struct
+
+    from pumiumtally_tpu.io.gmsh import read_gmsh
+
+    neg = str(tmp_path / "neg.msh")
+    with open(neg, "wb") as f:
+        f.write(b"$MeshFormat\n2.2 1 8\n" + struct.pack("<i", 1)
+                + b"\n$EndMeshFormat\n")
+        f.write(b"$Nodes\n1\n" + struct.pack("<iddd", 1, 0, 0, 0)
+                + b"\n$EndNodes\n")
+        f.write(b"$Elements\n1\n" + struct.pack("<iii", 1, -1, 0)
+                + b"\x00" * 12 + b"\n$EndElements\n")
+    with pytest.raises(ValueError, match="implausible"):
+        read_gmsh(neg)
+
+    big = str(tmp_path / "big.msh")
+    with open(big, "wb") as f:
+        f.write(b"$MeshFormat\n4.1 1 8\n" + struct.pack("<i", 1)
+                + b"\n$EndMeshFormat\n")
+        f.write(b"$Nodes\n" + struct.pack("<4q", 1, 2**31, 1, 2**31)
+                + b"\n$EndNodes\n")
+        f.write(b"$Elements\n" + struct.pack("<4q", 0, 0, 0, 0)
+                + b"\n$EndElements\n")
+    with pytest.raises(ValueError, match="implausible"):
+        read_gmsh(big)
