@@ -321,6 +321,24 @@ def read_vtk_cell_scalars(path: str, name: str) -> np.ndarray:
     return _read_vtk_binary_scalars(data, name)
 
 
+def _clean_errors(fn):
+    """Truncated/corrupt files must fail with ValueError/KeyError, not
+    raw parser exceptions (fuzz-found: IndexError from a cut ASCII
+    stream, struct.error from a cut .vtu header, and a silently SHORT
+    binary array)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except (IndexError, struct.error) as e:
+            raise ValueError(f"malformed VTK stream: {e!r}") from e
+
+    return wrapped
+
+
+@_clean_errors
 def _read_vtk_ascii_scalars(text: str, name: str) -> np.ndarray:
     lines = text.splitlines()
     ncells = None
@@ -333,10 +351,19 @@ def _read_vtk_ascii_scalars(text: str, name: str) -> np.ndarray:
             while len(vals) < ncells:
                 vals.extend(float(v) for v in lines[j].split())
                 j += 1
+            if j - 1 == len(lines) - 1 and not text.endswith("\n"):
+                # The final value came from a line with no trailing
+                # newline: a truncation can cut digits off a number
+                # that still parses ('47' -> '4') — reject rather
+                # than silently return corrupt data.
+                raise ValueError(
+                    "ASCII scalars end mid-line (truncated file?)"
+                )
             return np.array(vals[:ncells])
     raise KeyError(f"cell scalar {name!r} not found")
 
 
+@_clean_errors
 def _read_vtk_binary_scalars(data: bytes, name: str) -> np.ndarray:
     marker = b"CELL_DATA "
     p = data.find(marker)
@@ -348,13 +375,26 @@ def _read_vtk_binary_scalars(data: bytes, name: str) -> np.ndarray:
     q = data.find(tag, p)
     if q < 0:
         raise KeyError(f"cell scalar {name!r} not found")
-    # Skip the SCALARS line and the LOOKUP_TABLE line.
-    start = data.find(b"\n", data.find(b"\n", q) + 1) + 1
-    return np.frombuffer(
-        data[start: start + 8 * ncells], dtype=">f8"
-    ).astype(np.float64)
+    # Skip the SCALARS line and the LOOKUP_TABLE line — each newline
+    # must exist (find() returning -1 would silently rewind start to
+    # offset 0 and parse header bytes as data).
+    nl1 = data.find(b"\n", q)
+    if nl1 < 0:
+        raise ValueError("truncated SCALARS header line")
+    nl2 = data.find(b"\n", nl1 + 1)
+    if nl2 < 0:
+        raise ValueError("truncated LOOKUP_TABLE line")
+    start = nl2 + 1
+    payload = data[start: start + 8 * ncells]
+    if len(payload) != 8 * ncells:
+        raise ValueError(
+            f"truncated binary scalars: {len(payload)} bytes for "
+            f"{ncells} cells"
+        )
+    return np.frombuffer(payload, dtype=">f8").astype(np.float64)
 
 
+@_clean_errors
 def _read_vtu_array(path: str, name: str) -> np.ndarray:
     with open(path, "rb") as f:
         data = f.read()
@@ -370,7 +410,14 @@ def _read_vtu_array(path: str, name: str) -> np.ndarray:
     o = elem.find(off_tag)
     offset = int(elem[o + len(off_tag): elem.find(b'"', o + len(off_tag))])
     base = data.find(b'<AppendedData encoding="raw">')
+    if base < 0:
+        raise ValueError("no raw AppendedData section in .vtu")
     base = data.find(b"_", base) + 1
     nbytes = struct.unpack("<Q", data[base + offset: base + offset + 8])[0]
     start = base + offset + 8
-    return np.frombuffer(data[start: start + nbytes], dtype="<f8").copy()
+    payload = data[start: start + nbytes]
+    if len(payload) != nbytes:
+        raise ValueError(
+            f"truncated .vtu payload: {len(payload)} of {nbytes} bytes"
+        )
+    return np.frombuffer(payload, dtype="<f8").copy()
