@@ -233,7 +233,11 @@ def _parse_v2_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
         nfollow = _check_count(nfollow, (len(sec) - off) // 4, "block")
         ntags = _check_count(ntags, 1024, "tag")
         if nfollow == 0:
-            raise ValueError("empty element block in binary v2 stream")
+            # Spec-legal empty block: skip it. A pathological stream of
+            # endless empty blocks still terminates — off advances 12
+            # bytes per header until unpack_from runs out of section
+            # and raises (wrapped into the clean ValueError).
+            continue
         stride = 1 + ntags + npn
         block = np.frombuffer(
             sec, dtype=i4, count=nfollow * stride, offset=off

@@ -354,10 +354,15 @@ def _read_vtk_ascii_scalars(text: str, name: str) -> np.ndarray:
             if j - 1 == len(lines) - 1 and not text.endswith("\n"):
                 # The final value came from a line with no trailing
                 # newline: a truncation can cut digits off a number
-                # that still parses ('47' -> '4') — reject rather
-                # than silently return corrupt data.
+                # that still parses ('47' -> '4') and is then
+                # indistinguishable from real data. DELIBERATE
+                # strictness: a complete third-party file that merely
+                # lacks its final newline is rejected too — append one
+                # to load it; silent corruption is the worse failure.
                 raise ValueError(
-                    "ASCII scalars end mid-line (truncated file?)"
+                    "ASCII scalars end on an unterminated line — "
+                    "truncated file? (if the file is complete, append "
+                    "a trailing newline)"
                 )
             return np.array(vals[:ncells])
     raise KeyError(f"cell scalar {name!r} not found")

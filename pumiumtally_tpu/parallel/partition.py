@@ -339,6 +339,11 @@ def walk_local(
         return x_fin, lelem, done, exited, pending, flux, it
 
     # ---- compaction cascade (indirect form) ----------------------------
+    # NOTE: deliberately parallel to ops/walk.py's cascade (different
+    # carries: pending/exited, pause-aware inertness, slot-order
+    # restore) — any fix to the schedule/permute/restore machinery or
+    # the concatenate-not-at[].set miscompile workaround there must be
+    # mirrored here, and vice versa.
     windows = [n_slots]
     while windows[-1] > min_window:
         windows.append(max(min_window, -(-windows[-1] // 2)))
