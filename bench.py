@@ -218,7 +218,7 @@ def run_pincell(n: int, moves: int) -> dict:
     return timed_moves(t, pts, moves, drive)
 
 
-def preflight_device(max_wait_s: float = 1500.0) -> None:
+def preflight_device(max_wait_s: float | None = None) -> None:
     """Fail fast (rc 1) if the accelerator cannot be claimed.
 
     A killed TPU client can leave the tunnel's device grant stuck, and
@@ -226,9 +226,15 @@ def preflight_device(max_wait_s: float = 1500.0) -> None:
     hang is only escapable by killing the process) with retries, so a
     transiently busy tunnel still gets its bench, and a wedged one
     produces a diagnosable failure instead of an eternal hang. The
-    wait is generous (25 min): observed wedges have cleared on the
-    scale of tens of minutes to hours, and a late bench beats no bench.
+    default wait is generous (25 min): observed wedges have cleared on
+    the scale of tens of minutes to hours, and a late bench beats no
+    bench — but the caller (e.g. a round driver with its own budget)
+    can cap it via PUMIUMTALLY_BENCH_MAX_WAIT (seconds).
     """
+    if max_wait_s is None:
+        max_wait_s = float(
+            os.environ.get("PUMIUMTALLY_BENCH_MAX_WAIT", 1500.0)
+        )
     deadline = time.monotonic() + max_wait_s
     attempt = 0
     fast_failures = 0
@@ -236,12 +242,16 @@ def preflight_device(max_wait_s: float = 1500.0) -> None:
     while True:
         attempt += 1
         timed_out = False
+        # Honor a tight driver budget: a single probe never overshoots
+        # the deadline by more than the 30 s floor a live-but-cold
+        # tunnel needs to answer.
+        probe_timeout = min(150.0, max(30.0, deadline - time.monotonic()))
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax, jax.numpy as jnp;"
                  "print(float(jnp.sum(jnp.ones(8))))"],
-                capture_output=True, text=True, timeout=150,
+                capture_output=True, text=True, timeout=probe_timeout,
             )
             if r.returncode == 0:
                 return
@@ -252,9 +262,8 @@ def preflight_device(max_wait_s: float = 1500.0) -> None:
             last_err = "(probe timed out — wedged device tunnel?)"
         # A quick rc!=0 is deterministic (broken install/driver), not a
         # busy tunnel: don't burn the whole deadline retrying it.
-        if (not timed_out and fast_failures >= 3) or (
-            time.monotonic() >= deadline
-        ):
+        remaining = deadline - time.monotonic()
+        if (not timed_out and fast_failures >= 3) or remaining <= 0:
             print(
                 f"# FATAL: accelerator unreachable after {attempt} probe "
                 f"attempts; no benchmark number can be measured.\n"
@@ -262,7 +271,9 @@ def preflight_device(max_wait_s: float = 1500.0) -> None:
                 file=sys.stderr,
             )
             sys.exit(1)
-        time.sleep(30)
+        # Cap the retry sleep by the remaining budget too (a fixed 30 s
+        # would overshoot a tight driver budget between probes).
+        time.sleep(min(30.0, remaining))
 
 
 def measure_link_bandwidth(mb: float = 8.0) -> float | None:

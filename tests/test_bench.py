@@ -70,6 +70,48 @@ def test_autotune_integration_and_conservation(bench):
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
 
 
+def test_preflight_max_wait_env_caps_budget(bench, monkeypatch):
+    """PUMIUMTALLY_BENCH_MAX_WAIT must bound BOTH the retry deadline
+    and the per-probe timeout, so a round driver controls exactly what
+    a wedged tunnel costs. Probes are simulated (a real one could hang
+    this suite — the very failure mode the knob exists for)."""
+    import subprocess as sp
+
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_MAX_WAIT", "45")
+    seen_timeouts = []
+
+    def fake_run(cmd, **kw):
+        seen_timeouts.append(kw["timeout"])
+        raise sp.TimeoutExpired(cmd, kw["timeout"])
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    # Controlled clock: each probe costs its timeout, each sleep its
+    # duration — so the deadline logic runs without real waiting.
+    clock = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    monkeypatch.setattr(bench.time, "sleep", fake_sleep)
+
+    real_run = fake_run
+
+    def run_and_advance(cmd, **kw):
+        clock["t"] += kw["timeout"]
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(bench.subprocess, "run", run_and_advance)
+    with pytest.raises(SystemExit) as exc:
+        bench.preflight_device()
+    assert exc.value.code == 1
+    # Probe timeouts never exceed the env budget (floor of 30 s aside),
+    # and the loop gave up at the env deadline, not the 25-min default.
+    assert seen_timeouts[0] == 45.0
+    assert all(t <= 45.0 for t in seen_timeouts)
+    assert clock["t"] <= 45.0 + 30.0 + 30.0  # one probe + floor slack
+
+
 def test_pincell_workload(bench):
     res = bench.run_pincell(2000, 2)
     assert res["moves_per_sec"] > 0
