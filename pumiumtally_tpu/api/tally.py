@@ -91,6 +91,13 @@ class TallyTimes:
 # Consecutive origin-echo misses after which a facade stops paying for
 # echo snapshots (the driver has proven it resamples every move).
 _ECHO_MISS_LIMIT = 8
+# While disarmed, one snapshot is retained every this-many moves so the
+# NEXT move can probe again: a driver that echoes intermittently (e.g.
+# resampling phases longer than the miss limit) regains the upload skip
+# within a period instead of losing it until CopyInitialPosition. Cost
+# of a retry: one [n,3] snapshot copy per period plus one 64-point
+# probe on the following move.
+_ECHO_REARM_PERIOD = 64
 
 
 def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
@@ -379,13 +386,17 @@ class PumiTally:
         (fresh samples every move) pay ~nothing instead of a
         full-batch cast + compare per move. After _ECHO_MISS_LIMIT
         consecutive misses the snapshots are dropped and retention
-        stops (see _retain_echo_snapshots) — the steady state for a
-        never-echoing driver is then a single attribute test."""
-        if (
-            buf is None
-            or not self.config.auto_continue
-            or self._last_dests_host is None
-        ):
+        mostly stops (see _retain_echo_snapshots) — the steady state
+        for a never-echoing driver is an attribute test on all but one
+        move per _ECHO_REARM_PERIOD, when a snapshot is retained so the
+        next move can probe whether the driver started echoing again."""
+        if buf is None or not self.config.auto_continue:
+            return False
+        if self._last_dests_host is None:
+            # No snapshot to compare against (start of batch, or
+            # disarmed): still count the move so the periodic re-arm
+            # clock advances.
+            self._echo_misses += 1
             return False
         prev = self._last_dests_host  # [n,3] working dtype, owned
         n = self.num_particles
@@ -400,19 +411,22 @@ class PumiTally:
         self._echo_misses += 1
         if self._echo_misses >= _ECHO_MISS_LIMIT:
             # This driver resamples origins every move; stop paying
-            # for snapshots it will never hit. CopyInitialPosition
-            # re-arms the detector for the next batch.
+            # for snapshots it will never hit. CopyInitialPosition —
+            # or a periodic retry (_ECHO_REARM_PERIOD) — re-arms the
+            # detector.
             self._last_dests_host = None
             self._last_dests_dev = None
         return False
 
     def _retain_echo_snapshots(self) -> bool:
         """Whether this move's destinations should be snapshotted for
-        the next move's echo check (only origin-passing drivers that
-        have not proven themselves never-echoing)."""
-        return (
-            self.config.auto_continue
-            and self._echo_misses < _ECHO_MISS_LIMIT
+        the next move's echo check: origin-passing drivers that have
+        not proven themselves never-echoing, plus one retry snapshot
+        per _ECHO_REARM_PERIOD while disarmed (an intermittently
+        echoing driver then recovers the upload skip within a period)."""
+        return self.config.auto_continue and (
+            self._echo_misses < _ECHO_MISS_LIMIT
+            or self._echo_misses % _ECHO_REARM_PERIOD == _ECHO_REARM_PERIOD - 1
         )
 
     def _as_positions(self, buf, size: Optional[int]) -> jnp.ndarray:

@@ -67,7 +67,13 @@ class TallyConfig:
         synchronization. Applies to every facade: the streaming ones
         detect the echo on the flat caller buffer and reuse their
         per-chunk device arrays (the weights/flying caches below them
-        are monolithic/sharded/partitioned only).
+        are monolithic/sharded/partitioned only). Detector lifecycle:
+        after 8 consecutive misses the facade stops snapshotting
+        destinations (a never-echoing driver then pays ~nothing for
+        the feature); while disarmed one snapshot is retried every 64
+        moves, so a driver that echoes intermittently (e.g. periodic
+        resampling phases) regains the upload skip within a period —
+        and ``CopyInitialPosition`` always re-arms fully.
       fenced_timing: if True (default), each API call blocks until its
         device work finishes so ``TallyTimes`` measures real per-phase
         wall time (the fence the reference intended via
@@ -134,6 +140,16 @@ class TallyConfig:
     walk_perm_mode: Optional[str] = None
     walk_window_factor: Optional[int] = None
     walk_min_window: Optional[int] = None
+    # Partitioned engines only: when set and a chip's owned element
+    # count L is <= this bound (and local adjacency fits the float
+    # table), the per-chip local walk runs as the VMEM-resident one-hot
+    # MXU Pallas kernel (ops/vmem_walk.py) instead of the HBM row
+    # gather. Wins when partitions are small enough that the [L,32]
+    # table lives in VMEM (~<= a few thousand tets — see the module's
+    # cost model); larger partitions silently keep the gather walk.
+    # Not bitwise vs the gather walk (documented rounding-level
+    # divergence); conservation gates apply unchanged.
+    walk_vmem_max_elems: Optional[int] = None
     # StreamingPartitionedTally only: split the device mesh into this
     # many disjoint groups — chunks round-robin across them, so G
     # chunks transport concurrently (particle data parallelism across
@@ -175,6 +191,13 @@ class TallyConfig:
             raise ValueError(
                 f"walk_min_window must be >= 1, got {self.walk_min_window!r}"
             )
+        if self.walk_vmem_max_elems is not None and int(
+            self.walk_vmem_max_elems
+        ) < 1:
+            raise ValueError(
+                f"walk_vmem_max_elems must be >= 1, "
+                f"got {self.walk_vmem_max_elems!r}"
+            )
 
     def resolved_min_window(self) -> int:
         """min_window with the kernel default applied (consumed, with
@@ -203,11 +226,29 @@ class TallyConfig:
         (name, value) pairs — passed as a STATIC argument through the
         jitted step functions (an untuned config yields ``()``, so its
         jit cache keys match pre-knob builds)."""
+        from pumiumtally_tpu.ops.walk import (
+            PERM_MODE_DEFAULT,
+            _resolve_perm_mode,
+        )
+
         out = []
         if self.walk_cond_every is not None:
             out.append(("cond_every", int(self.walk_cond_every)))
-        if self.walk_perm_mode is not None:
-            out.append(("perm_mode", self.walk_perm_mode))
+        # "auto"/None resolve HERE (env var included) rather than at
+        # trace time inside the kernel: the resolved mode must be part
+        # of the static jit key, or flipping PUMIUMTALLY_WALK_PERM in a
+        # running process would silently reuse the stale compiled mode
+        # (bitwise-identical output, but it would invalidate perf A/Bs).
+        # Default-equal modes are still dropped to keep cache-key parity
+        # with untuned configs.
+        mode = _resolve_perm_mode(self.walk_perm_mode or "auto")
+        # Drop the knob only when it is BOTH the kernel default and
+        # what a trace-time "auto" would resolve to right now — an
+        # explicit "packed" under a contrary env var must still be
+        # emitted, or the kernel's trace-time fallback would override
+        # the explicit choice.
+        if mode != PERM_MODE_DEFAULT or mode != _resolve_perm_mode("auto"):
+            out.append(("perm_mode", mode))
         if self.walk_window_factor is not None:
             out.append(("window_factor", int(self.walk_window_factor)))
         if self.walk_min_window is not None:

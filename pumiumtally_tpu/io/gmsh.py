@@ -293,7 +293,7 @@ def _parse_v4_binary(data: bytes, end: str) -> Tuple[np.ndarray, np.ndarray]:
             raise ValueError(f"unsupported binary v4 element type {etype}")
         stride = 1 + _NODES_PER_ELEM_TYPE[etype]
         nblock = _check_count(
-            nblock, (len(sec) - off) // (8 * stride) + 1, "element block size"
+            nblock, (len(sec) - off) // (8 * stride), "element block size"
         )
         block = np.frombuffer(
             sec, dtype=end + "i8", count=nblock * stride, offset=off

@@ -94,10 +94,22 @@ WINDOW_FACTOR_DEFAULT = 2
 #                gather per walk iteration).
 _PERM_MODES = ("arrays", "packed", "indirect")
 
+# The mode "auto" resolves to when PUMIUMTALLY_WALK_PERM is unset.
+PERM_MODE_DEFAULT = "packed"
+
 
 def _resolve_perm_mode(mode: str) -> str:
+    """Resolve "auto" via the PUMIUMTALLY_WALK_PERM env var.
+
+    Called from TallyConfig.walk_kwargs() so the resolved mode lands in
+    the engines' static jit keys (an env flip then recompiles rather
+    than silently reusing the stale mode). A DIRECT walk() call with
+    perm_mode="auto" resolves at trace time instead — the env var is
+    then read once per compilation; pass an explicit mode to A/B within
+    one process.
+    """
     if mode == "auto":
-        mode = os.environ.get("PUMIUMTALLY_WALK_PERM", "packed")
+        mode = os.environ.get("PUMIUMTALLY_WALK_PERM", PERM_MODE_DEFAULT)
     if mode not in _PERM_MODES:
         raise ValueError(
             f"perm_mode must be one of {_PERM_MODES} or 'auto', got {mode!r}"

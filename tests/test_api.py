@@ -327,6 +327,7 @@ def test_native_device_groups_env(monkeypatch, tmp_path):
         TallyConfig(device_groups=0)
 
 
+@pytest.mark.slow
 def test_walk_tuning_knobs_reach_all_facades():
     """TallyConfig.walk_* knobs flow through every facade's jitted
     dispatch as static args; a tuned config reproduces the untuned
@@ -380,6 +381,38 @@ def test_walk_tuning_knobs_reach_all_facades():
         TallyConfig(walk_window_factor=1)
 
 
+def test_perm_mode_env_resolves_in_walk_kwargs(monkeypatch):
+    """PUMIUMTALLY_WALK_PERM must resolve at CONFIG resolution (into
+    the static jit key), not at trace time inside the kernel — an env
+    flip in a running process then recompiles instead of silently
+    reusing the stale cached mode (ADVICE r3)."""
+    from pumiumtally_tpu import TallyConfig
+
+    monkeypatch.delenv("PUMIUMTALLY_WALK_PERM", raising=False)
+    assert TallyConfig().walk_kwargs() == ()
+    # An explicit default-equal mode normalizes away (cache-key parity).
+    assert TallyConfig(walk_perm_mode="packed").walk_kwargs() == ()
+    monkeypatch.setenv("PUMIUMTALLY_WALK_PERM", "arrays")
+    assert ("perm_mode", "arrays") in TallyConfig().walk_kwargs()
+    assert ("perm_mode", "arrays") in TallyConfig(
+        walk_perm_mode="auto"
+    ).walk_kwargs()
+    # An explicit non-auto mode wins over the env var...
+    assert ("perm_mode", "indirect") in TallyConfig(
+        walk_perm_mode="indirect"
+    ).walk_kwargs()
+    # ...including an explicit DEFAULT mode under a contrary env var
+    # (dropping it would let the kernel's trace-time fallback override
+    # the explicit choice).
+    assert ("perm_mode", "packed") in TallyConfig(
+        walk_perm_mode="packed"
+    ).walk_kwargs()
+    # A bogus env value fails loudly at config resolution.
+    monkeypatch.setenv("PUMIUMTALLY_WALK_PERM", "bogus")
+    with pytest.raises(ValueError):
+        TallyConfig().walk_kwargs()
+
+
 def test_partitioned_engine_consumes_cond_every():
     """The one walk knob the partitioned engines support must actually
     reach the engine (and an invalid value must be rejected)."""
@@ -419,7 +452,7 @@ def test_walk_kw_actually_reaches_kernel(monkeypatch):
 
     # Unique static values so the jitted steps cannot hit a cached
     # trace from another test (tracing is when the recorder fires).
-    knobs = dict(walk_cond_every=3, walk_perm_mode="packed",
+    knobs = dict(walk_cond_every=3, walk_perm_mode="indirect",
                  walk_min_window=333)
     mesh = build_box(1, 1, 1, 2, 2, 2)
     n = 200
@@ -436,5 +469,5 @@ def test_walk_kw_actually_reaches_kernel(monkeypatch):
         t.MoveToNextLocation(None, src.reshape(-1).copy())
         assert len(seen) >= 3  # localize + phase A/B + continue
         for s in seen:
-            assert s == {"cond_every": 3, "perm_mode": "packed",
+            assert s == {"cond_every": 3, "perm_mode": "indirect",
                          "min_window": 333}, (dm, s)

@@ -354,14 +354,32 @@ def test_echo_disarm_state_machine():
         dests = rng.uniform(0.05, 0.95, (n, 3))
         move(origins, dests)
         if i == 0:
-            # First move can't miss (no snapshot yet) and must retain.
-            assert t._echo_misses == 0 and t._last_dests_host is not None
+            # First move can't compare (no snapshot yet) but still
+            # ticks the re-arm clock — and must retain.
+            assert t._echo_misses == 1 and t._last_dests_host is not None
     assert t.auto_continue_hits == 0
     # Disarmed: snapshots dropped, retention off.
     assert t._echo_misses >= _ECHO_MISS_LIMIT
     assert t._last_dests_host is None and t._last_dests_dev is None
     move(rng.uniform(0.05, 0.95, (n, 3)), rng.uniform(0.05, 0.95, (n, 3)))
-    assert t._last_dests_host is None  # stays off for this batch
+    assert t._last_dests_host is None  # stays off between retry windows
+
+    # Periodic re-arm (_ECHO_REARM_PERIOD): while disarmed the facade
+    # retains ONE retry snapshot per period, and an intermittently
+    # echoing driver regains the upload skip on the following move.
+    from pumiumtally_tpu.api.tally import _ECHO_REARM_PERIOD
+
+    while t._echo_misses % _ECHO_REARM_PERIOD != _ECHO_REARM_PERIOD - 2:
+        move(rng.uniform(0.05, 0.95, (n, 3)),
+             rng.uniform(0.05, 0.95, (n, 3)))
+        assert t._last_dests_host is None  # still within the window
+    retry_dests = rng.uniform(0.05, 0.95, (n, 3))
+    move(rng.uniform(0.05, 0.95, (n, 3)), retry_dests)  # hits the boundary
+    assert t._last_dests_host is not None  # the periodic retry snapshot
+    hits_before = t.auto_continue_hits
+    move(retry_dests, rng.uniform(0.05, 0.95, (n, 3)))  # echo on retry
+    assert t.auto_continue_hits == hits_before + 1
+    assert t._echo_misses == 0  # fully re-armed by the hit
 
     # CopyInitialPosition re-arms the detector.
     t.CopyInitialPosition(pts.reshape(-1).copy())
@@ -370,8 +388,9 @@ def test_echo_disarm_state_machine():
     move(pts, d1)
     assert t._last_dests_host is not None  # retaining again
     d2 = rng.uniform(0.05, 0.95, (n, 3))
+    hits_before = t.auto_continue_hits
     move(d1, d2)  # echo!
-    assert t.auto_continue_hits == 1
+    assert t.auto_continue_hits == hits_before + 1
     assert t._echo_misses == 0  # hit reset the streak
 
     # A NONZERO miss streak is reset by a hit, so interleaved
