@@ -1,0 +1,403 @@
+// osh_writer — a standalone transcription of Omega_h's binary `.osh`
+// serialization logic, used to generate test fixtures this package's
+// Python reader (pumiumtally_tpu/io/osh.py) must parse.
+//
+// WHY THIS EXISTS: the reference library loads meshes with
+// `Omega_h::binary::read` (reference PumiTallyImpl.cpp:562), but no
+// Omega_h build is obtainable in this environment (no network). The
+// Python reader and the Python fixture writer (tools/make_osh_fixture.py)
+// were both written against one reading of the public Omega_h sources,
+// so a systematic misreading could pass both. This file transcribes the
+// WRITE PATH of `Omega_h_file.cpp` into dependency-free C++ (zlib only,
+// as upstream) with the same function decomposition the upstream code
+// has — write_value / write_array / write_string / write_meta /
+// write_tag / write(stream, mesh) — so its bytes are derived from the
+// upstream code's structure rather than from this repo's Python
+// modules. Fixtures it generates are checked in and parsed by
+// tests/test_io.py.
+//
+// Transcribed layout decisions (each mirrors Omega_h_file.cpp):
+//   * canonical byte order is the CPU's when little-endian; values are
+//     byte-swapped only on big-endian CPUs (`needs_swapping =
+//     !is_little_endian_cpu()`), so streams are little-endian on disk;
+//   * the stream does NOT repeat the format version: directories carry
+//     it in the `version` file (the in-stream version exists only in
+//     pre-version-4 files, which this writer does not emit);
+//   * arrays are [int32 count][int64 zbytes][zlib payload] when
+//     compressed (compress2 at Z_BEST_SPEED) or raw bytes otherwise;
+//   * meta is: compressed?(i8) family(i8) dim(i8) comm_size(i32)
+//     comm_rank(i32) parting(i8) nghost(i32) have_hints(i8) [hints],
+//     then (version >= 10 only) matched(i8) — this writer emits
+//     version 9 and so no matched byte;
+//   * per dimension d=1..3 the downward adjacency ab2b (i32) plus,
+//     for d>1, the alignment codes (i8, code = rotation<<1 | flip per
+//     Omega_h_align.hpp);
+//   * per dimension d=0..3: ntags(i32), then each tag as
+//     name(i32 len + bytes) ncomps(i8) type(i8) data-array, with the
+//     Omega_h_Type codes I8=0, I32=2, I64=3, F64=5; then, only when
+//     comm_size > 1, the owner ranks + idxs arrays.
+//
+// Entity derivation (edges/triangles from tets) follows PUMIPic/Omega_h
+// reflect_down semantics: entities numbered by FIRST APPEARANCE while
+// scanning parents in order, storing each entity's vertices in the
+// order induced by the parent that defined it — which makes the
+// alignment codes nontrivial (the Python reader claims insensitivity
+// to them; these fixtures exercise that claim with independent bytes).
+//
+// Build: make -C native osh_writer   (links only -lz)
+// Run:   ./native/osh_writer OUTDIR  — writes OUTDIR/cube_omega_cpp.osh
+//        (compressed) and OUTDIR/cube_omega_cpp_raw.osh (uncompressed).
+
+#include <zlib.h>
+
+#include <array>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace osh {
+
+using I8 = std::int8_t;
+using I32 = std::int32_t;
+using I64 = std::int64_t;
+using Real = double;
+
+static_assert(sizeof(I32) == 4, "osh format assumes 32 bit Int");
+static_assert(sizeof(I64) == 8, "osh format assumes 64 bit GO");
+static_assert(sizeof(Real) == 8, "osh format assumes 64 bit Real");
+
+constexpr I32 latest_version = 9;  // what this writer emits
+
+bool is_little_endian_cpu() {
+  std::uint16_t const endian_canary = 0x1;
+  std::uint8_t const* p =
+      reinterpret_cast<std::uint8_t const*>(&endian_canary);
+  return *p == 0x1;
+}
+
+template <typename T>
+void swap_bytes(T& val) {
+  char* p = reinterpret_cast<char*>(&val);
+  for (std::size_t i = 0; i < sizeof(T) / 2; ++i) {
+    char const t = p[i];
+    p[i] = p[sizeof(T) - 1 - i];
+    p[sizeof(T) - 1 - i] = t;
+  }
+}
+
+static bool const needs_swapping = !is_little_endian_cpu();
+
+template <typename T>
+void write_value(std::ostream& stream, T val) {
+  if (needs_swapping) swap_bytes(val);
+  stream.write(reinterpret_cast<const char*>(&val), sizeof(T));
+}
+
+template <typename T>
+void write_array(std::ostream& stream, std::vector<T> const& array,
+                 bool is_compressed) {
+  I32 const size = static_cast<I32>(array.size());
+  write_value(stream, size);
+  std::vector<T> swapped;
+  T const* data = array.data();
+  if (needs_swapping) {
+    swapped = array;
+    for (auto& v : swapped) swap_bytes(v);
+    data = swapped.data();
+  }
+  I64 const uncompressed_bytes =
+      static_cast<I64>(array.size() * sizeof(T));
+  if (is_compressed) {
+    uLong const source_bytes = static_cast<uLong>(uncompressed_bytes);
+    uLong dest_bytes = ::compressBound(source_bytes);
+    std::vector<Bytef> compressed(dest_bytes);
+    int const ret = ::compress2(
+        compressed.data(), &dest_bytes,
+        reinterpret_cast<const Bytef*>(data), source_bytes, Z_BEST_SPEED);
+    if (ret != Z_OK) {
+      std::fprintf(stderr, "compress2 failed (%d)\n", ret);
+      std::exit(1);
+    }
+    I64 const compressed_bytes = static_cast<I64>(dest_bytes);
+    write_value(stream, compressed_bytes);
+    stream.write(reinterpret_cast<const char*>(compressed.data()),
+                 compressed_bytes);
+  } else {
+    stream.write(reinterpret_cast<const char*>(data), uncompressed_bytes);
+  }
+}
+
+void write_string(std::ostream& stream, std::string const& val) {
+  I32 const len = static_cast<I32>(val.length());
+  write_value(stream, len);
+  stream.write(val.c_str(), len);
+}
+
+// ---- the mesh we serialize ------------------------------------------------
+
+// Omega_h_Type codes (Omega_h_defines.h).
+enum TagType : I8 { OSH_I8 = 0, OSH_I32 = 2, OSH_I64 = 3, OSH_F64 = 5 };
+
+struct Tag {
+  std::string name;
+  I8 ncomps;
+  TagType type;
+  std::vector<I8> i8s;
+  std::vector<I32> i32s;
+  std::vector<I64> i64s;
+  std::vector<Real> reals;
+};
+
+struct Mesh {
+  I8 dim = 3;
+  I32 comm_size = 1;
+  I32 comm_rank = 0;
+  I32 nverts = 0;
+  // Downward adjacency chain + alignment codes.
+  std::vector<I32> edge2vert;              // [nedges*2]
+  std::vector<I32> tri2edge;               // [ntris*3]
+  std::vector<I8> tri_codes;               // [ntris*3]
+  std::vector<I32> tet2tri;                // [ntets*4]
+  std::vector<I8> tet_codes;               // [ntets*4]
+  std::array<std::vector<Tag>, 4> tags;    // per dimension
+};
+
+// write_meta (Omega_h_file.cpp): everything between the compression
+// flag and the vertex count.
+void write_meta(std::ostream& stream, Mesh const& mesh) {
+  I8 const family = 0;  // OMEGA_H_SIMPLEX
+  write_value(stream, family);
+  write_value(stream, mesh.dim);
+  write_value(stream, mesh.comm_size);
+  write_value(stream, mesh.comm_rank);
+  I8 const parting = 0;  // OMEGA_H_ELEM_BASED
+  write_value(stream, parting);
+  I32 const nghost_layers = 0;
+  write_value(stream, nghost_layers);
+  I8 const have_hints = 0;  // no RIB hints
+  write_value(stream, have_hints);
+  // version >= 10 would write the matched flag here; we emit 9.
+}
+
+void write_tag(std::ostream& stream, Tag const& tag, bool is_compressed) {
+  write_string(stream, tag.name);
+  write_value(stream, tag.ncomps);
+  I8 const type = static_cast<I8>(tag.type);
+  write_value(stream, type);
+  switch (tag.type) {
+    case OSH_I8:
+      write_array(stream, tag.i8s, is_compressed);
+      break;
+    case OSH_I32:
+      write_array(stream, tag.i32s, is_compressed);
+      break;
+    case OSH_I64:
+      write_array(stream, tag.i64s, is_compressed);
+      break;
+    case OSH_F64:
+      write_array(stream, tag.reals, is_compressed);
+      break;
+  }
+}
+
+// binary::write(std::ostream&, Mesh*) — the stream body.
+void write(std::ostream& stream, Mesh const& mesh, bool is_compressed) {
+  unsigned char const magic[2] = {0xa1, 0x1a};
+  stream.write(reinterpret_cast<const char*>(magic), sizeof(magic));
+  // (the format version was moved out of the stream into the
+  //  directory's `version` file at version 4)
+  I8 const compressed_flag = is_compressed ? 1 : 0;
+  write_value(stream, compressed_flag);
+  write_meta(stream, mesh);
+  write_value(stream, mesh.nverts);
+  // Downward adjacencies, d = 1..dim; codes only for d > 1.
+  write_array(stream, mesh.edge2vert, is_compressed);
+  write_array(stream, mesh.tri2edge, is_compressed);
+  write_array(stream, mesh.tri_codes, is_compressed);
+  write_array(stream, mesh.tet2tri, is_compressed);
+  write_array(stream, mesh.tet_codes, is_compressed);
+  for (int d = 0; d <= mesh.dim; ++d) {
+    I32 const ntags = static_cast<I32>(mesh.tags[d].size());
+    write_value(stream, ntags);
+    for (auto const& tag : mesh.tags[d]) {
+      write_tag(stream, tag, is_compressed);
+    }
+    // comm_size == 1 here: no owner arrays.
+  }
+}
+
+// ---- entity derivation (reflect_down semantics) ---------------------------
+
+// Canonical simplex templates (Omega_h_simplex.hpp).
+constexpr int tet_faces[4][3] = {{0, 2, 1}, {0, 1, 3}, {1, 2, 3}, {2, 0, 3}};
+constexpr int tri_edges[3][2] = {{0, 1}, {1, 2}, {2, 0}};
+
+// Alignment code (Omega_h_align.hpp): code = rotation << 1 | flip,
+// where `rotation` rotates the STORED vertex order and `flip` swaps
+// the last two, reproducing the USE order in the parent.
+template <int N>
+I8 align_code(std::array<I32, N> const& stored,
+              std::array<I32, N> const& use) {
+  for (int rot = 0; rot < N; ++rot) {
+    std::array<I32, N> r;
+    for (int i = 0; i < N; ++i) r[i] = stored[(i + rot) % N];
+    if (r == use) return static_cast<I8>(rot << 1);
+    std::array<I32, N> fl = r;
+    if (N >= 2) {
+      I32 const t = fl[N - 2];
+      fl[N - 2] = fl[N - 1];
+      fl[N - 1] = t;
+    }
+    if (fl == use) return static_cast<I8>((rot << 1) | 1);
+  }
+  std::fprintf(stderr, "no alignment code found\n");
+  std::exit(1);
+}
+
+// First-appearance entity map: key = sorted vertex tuple; value =
+// (entity id, stored vertex order = first use's order).
+template <int N>
+struct EntitySet {
+  std::map<std::array<I32, N>, std::pair<I32, std::array<I32, N>>> byKey;
+  std::vector<std::array<I32, N>> stored;  // id -> stored vertex order
+
+  // Returns (id, code aligning stored order onto this use's order).
+  std::pair<I32, I8> use(std::array<I32, N> const& verts) {
+    std::array<I32, N> key = verts;
+    for (int i = 0; i < N - 1; ++i)  // tiny N: insertion sort
+      for (int j = i + 1; j < N; ++j)
+        if (key[j] < key[i]) {
+          I32 const t = key[i];
+          key[i] = key[j];
+          key[j] = t;
+        }
+    auto it = byKey.find(key);
+    if (it == byKey.end()) {
+      I32 const id = static_cast<I32>(stored.size());
+      byKey.emplace(key, std::make_pair(id, verts));
+      stored.push_back(verts);
+      return {id, 0};  // defining use: identity alignment
+    }
+    return {it->second.first, align_code<N>(it->second.second, verts)};
+  }
+};
+
+Mesh build_mesh(std::vector<Real> const& coords,
+                std::vector<std::array<I32, 4>> const& tets) {
+  Mesh mesh;
+  mesh.nverts = static_cast<I32>(coords.size() / 3);
+  EntitySet<3> tris;
+  EntitySet<2> edges;
+  // Pass 1: triangles from tets, in parent order.
+  for (auto const& tet : tets) {
+    for (auto const& f : tet_faces) {
+      std::array<I32, 3> const fv = {tet[f[0]], tet[f[1]], tet[f[2]]};
+      auto const [id, code] = tris.use(fv);
+      mesh.tet2tri.push_back(id);
+      mesh.tet_codes.push_back(code);
+    }
+  }
+  // Pass 2: edges from triangles, in triangle-id order.
+  for (auto const& tv : tris.stored) {
+    for (auto const& e : tri_edges) {
+      std::array<I32, 2> const ev = {tv[e[0]], tv[e[1]]};
+      auto const [id, code] = edges.use(ev);
+      mesh.tri2edge.push_back(id);
+      mesh.tri_codes.push_back(code);
+    }
+  }
+  for (auto const& ev : edges.stored) {
+    mesh.edge2vert.push_back(ev[0]);
+    mesh.edge2vert.push_back(ev[1]);
+  }
+
+  // Tags: what msh2osh output carries — coordinates + globals on the
+  // vertices, class_id/class_dim + globals on the elements.
+  I32 const nedges = static_cast<I32>(edges.stored.size());
+  I32 const ntris = static_cast<I32>(tris.stored.size());
+  I32 const ntets = static_cast<I32>(tets.size());
+  {
+    Tag t;
+    t.name = "coordinates";
+    t.ncomps = 3;
+    t.type = OSH_F64;
+    t.reals = coords;
+    mesh.tags[0].push_back(t);
+  }
+  auto global_tag = [](I32 n) {
+    Tag t;
+    t.name = "global";
+    t.ncomps = 1;
+    t.type = OSH_I64;
+    for (I32 i = 0; i < n; ++i) t.i64s.push_back(i);
+    return t;
+  };
+  mesh.tags[0].push_back(global_tag(mesh.nverts));
+  mesh.tags[1].push_back(global_tag(nedges));
+  mesh.tags[2].push_back(global_tag(ntris));
+  {
+    Tag t;
+    t.name = "class_id";
+    t.ncomps = 1;
+    t.type = OSH_I32;
+    for (I32 i = 0; i < ntets; ++i) t.i32s.push_back(1);
+    mesh.tags[3].push_back(t);
+    Tag d;
+    d.name = "class_dim";
+    d.ncomps = 1;
+    d.type = OSH_I8;
+    for (I32 i = 0; i < ntets; ++i) d.i8s.push_back(3);
+    mesh.tags[3].push_back(d);
+  }
+  mesh.tags[3].push_back(global_tag(ntets));
+  return mesh;
+}
+
+// Directory-level write (binary::write(path, mesh)): the rank streams
+// plus the `nparts` and `version` ASCII files.
+void write_dir(std::string const& path, Mesh const& mesh,
+               bool is_compressed) {
+  ::mkdir(path.c_str(), 0755);
+  {
+    std::ofstream f(path + "/nparts");
+    f << mesh.comm_size << '\n';
+  }
+  {
+    std::ofstream f(path + "/version");
+    f << latest_version << '\n';
+  }
+  std::ofstream f(path + "/" + std::to_string(mesh.comm_rank) + ".osh",
+                  std::ios::binary);
+  write(f, mesh, is_compressed);
+}
+
+}  // namespace osh
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s OUTDIR\n", argv[0]);
+    return 1;
+  }
+  std::string const out = argv[1];
+  // The unit cube split into 6 tets around the main diagonal v0-v6 —
+  // the reference test fixture geometry (build_box(1,1,1,1,1,1),
+  // reference test_pumi_tally_impl_methods.cpp:34-35).
+  std::vector<osh::Real> const coords = {
+      0, 0, 0, 1, 0, 0, 1, 1, 0, 0, 1, 0,
+      0, 0, 1, 1, 0, 1, 1, 1, 1, 0, 1, 1,
+  };
+  std::vector<std::array<osh::I32, 4>> const tets = {
+      {0, 1, 2, 6}, {0, 2, 3, 6}, {0, 3, 7, 6},
+      {0, 7, 4, 6}, {0, 4, 5, 6}, {0, 5, 1, 6},
+  };
+  auto const mesh = osh::build_mesh(coords, tets);
+  osh::write_dir(out + "/cube_omega_cpp.osh", mesh, true);
+  osh::write_dir(out + "/cube_omega_cpp_raw.osh", mesh, false);
+  std::printf("wrote %s/cube_omega_cpp.osh (+_raw)\n", out.c_str());
+  return 0;
+}

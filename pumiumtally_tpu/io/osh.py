@@ -12,21 +12,31 @@ Layout implemented here (reconstructed from the public Omega_h sources
 for the canonical downward templates, ``Omega_h_align.hpp`` for the
 alignment codes). There is no Omega_h build in this environment (no
 network), so validation is: self-round-trip, structural sanity checks,
-and the ``tests/data/cube_omega*.osh`` fixtures — streams produced by
-an INDEPENDENT byte-level writer (``tools/make_osh_fixture.py``) that
-follows Omega_h's own derivation conventions (first-appearance entity
-numbering, child vertex order from the defining parent, nontrivial
-alignment codes, msh2osh-style tags, shared-vertex owners). Agreement
-with bytes from a genuine Omega_h binary remains unproven; every parse
-failure degrades to an actionable error:
+the ``tests/data/cube_omega*.osh`` fixtures — streams produced by an
+INDEPENDENT byte-level writer (``tools/make_osh_fixture.py``) — and
+fixtures from ``native/osh_writer.cpp``, a standalone C++ transcription
+of the upstream writer's serialization logic. Agreement with bytes
+from a genuine Omega_h binary remains unproven. Because the two layout
+details that CANNOT be settled without one — byte order, and whether
+the stream repeats the version the directory's ``version`` file
+carries — are exactly the kind of systematic misreading that would
+pass a self-round-trip, the reader AUTO-DETECTS both (see
+``_read_stream_any``): it tries the upstream-protocol reading
+(little-endian, version in the directory file only — ``Omega_h``
+writes values natively and swaps only on big-endian CPUs, i.e. the
+canonical stream is little-endian, and its in-stream version read is
+gated on the version file being absent), then the transposed variants,
+accepting the first that passes the strict structural checks below.
+Every parse failure degrades to an actionable error:
 
     mesh.osh/
       nparts      ASCII int   — number of rank files
       version     ASCII int   — directory format version (absent in
-                                old files; the stream repeats it)
-      <rank>.osh  binary stream, all values BIG-endian:
+                                old files; the stream then carries it)
+      <rank>.osh  binary stream (canonically little-endian; all four
+                  endian x version-location variants are accepted):
         magic     2 bytes     0xa1 0x1a
-        version   int32
+        [version  int32       only when the version file is absent]
         compress  int8        1 = arrays are zlib streams
         family    int8        0 = simplex        (version >= 7)
         dim       int8        must be 3
@@ -47,7 +57,7 @@ failure degrades to an actionable error:
         owners per dimension (comm_size > 1 only): ranks + idxs arrays
 
     array := int32 count, then (if compress) int64 zlib-byte-count +
-             zlib payload, else raw big-endian payload.
+             zlib payload, else raw payload.
 
 Vertex coordinates come from the ``coordinates`` float64 tag on
 dimension 0. Connectivity is stored as a chain of downward adjacencies
@@ -86,12 +96,12 @@ _TYPE_I8 = 0
 _TYPE_I32 = 2
 _TYPE_I64 = 3
 _TYPE_F64 = 5
-_TYPE_DTYPES = {
-    _TYPE_I8: np.dtype(">i1"),
-    _TYPE_I32: np.dtype(">i4"),
-    _TYPE_I64: np.dtype(">i8"),
-    _TYPE_F64: np.dtype(">f8"),
-}
+_TYPE_CODES = {_TYPE_I8: "i1", _TYPE_I32: "i4", _TYPE_I64: "i8",
+               _TYPE_F64: "f8"}
+
+
+def _type_dtype(typ: int, end: str) -> np.dtype:
+    return np.dtype(end + _TYPE_CODES[typ])
 
 # Canonical tet-face template (Omega_h_simplex.hpp simplex_down_template
 # for (3,2)): face k's vertices as local tet vertex indices.
@@ -119,13 +129,18 @@ def _read_exact(f: BinaryIO, n: int) -> bytes:
     return b
 
 
-def _read_value(f: BinaryIO, fmt: str):
-    fmt = ">" + fmt
+def _read_value(f: BinaryIO, fmt: str, end: str):
+    fmt = end + fmt
     return struct.unpack(fmt, _read_exact(f, struct.calcsize(fmt)))[0]
 
 
+# The writer emits the canonical (little-endian) byte order — Omega_h
+# writes values natively and swaps only on big-endian CPUs.
+_WRITE_END = "<"
+
+
 def _write_value(f: BinaryIO, fmt: str, v) -> None:
-    f.write(struct.pack(">" + fmt, v))
+    f.write(struct.pack(_WRITE_END + fmt, v))
 
 
 def _remaining(f: BinaryIO) -> Optional[int]:
@@ -140,14 +155,17 @@ def _remaining(f: BinaryIO) -> Optional[int]:
         return None
 
 
-def _read_array(f: BinaryIO, dtype: np.dtype, compressed: bool) -> np.ndarray:
-    count = _read_value(f, "i")
+def _read_array(
+    f: BinaryIO, typ: int, compressed: bool, end: str
+) -> np.ndarray:
+    dtype = _type_dtype(typ, end)
+    count = _read_value(f, "i", end)
     if count < 0:
         raise OshFormatError(f"negative array count {count} in .osh stream")
     nbytes = count * dtype.itemsize
     left = _remaining(f)
     if compressed:
-        zbytes = _read_value(f, "q")
+        zbytes = _read_value(f, "q", end)
         if zbytes < 0:
             raise OshFormatError("negative zlib byte count in .osh stream")
         # Plausibility bounds from the actual file size: a corrupt
@@ -191,21 +209,24 @@ def _read_array(f: BinaryIO, dtype: np.dtype, compressed: bool) -> np.ndarray:
     return np.frombuffer(raw, dtype=dtype).copy()
 
 
-def _write_array(f: BinaryIO, arr: np.ndarray, dtype: np.dtype,
+def _write_array(f: BinaryIO, arr: np.ndarray, typ: int,
                  compress: bool) -> None:
-    arr = np.ascontiguousarray(arr, dtype=dtype)
+    arr = np.ascontiguousarray(arr, dtype=_type_dtype(typ, _WRITE_END))
     _write_value(f, "i", arr.size)
     raw = arr.tobytes()
     if compress:
-        z = zlib.compress(raw, 6)
+        # Z_BEST_SPEED — the level the upstream writer passes to
+        # compress2 (parseability does not depend on it, but byte
+        # parity with native/osh_writer.cpp does).
+        z = zlib.compress(raw, 1)
         _write_value(f, "q", len(z))
         f.write(z)
     else:
         f.write(raw)
 
 
-def _read_string(f: BinaryIO) -> str:
-    n = _read_value(f, "i")
+def _read_string(f: BinaryIO, end: str) -> str:
+    n = _read_value(f, "i", end)
     if not 0 <= n < 4096:
         raise OshFormatError(f"implausible string length {n} in .osh stream")
     return _read_exact(f, n).decode("utf-8")
@@ -221,50 +242,52 @@ def _write_string(f: BinaryIO, s: str) -> None:
 # Stream reader
 # ---------------------------------------------------------------------------
 
-def _read_meta(f: BinaryIO, version: int) -> Tuple[int, int, bool]:
+def _read_meta(f: BinaryIO, version: int, end: str) -> Tuple[int, int, bool]:
     """Returns (dim, comm_size, compressed)."""
-    compressed = bool(_read_value(f, "b"))
+    compressed = bool(_read_value(f, "b", end))
     if version >= 7:
-        family = _read_value(f, "b")
+        family = _read_value(f, "b", end)
         if family != 0:
             raise OshFormatError(
                 f"mesh family {family} is not simplex; only tet meshes "
                 "are supported"
             )
-    dim = _read_value(f, "b")
-    comm_size = _read_value(f, "i")
-    _comm_rank = _read_value(f, "i")
-    _parting = _read_value(f, "b")
-    _nghost = _read_value(f, "i")
-    have_hints = _read_value(f, "b")
+    dim = _read_value(f, "b", end)
+    comm_size = _read_value(f, "i", end)
+    _comm_rank = _read_value(f, "i", end)
+    _parting = _read_value(f, "b", end)
+    _nghost = _read_value(f, "i", end)
+    have_hints = _read_value(f, "b", end)
+    if have_hints not in (0, 1):
+        raise OshFormatError(f"implausible RIB hint flag {have_hints}")
     if have_hints:
-        naxes = _read_value(f, "i")
+        naxes = _read_value(f, "i", end)
         if not 0 <= naxes < 64:
             raise OshFormatError(f"implausible RIB hint axis count {naxes}")
         _read_exact(f, naxes * 3 * 8)
     if version >= 10:
-        matched = _read_value(f, "b")
+        matched = _read_value(f, "b", end)
         if matched:
             raise OshFormatError("matched (periodic) meshes not supported")
     return dim, comm_size, compressed
 
 
 def _read_tags(
-    f: BinaryIO, nents: int, compressed: bool
+    f: BinaryIO, nents: int, compressed: bool, end: str
 ) -> Dict[str, np.ndarray]:
-    ntags = _read_value(f, "i")
+    ntags = _read_value(f, "i", end)
     if not 0 <= ntags < 1024:
         raise OshFormatError(f"implausible tag count {ntags} in .osh stream")
     tags: Dict[str, np.ndarray] = {}
     for _ in range(ntags):
-        name = _read_string(f)
-        ncomps = _read_value(f, "b")
-        typ = _read_value(f, "b")
-        if typ not in _TYPE_DTYPES:
+        name = _read_string(f, end)
+        ncomps = _read_value(f, "b", end)
+        typ = _read_value(f, "b", end)
+        if typ not in _TYPE_CODES:
             raise OshFormatError(
                 f"unknown tag data type {typ} for tag {name!r}"
             )
-        data = _read_array(f, _TYPE_DTYPES[typ], compressed)
+        data = _read_array(f, typ, compressed, end)
         if ncomps > 0 and data.size != nents * ncomps:
             raise OshFormatError(
                 f"tag {name!r}: {data.size} values for {nents} entities "
@@ -301,47 +324,82 @@ def _compose_vertex_sets(
     return sets
 
 
-def _read_stream(f: BinaryIO) -> dict:
+def _read_stream(
+    f: BinaryIO,
+    version: Optional[int],
+    version_in_stream: bool,
+    end: str,
+) -> dict:
     """Parse one <rank>.osh stream → dict with coords, tet2vert, and
-    per-dimension tag dicts."""
+    per-dimension tag dicts.
+
+    ``version`` is the directory ``version`` file's value (None when
+    absent); ``version_in_stream`` selects whether an int32 version
+    follows the magic (upstream writes it there only for old files
+    whose directories lack the version file); ``end`` is the struct
+    byte-order character. ``_read_stream_any`` tries the variants.
+    """
     if _read_exact(f, 2) != _MAGIC:
         raise OshFormatError("bad magic bytes (not an Omega_h stream)")
-    version = _read_value(f, "i")
+    if version_in_stream:
+        version = _read_value(f, "i", end)
+    if version is None:
+        raise OshFormatError(
+            "no version file in the directory and none read from the "
+            "stream"
+        )
     if not _MIN_VERSION <= version <= _MAX_VERSION:
         raise OshFormatError(
             f".osh stream version {version} outside supported range "
             f"[{_MIN_VERSION}, {_MAX_VERSION}]"
         )
-    dim, comm_size, compressed = _read_meta(f, version)
+    dim, comm_size, compressed = _read_meta(f, version, end)
     if dim != 3:
         raise OshFormatError(f"expected a 3D mesh, got dim={dim}")
-    nverts = _read_value(f, "i")
+    if not 1 <= comm_size < 2**20:
+        raise OshFormatError(f"implausible comm size {comm_size}")
+    nverts = _read_value(f, "i", end)
     if nverts < 0:
         raise OshFormatError(f"negative vertex count {nverts}")
 
     # Downward adjacency chain: edge2vert, tri2edge(+codes), tet2tri(+codes).
-    ev2v = _read_array(f, _TYPE_DTYPES[_TYPE_I32], compressed)
+    ev2v = _read_array(f, _TYPE_I32, compressed, end)
     if ev2v.size % 2:
         raise OshFormatError("edge->vert adjacency not a multiple of 2")
     edge2vert = ev2v.reshape(-1, 2).astype(np.int64)
-    fe2e = _read_array(f, _TYPE_DTYPES[_TYPE_I32], compressed)
-    _ = _read_array(f, _TYPE_DTYPES[_TYPE_I8], compressed)  # tri codes
+    fe2e = _read_array(f, _TYPE_I32, compressed, end)
+    _ = _read_array(f, _TYPE_I8, compressed, end)  # tri codes
     if fe2e.size % 3:
         raise OshFormatError("tri->edge adjacency not a multiple of 3")
     tri2edge = fe2e.reshape(-1, 3).astype(np.int64)
-    rf2f = _read_array(f, _TYPE_DTYPES[_TYPE_I32], compressed)
-    _ = _read_array(f, _TYPE_DTYPES[_TYPE_I8], compressed)  # tet codes
+    rf2f = _read_array(f, _TYPE_I32, compressed, end)
+    _ = _read_array(f, _TYPE_I8, compressed, end)  # tet codes
     if rf2f.size % 4:
         raise OshFormatError("tet->tri adjacency not a multiple of 4")
     tet2tri = rf2f.reshape(-1, 4).astype(np.int64)
 
+    # Index-range validation BEFORE any fancy indexing: a misframed or
+    # corrupt stream must produce the clean format error, not a numpy
+    # IndexError (and the variant auto-detection relies on clean
+    # rejection of wrong framings).
+    for arr, bound, what in (
+        (edge2vert, nverts, "edge->vert"),
+        (tri2edge, edge2vert.shape[0], "tri->edge"),
+        (tet2tri, tri2edge.shape[0], "tet->tri"),
+    ):
+        if arr.size and (arr.min() < 0 or arr.max() >= bound):
+            raise OshFormatError(
+                f"{what} adjacency references entities outside "
+                f"[0, {bound})"
+            )
+
     nents = [nverts, edge2vert.shape[0], tri2edge.shape[0], tet2tri.shape[0]]
     tags: List[Dict[str, np.ndarray]] = []
     for d in range(4):
-        tags.append(_read_tags(f, nents[d], compressed))
+        tags.append(_read_tags(f, nents[d], compressed, end))
         if comm_size > 1:
-            _ranks = _read_array(f, _TYPE_DTYPES[_TYPE_I32], compressed)
-            _idxs = _read_array(f, _TYPE_DTYPES[_TYPE_I32], compressed)
+            _ranks = _read_array(f, _TYPE_I32, compressed, end)
+            _idxs = _read_array(f, _TYPE_I32, compressed, end)
 
     if "coordinates" not in tags[0]:
         raise OshFormatError("no `coordinates` tag on the vertices")
@@ -360,6 +418,44 @@ def _read_stream(f: BinaryIO) -> dict:
         "tags": tags,
         "comm_size": comm_size,
     }
+
+
+def _read_stream_any(f: BinaryIO, dir_version: Optional[int]) -> dict:
+    """Parse a <rank>.osh stream, auto-detecting byte order and version
+    location (the two layout details unprovable without a genuine
+    Omega_h build — see the module docstring).
+
+    Variant priority follows the upstream protocol: when the directory
+    has a ``version`` file the stream does not repeat it (upstream only
+    reads an in-stream version when the file is absent), and streams
+    are canonically little-endian; the transposed variants cover both
+    this package's earlier big-endian/in-stream output and the
+    possibility that the upstream reading here is itself transposed.
+    Wrong framings are rejected by hard structural checks (magic,
+    version range, dim==3, simplex family, adjacency multiples,
+    index ranges, tag sizes, and the vertex-set multiplicity
+    composition), so false acceptance is not a practical concern —
+    and every accepted variant yields the same arrays, since values
+    are values once the framing is fixed.
+    """
+    if dir_version is not None:
+        variants = [("<", False), (">", True), ("<", True), (">", False)]
+    else:
+        # No version file: the stream must carry the version.
+        variants = [("<", True), (">", True)]
+    errors = []
+    for end, vin in variants:
+        f.seek(0)
+        try:
+            return _read_stream(f, dir_version, vin, end)
+        except OshFormatError as e:
+            errors.append(
+                f"[{'LE' if end == '<' else 'BE'}"
+                f"{'/stream-version' if vin else ''}] {e}"
+            )
+    raise OshFormatError(
+        "stream parses under no known layout variant: " + "; ".join(errors)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -421,7 +517,9 @@ def _write_stream(
     extra_tags: Optional[List[Dict[str, np.ndarray]]] = None,
 ) -> None:
     f.write(_MAGIC)
-    _write_value(f, "i", _WRITE_VERSION)
+    # No in-stream version: the directory's `version` file carries it
+    # (upstream moved it there at version 4 and only reads it from the
+    # stream when the file is absent).
     _write_value(f, "b", int(compress))
     _write_value(f, "b", 0)  # family: simplex
     _write_value(f, "b", 3)  # dim
@@ -435,15 +533,11 @@ def _write_stream(
     edge2vert, tri2edge, tri_codes, tet2tri, tet_codes = _build_downward(
         tet2vert
     )
-    i32, i8, f64, i64 = (
-        _TYPE_DTYPES[_TYPE_I32], _TYPE_DTYPES[_TYPE_I8],
-        _TYPE_DTYPES[_TYPE_F64], _TYPE_DTYPES[_TYPE_I64],
-    )
-    _write_array(f, edge2vert.reshape(-1), i32, compress)
-    _write_array(f, tri2edge.reshape(-1), i32, compress)
-    _write_array(f, tri_codes, i8, compress)
-    _write_array(f, tet2tri.reshape(-1), i32, compress)
-    _write_array(f, tet_codes, i8, compress)
+    _write_array(f, edge2vert.reshape(-1), _TYPE_I32, compress)
+    _write_array(f, tri2edge.reshape(-1), _TYPE_I32, compress)
+    _write_array(f, tri_codes, _TYPE_I8, compress)
+    _write_array(f, tet2tri.reshape(-1), _TYPE_I32, compress)
+    _write_array(f, tet_codes, _TYPE_I8, compress)
 
     nents = [coords.shape[0], edge2vert.shape[0], tri2edge.shape[0],
              tet2tri.shape[0]]
@@ -460,20 +554,21 @@ def _write_stream(
             _write_string(f, name)
             _write_value(f, "b", ncomps)
             if data.dtype == np.float64:
-                typ, dt = _TYPE_F64, f64
+                typ = _TYPE_F64
             elif data.dtype == np.int64:
-                typ, dt = _TYPE_I64, i64
+                typ = _TYPE_I64
             elif data.dtype == np.int8:
-                typ, dt = _TYPE_I8, i8
+                typ = _TYPE_I8
             else:
-                typ, dt = _TYPE_I32, i32
+                typ = _TYPE_I32
             _write_value(f, "b", typ)
-            _write_array(f, data.reshape(-1), dt, compress)
+            _write_array(f, data.reshape(-1), typ, compress)
         if comm_size > 1:
             # Owners: this writer emits fully-owned parts (rank owns
             # every entity it stores) — merging goes through globals.
-            _write_array(f, np.full(nents[d], comm_rank), i32, compress)
-            _write_array(f, np.arange(nents[d]), i32, compress)
+            _write_array(f, np.full(nents[d], comm_rank), _TYPE_I32,
+                         compress)
+            _write_array(f, np.arange(nents[d]), _TYPE_I32, compress)
 
 
 # ---------------------------------------------------------------------------
@@ -618,6 +713,11 @@ def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
     if os.path.exists(nparts_file):
         with open(nparts_file) as f:
             nparts = int(f.read().strip())
+    version_file = os.path.join(path, "version")
+    dir_version: Optional[int] = None
+    if os.path.exists(version_file):
+        with open(version_file) as f:
+            dir_version = int(f.read().strip())
     parts = []
     for rank in range(nparts):
         stream = os.path.join(path, f"{rank}.osh")
@@ -628,7 +728,7 @@ def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
             )
         with open(stream, "rb") as f:
             try:
-                parts.append(_read_stream(f))
+                parts.append(_read_stream_any(f, dir_version))
             except OshFormatError as e:
                 raise ValueError(
                     f"{path!r}/{rank}.osh does not parse as an Omega_h "

@@ -319,17 +319,21 @@ def test_cli_box_and_pincell_generation(tmp_path, capsys):
     )
     # The material classification rides in the written stream as the
     # class_id element tag.
-    from pumiumtally_tpu.io.osh import _read_stream
+    from pumiumtally_tpu.io.osh import _WRITE_VERSION, _read_stream_any
 
     with open(pin + "/0.osh", "rb") as f:
-        parsed = _read_stream(f)
+        parsed = _read_stream_any(f, _WRITE_VERSION)
     region = np.asarray(parsed["tags"][3]["class_id"])
     assert set(np.unique(region)) == {0, 1}
     assert region.shape[0] == mesh.nelems
 
 
 def test_osh_elem_tag_validation(tmp_path):
-    from pumiumtally_tpu.io.osh import _read_stream, write_osh
+    from pumiumtally_tpu.io.osh import (
+        _WRITE_VERSION,
+        _read_stream_any,
+        write_osh,
+    )
 
     coords, tets = box_arrays(1, 1, 1, 1, 1, 1)
     ne = len(tets)
@@ -343,7 +347,7 @@ def test_osh_elem_tag_validation(tmp_path):
         "mat": np.arange(ne, dtype=np.int16),
     })
     with open(p + "/0.osh", "rb") as f:
-        tags = _read_stream(f)["tags"][3]
+        tags = _read_stream_any(f, _WRITE_VERSION)["tags"][3]
     np.testing.assert_allclose(
         tags["density"], np.linspace(0.1, 0.7, ne).astype(np.float32),
         rtol=1e-7,
@@ -436,7 +440,7 @@ def test_pvtu_explicit_nparts_writes_empty_trailing_pieces(tmp_path):
 
 def test_cli_lattice_generation(tmp_path, capsys):
     from pumiumtally_tpu.cli import main as cli_main
-    from pumiumtally_tpu.io.osh import _read_stream
+    from pumiumtally_tpu.io.osh import _WRITE_VERSION, _read_stream_any
 
     out = str(tmp_path / "asm.osh")
     cli_main(["lattice", out, "--nx", "2", "--ny", "2", "--n-theta", "8",
@@ -448,7 +452,7 @@ def test_cli_lattice_generation(tmp_path, capsys):
         np.asarray(mesh.volumes).sum(), 4 * 1.26**2, rtol=1e-12
     )
     with open(out + "/0.osh", "rb") as f:
-        parsed = _read_stream(f)
+        parsed = _read_stream_any(f, _WRITE_VERSION)
     cid = np.asarray(parsed["tags"][3]["cell_id"])
     assert sorted(np.unique(cid).tolist()) == [0, 1, 2, 3]
     assert cid.shape[0] == mesh.nelems
@@ -474,13 +478,47 @@ _CUBE_TETS = {
 }
 
 
-@pytest.mark.parametrize("name", ["cube_omega1.osh", "cube_omega2.osh"])
+@pytest.mark.parametrize("name", [
+    # tools/make_osh_fixture.py output: big-endian with an in-stream
+    # version (this package's earlier reading of the layout).
+    "cube_omega1.osh", "cube_omega2.osh",
+    # native/osh_writer.cpp output: a C++ transcription of the
+    # upstream writer's serialization logic — little-endian, version
+    # only in the directory file, compress2-at-Z_BEST_SPEED zlib
+    # framing (and a raw variant). NOT produced by any Python module
+    # in this repo.
+    "cube_omega_cpp.osh", "cube_omega_cpp_raw.osh",
+])
 def test_osh_reads_independent_fixture(name):
     from pumiumtally_tpu.io.osh import read_osh
 
     coords, tets = read_osh(os.path.join(_FIX, name))
     np.testing.assert_allclose(coords, _CUBE_VERTS)
     assert {tuple(sorted(t)) for t in tets.tolist()} == _CUBE_TETS
+
+
+def test_osh_cpp_fixture_is_little_endian_without_stream_version():
+    """Pin the layout axes the C++ transcription settles differently
+    from the earlier Python fixtures, so regeneration cannot silently
+    collapse the variant coverage: after the 2-byte magic the stream
+    begins with the compression flag (no int32 version), and the first
+    array count is little-endian."""
+    import struct
+
+    with open(os.path.join(_FIX, "cube_omega_cpp_raw.osh", "0.osh"),
+              "rb") as f:
+        data = f.read()
+    assert data[:2] == b"\xa1\x1a"
+    # compressed?=0, family=0 (simplex), dim=3 — not a version int32.
+    assert data[2] == 0 and data[3] == 0 and data[4] == 3
+    # meta: cs(i32) cr(i32) parting(i8) ng(i32) hints(i8) then nverts.
+    cs, cr = struct.unpack_from("<ii", data, 5)
+    assert (cs, cr) == (1, 0)
+    (nverts,) = struct.unpack_from("<i", data, 5 + 4 + 4 + 1 + 4 + 1)
+    assert nverts == 8  # little-endian read of the true count
+    # edge->vert count follows: 2 per edge, 19 edges for the 6-tet cube.
+    (ev_count,) = struct.unpack_from("<i", data, 5 + 4 + 4 + 1 + 4 + 1 + 4)
+    assert ev_count == 38
 
 
 def test_osh_fixture_builds_mesh_with_unit_volume():

@@ -28,6 +28,15 @@ Omega_h build (none is obtainable in this environment — no network).
 It does prove the reader decodes a stream written from the documented
 layout by code that cannot share a systematic bug with it.
 
+NOTE (round 4): these fixtures deliberately keep the BIG-endian,
+version-in-stream framing this repo's earlier layout reading used.
+The reader now auto-detects framing variants (io/osh.py
+``_read_stream_any``), the package writer emits the upstream-protocol
+variant (little-endian, version in the directory file only), and
+``native/osh_writer.cpp`` — a C++ transcription of the upstream
+writer — generates fixtures in THAT framing; keeping this generator's
+framing unchanged preserves test coverage of the transposed variant.
+
 Run from the repo root:  python tools/make_osh_fixture.py
 """
 
