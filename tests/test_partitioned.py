@@ -166,6 +166,7 @@ def test_partitioned_oracle_6tet_cube():
     )
 
 
+@pytest.mark.slow
 def test_partitioned_split_adjacency_matches_packed():
     """The int32 out-of-row adjacency fallback (f32 meshes past the
     exact-id limit) must walk identically to the packed table."""
@@ -229,6 +230,7 @@ def test_partitioned_stress_forced_migrations():
     )
 
 
+@pytest.mark.slow
 def test_partitioned_lost_source_points_never_tally(capsys):
     """Source points outside every element (possible only on
     non-convex/foreign geometry, or points outside the hull) must be
@@ -427,6 +429,7 @@ def test_migrate_state_pack_round_trip():
         np.testing.assert_array_equal(np.asarray(got), np.asarray(v[perm]), k)
 
 
+@pytest.mark.slow
 def test_last_walk_rounds_diagnostic():
     """last_walk_rounds reports the phase's walk rounds: 1 when no
     particle crosses a partition (no migration), >1 when crossings

@@ -137,6 +137,7 @@ def test_lattice_1x1_equals_pincell():
     )
 
 
+@pytest.mark.slow
 def test_lattice_partitioned_matches_monolithic():
     """Partitioned engine over the assembly geometry: RCB ownership of
     the O-grid cells, migration across curved-ring interfaces; flux

@@ -103,6 +103,7 @@ def test_cascade_matches_plain_walk_under_shard_map():
                                rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_cascade_respects_max_iter_budget():
     mesh, x, elem, dest, fly, w = _setup(seed=1)
     flux0 = jnp.zeros((mesh.nelems,))
