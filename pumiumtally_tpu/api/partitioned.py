@@ -58,6 +58,7 @@ class PartitionedPumiTally(PumiTally):
             check_found_all=self.config.check_found_all,
             cond_every=self.config.resolved_cond_every(),
             min_window=self.config.resolved_min_window(),
+            vmem_walk_max_elems=self.config.walk_vmem_max_elems,
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

@@ -428,6 +428,7 @@ class StreamingPartitionedTally(StreamingTally):
                 part=part, shared_jit_cache=caches[g],
                 cond_every=self.config.resolved_cond_every(),
                 min_window=self.config.resolved_min_window(),
+                vmem_walk_max_elems=self.config.walk_vmem_max_elems,
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

@@ -1,0 +1,286 @@
+"""VMEM-resident one-hot MXU walk for small mesh partitions (Pallas).
+
+The production walk kernels (``ops/walk.py``, ``parallel/partition.py
+walk_local``) fetch each particle's current tet row from the packed
+``[L,20]`` walk table with a random-row HBM gather — measured as the
+hot loop's bandwidth floor (~80 B/crossing at row-granularity DMA
+rates, docs/PERF_NOTES.md). When a PARTITION is small enough that its
+table fits VMEM (~16 MB/core on v5e; a [4k,32] f32 table is 0.5 MB),
+the gather can instead be a one-hot matmul executed entirely on-chip:
+
+    row[W,32]  = onehot(lelem)[W,L] @ table[L,32]     (row fetch)
+    flux[L]   += contrib[1,W] @ onehot(lelem)[W,L]    (tally scatter)
+
+``vmem_walk_local`` is a drop-in for ``walk_local``'s walk itself (same
+pause/ownership semantics: exit faces whose neighbor lives on another
+chip set ``pending`` and park the particle for migration) as ONE Pallas
+kernel per particle tile: the table is pinned in VMEM, the whole
+while-loop runs inside the kernel (no per-iteration XLA op boundaries,
+no HBM round-trips for the loop carries), and the tile's flux partial
+accumulates on-chip and is written once.
+
+Cost model (why only small L wins): the MXU work is 2·W·L·32 FLOPs per
+iteration regardless of the active fraction — ~3-5x under the measured
+gather floor at L≈512-1k, a wash by L≈4k (prototype analysis:
+tools/exp_r3_vmem.py). The ``TallyConfig.walk_vmem_max_elems`` knob
+gates it accordingly, on the PER-CHIP element count.
+
+Numerical contract: NOT bitwise-identical to ``walk_local`` — the
+per-face projections are computed column-wise (Mosaic-lowerable form)
+instead of via the einsum, so results can differ in the last ulp; a
+destination exactly ON a tet face may then commit the face-adjacent
+neighbor element (the same benign divergence class partitioned mode
+already documents vs the replicated walk). Track lengths, committed
+positions, pause points and flux agree to rounding; the engines'
+conservation gates apply unchanged.
+
+No compaction cascade: lock-step waste costs MXU flops here, not
+gathers, and the one-hot tile shape is fixed — the while_loop exits as
+soon as the tile is all done/paused, which serves the same purpose at
+tile granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Table rows are padded [L,20] -> [L,TABLE_PAD_COLS] so the MXU operand
+# has a lane-aligned minor dimension.
+TABLE_PAD_COLS = 32
+W_TILE_DEFAULT = 256
+
+
+def pad_table(table: jnp.ndarray) -> jnp.ndarray:
+    """[L,20] walk table -> [L,32] zero-padded MXU operand."""
+    L, c = table.shape
+    return jnp.concatenate(
+        [table, jnp.zeros((L, TABLE_PAD_COLS - c), table.dtype)], axis=1
+    )
+
+
+def backend_needs_interpret() -> bool:
+    """Mosaic lowering exists only on TPU backends; everywhere else
+    (the CPU parity/test environments) the kernel runs in pallas
+    interpret mode — same semantics, no compiled-kernel speed."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _advance_cols(
+    row, s, lelem, done, exited, pending, dest, d0, eff_w, tol, one, tally
+):
+    """One lock-step iteration from a fetched [W,32] row, column-wise
+    (no [W,4,3] reshape/einsum — the Mosaic-lowerable form). Mirrors
+    ``walk_local``'s advance semantics exactly: same crossing
+    predicate, same first-minimal-face tie-break (argmin), same
+    pause/boundary/reach transitions."""
+    active = (~done) & (pending < 0)
+    a_list, b_list = [], []
+    for f in range(4):
+        nx, ny, nz = row[:, 3 * f], row[:, 3 * f + 1], row[:, 3 * f + 2]
+        a_f = nx * d0[:, 0] + ny * d0[:, 1] + nz * d0[:, 2]
+        n_dest = nx * dest[:, 0] + ny * dest[:, 1] + nz * dest[:, 2]
+        # b = off - n·x0, with x0 = dest - d0 (the ray's start).
+        b_f = row[:, 12 + f] - n_dest + a_f
+        a_list.append(a_f)
+        b_list.append(b_f)
+    inf = jnp.asarray(jnp.inf, s.dtype)
+    s_fs = []
+    for f in range(4):
+        crossing = a_list[f] * (one - s) > tol
+        s_f = jnp.where(
+            crossing, b_list[f] / jnp.where(crossing, a_list[f], one), inf
+        )
+        s_fs.append(jnp.maximum(s_f, s))
+    s_exit = jnp.minimum(
+        jnp.minimum(s_fs[0], s_fs[1]), jnp.minimum(s_fs[2], s_fs[3])
+    )
+    adj = [row[:, 16 + f].astype(jnp.int32) for f in range(4)]
+    nxt = adj[3]
+    for f in (2, 1, 0):  # first minimal face wins (matches argmin)
+        nxt = jnp.where(s_fs[f] == s_exit, adj[f], nxt)
+    reached = s_exit >= one
+    s_new = jnp.where(reached, one, s_exit)
+    hit_boundary = (~reached) & (nxt == -1)
+    goes_remote = (~reached) & (nxt <= -2)
+
+    contrib = (
+        jnp.where(active, (s_new - s) * eff_w, 0.0) if tally else None
+    )
+
+    moving = active & ~reached & ~hit_boundary & ~goes_remote
+    lelem = jnp.where(moving, nxt, lelem)
+    s = jnp.where(active, s_new, s)
+    pending = jnp.where(active & goes_remote, -nxt - 2, pending)
+    done = done | (active & (reached | hit_boundary))
+    exited = exited | (active & hit_boundary)
+    return s, lelem, done, exited, pending, contrib
+
+
+def vmem_walk_local(
+    table: jnp.ndarray,  # [L,20] this chip's walk rows
+    x: jnp.ndarray,  # [S,3]
+    lelem: jnp.ndarray,  # [S] local element ids
+    dest: jnp.ndarray,  # [S,3]
+    flying: jnp.ndarray,  # [S] int8
+    weight: jnp.ndarray,  # [S]
+    done: jnp.ndarray,  # [S] bool
+    exited: jnp.ndarray,  # [S] bool
+    flux: jnp.ndarray,  # [L] owned flux
+    *,
+    tally: bool,
+    tol: float,
+    max_iters: int,
+    w_tile: int = W_TILE_DEFAULT,
+    interpret: Optional[bool] = None,
+    vma: Optional[frozenset] = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """Drop-in for ``parallel.partition.walk_local`` (minus its cascade
+    knobs): returns ``(x, lelem, done, exited, pending, flux, iters)``
+    with identical pause/boundary semantics, computed by the VMEM
+    one-hot kernel above. ``iters`` is the max over tiles.
+
+    Requires local adjacency ids representable in the float table
+    (``adj_int is None`` partitions — always true at VMEM-scale L).
+
+    ``vma``: when called inside ``shard_map`` with varying-mesh-axis
+    checking on, the mesh axis names the outputs vary over (the
+    engine passes its partition axis); pallas out_shapes must carry
+    them explicitly.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = backend_needs_interpret()
+    fdtype = x.dtype
+    L = table.shape[0]
+    one = jnp.asarray(1.0, fdtype)
+    n = x.shape[0]
+    if n == 0:  # walk_local handles the empty batch; match it
+        return (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
+                flux, jnp.asarray(0, jnp.int32))
+    w_tile = min(int(w_tile), max(n, 1))
+    pad = (-n) % w_tile
+
+    if pad:
+        def padv(a, fill):
+            return jnp.concatenate(
+                [a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)]
+            )
+
+        x, dest = padv(x, 0.0), padv(dest, 0.0)
+        lelem = padv(lelem, 0)
+        flying = padv(flying, 0)
+        weight = padv(weight, 0.0)
+        done = padv(done, True)  # pad slots are inert
+        exited = padv(exited, False)
+
+    d0 = dest - x
+    seg_len = jnp.linalg.norm(d0, axis=1)
+    eff_w = jnp.where(flying.astype(bool), weight * seg_len, 0.0)
+    T = (n + pad) // w_tile
+    max_iters = int(max_iters)
+    table_p = pad_table(table)
+
+    def kernel(table_ref, x_ref, lelem_ref, dest_ref, effw_ref, done_ref,
+               exited_ref, s_out, lelem_out, done_out, exited_out,
+               pending_out, it_out, flux_out):
+        table_v = table_ref[:]
+        x0 = x_ref[:]
+        dest_c = dest_ref[:]
+        d0_c = dest_c - x0
+        effw_c = effw_ref[:]
+        one_k = jnp.asarray(1.0, x0.dtype)
+        iota = lax.broadcasted_iota(jnp.int32, (w_tile, L), 1)
+        if vma:
+            # Under shard_map's varying-axis checking, primitive
+            # outputs computed from no input (the iota) stay
+            # "unvarying" and refuse to combine with the varying ref
+            # data — promote explicitly.
+            iota = lax.pvary(iota, tuple(vma))
+
+        def body(carry):
+            it, s, lelem, done, exited, pending, fl = carry
+            oh = (lelem[:, None] == iota).astype(table_v.dtype)
+            row = jnp.dot(oh, table_v,
+                          preferred_element_type=table_v.dtype)
+            s, lelem, done, exited, pending, contrib = _advance_cols(
+                row, s, lelem, done, exited, pending, dest_c, d0_c,
+                effw_c, tol, one_k, tally,
+            )
+            if tally:
+                fl = fl + jnp.dot(contrib[None, :], oh,
+                                  preferred_element_type=fl.dtype)
+            return it + jnp.int32(1), s, lelem, done, exited, pending, fl
+
+        def cond(carry):
+            it, _s, _le, done, _ex, pending, _fl = carry
+            return (it < max_iters) & jnp.any((~done) & (pending < 0))
+
+        # Initial carries derived from kernel INPUTS, not literal
+        # constants: under shard_map a literal is "unvarying" while the
+        # loop outputs vary over the partition axis, which breaks the
+        # while_loop carry typing (same hazard walk_local documents).
+        lelem0 = lelem_ref[:]
+        s0_k = x0[:, 0] * jnp.asarray(0, x0.dtype)
+        pending0 = (lelem0 - lelem0) - 1
+        fl0 = (table_v[:, 0] * jnp.asarray(0, table_v.dtype)).astype(
+            flux.dtype
+        )[None, :]
+        it, s, lelem, done, exited, pending, fl = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), s0_k, lelem0,
+             done_ref[:] != 0, exited_ref[:] != 0, pending0, fl0),
+        )
+        s_out[:] = s
+        lelem_out[:] = lelem
+        done_out[:] = done.astype(jnp.int8)
+        exited_out[:] = exited.astype(jnp.int8)
+        pending_out[:] = pending
+        it_out[0] = it
+        flux_out[:] = fl
+
+    tile = lambda: pl.BlockSpec((w_tile,), lambda t: (t,))  # noqa: E731
+    tile3 = lambda: pl.BlockSpec((w_tile, 3), lambda t: (t, 0))  # noqa: E731
+    s_o, lelem_o, done_o, exited_o, pending_o, iters, fparts = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((L, TABLE_PAD_COLS), lambda t: (0, 0)),
+            tile3(), tile(), tile3(), tile(), tile(), tile(),
+        ],
+        out_specs=[
+            tile(), tile(), tile(), tile(), tile(),
+            pl.BlockSpec((1,), lambda t: (t,)),
+            pl.BlockSpec((1, L), lambda t: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T * w_tile,), fdtype, vma=vma),
+            jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
+            jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
+            jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((T,), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((T, L), flux.dtype, vma=vma),
+        ],
+        interpret=interpret,
+    )(table_p, x, lelem, dest, eff_w,
+      done.astype(jnp.int8), exited.astype(jnp.int8))
+
+    s_o, lelem_o = s_o[:n], lelem_o[:n]
+    done_o = done_o[:n] != 0
+    exited_o = exited_o[:n] != 0
+    pending_o = pending_o[:n]
+    dest, d0 = dest[:n], d0[:n]
+    x0 = dest - d0
+    flux = flux + jnp.sum(fparts, axis=0)
+    # Same materialization rule as walk_local: reached-dest commits
+    # dest bit-exactly; everyone else (boundary leavers AND paused
+    # particles) commits x0 + s·d0.
+    x_fin = jnp.where(
+        (done_o & ~exited_o)[:, None], dest, x0 + s_o[:, None] * d0
+    )
+    return x_fin, lelem_o, done_o, exited_o, pending_o, flux, jnp.max(iters)

@@ -604,12 +604,19 @@ class PartitionedEngine:
         shared_jit_cache: Optional[dict] = None,
         cond_every: int = COND_EVERY_DEFAULT,
         min_window: int = _MIN_WINDOW,
+        vmem_walk_max_elems: Optional[int] = None,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
         compiled locate/phase programs between engines with identical
         partition/tolerance/round parameters — without it every chunk
-        engine would recompile the phase while_loop."""
+        engine would recompile the phase while_loop.
+
+        ``vmem_walk_max_elems`` (TallyConfig.walk_vmem_max_elems): use
+        the VMEM one-hot MXU local walk (ops/vmem_walk.py) when the
+        per-chip element count fits the bound; oversized partitions
+        (or ones needing the int adjacency sidecar) keep the gather
+        walk silently — the knob is a ceiling, not a demand."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -630,6 +637,11 @@ class PartitionedEngine:
         self.max_rounds = max_rounds
         self.cond_every = int(cond_every)
         self.min_window = int(min_window)
+        self.use_vmem_walk = (
+            vmem_walk_max_elems is not None
+            and self.part.L <= int(vmem_walk_max_elems)
+            and self.part.adj_int is None
+        )
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.ndev * self.part.L,), dtype)
         # Initial layout: particle pid occupies slot pid (chips get
@@ -824,7 +836,7 @@ class PartitionedEngine:
         # last, smaller chunk's capacity).
         key = ("phase", tally, self.cap_per_chip, self.max_rounds,
                self.max_iters, self.tol, self.cond_every, self.min_window,
-               id(self.part))
+               self.use_vmem_walk, id(self.part))
         if key in self._jit_cache:
             return self._jit_cache[key]
         pp = P(self.axis)
@@ -836,17 +848,27 @@ class PartitionedEngine:
         min_window = self.min_window
         has_adj = self.part.adj_int is not None
 
+        use_vmem = self.use_vmem_walk
+
         def round_kernel(table, *rest):
             if has_adj:
                 adj, x, lelem, dest, fly, w, done, exited, flux = rest
             else:
                 adj = None
                 x, lelem, dest, fly, w, done, exited, flux = rest
-            x, lelem, done, exited, pending, flux, _ = walk_local(
-                table, x, lelem, dest, fly, w, done, exited, flux,
-                tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
-                cond_every=cond_every, min_window=min_window,
-            )
+            if use_vmem:
+                from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
+
+                x, lelem, done, exited, pending, flux, _ = vmem_walk_local(
+                    table, x, lelem, dest, fly, w, done, exited, flux,
+                    tally=tally, tol=tol, max_iters=max_iters,
+                )
+            else:
+                x, lelem, done, exited, pending, flux, _ = walk_local(
+                    table, x, lelem, dest, fly, w, done, exited, flux,
+                    tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
+                    cond_every=cond_every, min_window=min_window,
+                )
             # Global round status computed in-program (one psum each) so
             # the while_loop can branch on them without leaving the
             # device.
@@ -855,11 +877,19 @@ class PartitionedEngine:
             return x, lelem, done, exited, pending, flux, n_pending, n_not_done
 
         n_in = 10 if has_adj else 9
+        # check_vma is disabled ONLY for the vmem-kernel variant: this
+        # jax version's pallas interpret path re-traces the kernel with
+        # physical types that drop the varying-axis tags, so the vma
+        # checker rejects any pallas_call under shard_map (its own
+        # error message recommends exactly this workaround). The gather
+        # variant keeps full vma checking; result parity between the
+        # two engines is pinned by tests/test_vmem_walk.py.
         round_sm = shard_map(
             round_kernel,
             mesh=self.device_mesh,
             in_specs=(pp,) * n_in,
             out_specs=(pp,) * 6 + (P(), P()),
+            check_vma=not use_vmem,
         )
 
         @jax.jit

@@ -1,0 +1,152 @@
+"""VMEM one-hot MXU walk (ops/vmem_walk.py) vs the gather-based
+``walk_local`` — semantics parity in pallas interpret mode (the CPU
+environment; Mosaic-compiled timing happens in the on-chip suite).
+
+The kernel is documented NOT bitwise-identical (column-wise projections
+round differently from the einsum), so parity here is: identical
+done/exited/pending/lelem transitions on generic (non-face-tie)
+workloads, positions and flux to rounding, and the engines' own
+conservation gate when wired in via TallyConfig.walk_vmem_max_elems.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import build_box
+from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
+from pumiumtally_tpu.parallel.partition import build_partition, walk_local
+
+
+def _chip_workload(seed, n, ndev=4, divs=4):
+    """A single chip's slice of a partitioned walk: its [L,20] table
+    plus particles localized to its elements, some destined to cross
+    partition faces (pauses), some non-flying (hold), some dead."""
+    mesh = build_box(1, 1, 1, divs, divs, divs)
+    part = build_partition(mesh, ndev)
+    assert part.adj_int is None
+    rng = np.random.default_rng(seed)
+    chip = 1
+    table = part.table[chip * part.L: (chip + 1) * part.L]
+    # Localize sources inside chip 1's owned elements via centroids.
+    owned = np.flatnonzero(np.asarray(part.orig_of_glid).reshape(
+        ndev, part.L)[chip] >= 0)
+    lelem = rng.choice(owned, size=n).astype(np.int32)
+    coords = np.asarray(mesh.coords)
+    tets = np.asarray(mesh.tet2vert)
+    orig = np.asarray(part.orig_of_glid).reshape(ndev, part.L)[chip]
+    cent = coords[tets[orig[lelem]]].mean(axis=1)
+    # Random walk destinations: mix of short hops (stay local), long
+    # hops (cross partitions -> pause), and exits (outside the box).
+    step = rng.normal(scale=0.25, size=(n, 3))
+    dest = cent + step
+    fly = (rng.random(n) > 0.15).astype(np.int8)
+    dead = rng.random(n) < 0.1
+    w = rng.uniform(0.5, 2.0, n)
+    x = jnp.asarray(cent)
+    dest = jnp.asarray(np.where(fly[:, None] == 1, dest, cent))
+    done0 = jnp.asarray(dead)
+    exited0 = jnp.zeros(n, bool)
+    flux0 = jnp.zeros((part.L,), x.dtype)
+    return (table, x, jnp.asarray(lelem), dest, jnp.asarray(fly),
+            jnp.asarray(w), done0, exited0, flux0)
+
+
+@pytest.mark.parametrize("tally", [True, False])
+def test_vmem_walk_local_matches_gather_walk(tally):
+    args = _chip_workload(seed=5, n=700)
+    ref = walk_local(*args, tally=tally, tol=1e-8, max_iters=4096)
+    out = vmem_walk_local(*args, tally=tally, tol=1e-8, max_iters=4096,
+                          w_tile=128, interpret=True)
+    rx, rl, rd, rex, rp, rf, _ = ref
+    vx, vl, vd, vex, vp, vf, _ = out
+    np.testing.assert_array_equal(np.asarray(vd), np.asarray(rd))
+    np.testing.assert_array_equal(np.asarray(vex), np.asarray(rex))
+    np.testing.assert_array_equal(np.asarray(vp), np.asarray(rp))
+    np.testing.assert_array_equal(np.asarray(vl), np.asarray(rl))
+    np.testing.assert_allclose(np.asarray(vx), np.asarray(rx),
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(vf), np.asarray(rf),
+                               rtol=1e-10, atol=1e-13)
+    if not tally:
+        np.testing.assert_array_equal(np.asarray(vf), np.asarray(rf))
+    # The workload must actually exercise pauses and mixed outcomes,
+    # or this parity test proves nothing.
+    assert int(np.sum(np.asarray(rp) >= 0)) > 0
+    assert int(np.sum(np.asarray(rex))) > 0
+    assert int(np.sum(np.asarray(rd))) > 0
+
+
+def test_vmem_walk_local_tile_padding_invariance():
+    """Results must not depend on the tile size / padding split."""
+    args = _chip_workload(seed=6, n=333)  # deliberately not a multiple
+    outs = []
+    for w_tile in (64, 333, 512):
+        outs.append(vmem_walk_local(
+            *args, tally=True, tol=1e-8, max_iters=4096,
+            w_tile=w_tile, interpret=True,
+        ))
+    for o in outs[1:]:
+        # Per-particle outputs (x, lelem, done, exited, pending) are
+        # exactly tile-invariant: each trajectory's math is unchanged
+        # by how particles are grouped into kernel tiles.
+        for a, b in zip(outs[0][:5], o[:5]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Flux is reduced per tile then summed, so only the ADDITION
+        # ORDER depends on the split — values agree to rounding.
+        np.testing.assert_allclose(np.asarray(outs[0][5]),
+                                   np.asarray(o[5]),
+                                   rtol=1e-12, atol=1e-15)
+
+
+def test_partitioned_engine_with_vmem_walk_matches_default():
+    """TallyConfig.walk_vmem_max_elems wires the kernel into the
+    partitioned engine; flux/positions agree with the gather engine to
+    f64 rounding and conservation holds."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    n = 600
+    rng = np.random.default_rng(9)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for knob in (None, 10_000):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=make_device_mesh(8),
+                        capacity_factor=8.0,
+                        walk_vmem_max_elems=knob),
+        )
+        assert t.engine.use_vmem_walk is (knob is not None)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        t.MoveToNextLocation(None, d2.reshape(-1).copy())
+        out.append((np.asarray(t.flux, np.float64), t.positions))
+    np.testing.assert_allclose(out[0][0], out[1][0],
+                               rtol=1e-10, atol=1e-13)
+    np.testing.assert_allclose(out[0][1], out[1][1],
+                               rtol=1e-12, atol=1e-12)
+    # Conservation on the vmem engine: total flux == total track length.
+    expect = (np.linalg.norm(d1 - src, axis=1)
+              + np.linalg.norm(d2 - d1, axis=1)).sum()
+    np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
+
+
+def test_vmem_gate_rejects_oversized_partitions():
+    """The engine must fall back to the gather walk (not crash, not
+    silently mis-tally) when the per-chip element count exceeds the
+    knob."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)  # 384 tets over 8 chips: L=48
+    t = PartitionedPumiTally(
+        mesh, 100,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
+                    walk_vmem_max_elems=10),  # below L
+    )
+    assert t.engine.use_vmem_walk is False
