@@ -48,8 +48,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pumiumtally_tpu.mesh.tetmesh import (
+    WALK_TABLE_ADJ,
+    WALK_TABLE_NORMALS,
+    WALK_TABLE_OFFSETS,
+)
+
 # Table rows are padded [L,20] -> [L,TABLE_PAD_COLS] so the MXU operand
-# has a lane-aligned minor dimension.
+# has a lane-aligned minor dimension. Column bases come from the shared
+# packed-table layout constants so a reorder there cannot silently skew
+# this kernel's reads.
+_N0 = WALK_TABLE_NORMALS.start
+_O0 = WALK_TABLE_OFFSETS.start
+_A0 = WALK_TABLE_ADJ.start
 TABLE_PAD_COLS = 32
 W_TILE_DEFAULT = 256
 
@@ -80,11 +91,12 @@ def _advance_cols(
     active = (~done) & (pending < 0)
     a_list, b_list = [], []
     for f in range(4):
-        nx, ny, nz = row[:, 3 * f], row[:, 3 * f + 1], row[:, 3 * f + 2]
+        nx, ny, nz = (row[:, _N0 + 3 * f], row[:, _N0 + 3 * f + 1],
+                      row[:, _N0 + 3 * f + 2])
         a_f = nx * d0[:, 0] + ny * d0[:, 1] + nz * d0[:, 2]
         n_dest = nx * dest[:, 0] + ny * dest[:, 1] + nz * dest[:, 2]
         # b = off - n·x0, with x0 = dest - d0 (the ray's start).
-        b_f = row[:, 12 + f] - n_dest + a_f
+        b_f = row[:, _O0 + f] - n_dest + a_f
         a_list.append(a_f)
         b_list.append(b_f)
     inf = jnp.asarray(jnp.inf, s.dtype)
@@ -98,7 +110,7 @@ def _advance_cols(
     s_exit = jnp.minimum(
         jnp.minimum(s_fs[0], s_fs[1]), jnp.minimum(s_fs[2], s_fs[3])
     )
-    adj = [row[:, 16 + f].astype(jnp.int32) for f in range(4)]
+    adj = [row[:, _A0 + f].astype(jnp.int32) for f in range(4)]
     nxt = adj[3]
     for f in (2, 1, 0):  # first minimal face wins (matches argmin)
         nxt = jnp.where(s_fs[f] == s_exit, adj[f], nxt)
@@ -146,10 +158,14 @@ def vmem_walk_local(
     Requires local adjacency ids representable in the float table
     (``adj_int is None`` partitions — always true at VMEM-scale L).
 
-    ``vma``: when called inside ``shard_map`` with varying-mesh-axis
-    checking on, the mesh axis names the outputs vary over (the
-    engine passes its partition axis); pallas out_shapes must carry
-    them explicitly.
+    ``vma``: the mesh axis names the outputs vary over when called
+    inside ``shard_map`` with varying-axis checking on. Currently
+    UNUSED by the engines: this jax version's pallas interpret path
+    re-traces kernels with physical types that drop the tags, so the
+    partitioned engine disables ``check_vma`` for its vmem round
+    program instead (see partition.py) and passes nothing here. Kept
+    (with the matching ``lax.pvary`` of the kernel's iota) for a jax
+    where the interpret path is consistent.
     """
     from jax.experimental import pallas as pl
 
@@ -157,7 +173,6 @@ def vmem_walk_local(
         interpret = backend_needs_interpret()
     fdtype = x.dtype
     L = table.shape[0]
-    one = jnp.asarray(1.0, fdtype)
     n = x.shape[0]
     if n == 0:  # walk_local handles the empty batch; match it
         return (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
@@ -187,7 +202,8 @@ def vmem_walk_local(
 
     def kernel(table_ref, x_ref, lelem_ref, dest_ref, effw_ref, done_ref,
                exited_ref, s_out, lelem_out, done_out, exited_out,
-               pending_out, it_out, flux_out):
+               pending_out, it_out, *flux_outs):
+        flux_out = flux_outs[0] if tally else None
         table_v = table_ref[:]
         x0 = x_ref[:]
         dest_c = dest_ref[:]
@@ -203,7 +219,10 @@ def vmem_walk_local(
             iota = lax.pvary(iota, tuple(vma))
 
         def body(carry):
-            it, s, lelem, done, exited, pending, fl = carry
+            # The flux partial rides the carry only when tallying — a
+            # no-tally walk (localization, phase A) then carries,
+            # writes and reduces nothing provably zero.
+            it, s, lelem, done, exited, pending, *fl = carry
             oh = (lelem[:, None] == iota).astype(table_v.dtype)
             row = jnp.dot(oh, table_v,
                           preferred_element_type=table_v.dtype)
@@ -212,12 +231,13 @@ def vmem_walk_local(
                 effw_c, tol, one_k, tally,
             )
             if tally:
-                fl = fl + jnp.dot(contrib[None, :], oh,
-                                  preferred_element_type=fl.dtype)
-            return it + jnp.int32(1), s, lelem, done, exited, pending, fl
+                fl = [fl[0] + jnp.dot(contrib[None, :], oh,
+                                      preferred_element_type=fl[0].dtype)]
+            return (it + jnp.int32(1), s, lelem, done, exited, pending,
+                    *fl)
 
         def cond(carry):
-            it, _s, _le, done, _ex, pending, _fl = carry
+            it, _s, _le, done, _ex, pending = carry[:6]
             return (it < max_iters) & jnp.any((~done) & (pending < 0))
 
         # Initial carries derived from kernel INPUTS, not literal
@@ -227,48 +247,55 @@ def vmem_walk_local(
         lelem0 = lelem_ref[:]
         s0_k = x0[:, 0] * jnp.asarray(0, x0.dtype)
         pending0 = (lelem0 - lelem0) - 1
-        fl0 = (table_v[:, 0] * jnp.asarray(0, table_v.dtype)).astype(
-            flux.dtype
-        )[None, :]
-        it, s, lelem, done, exited, pending, fl = lax.while_loop(
-            cond, body,
-            (jnp.int32(0), s0_k, lelem0,
-             done_ref[:] != 0, exited_ref[:] != 0, pending0, fl0),
-        )
+        init = (jnp.int32(0), s0_k, lelem0,
+                done_ref[:] != 0, exited_ref[:] != 0, pending0)
+        if tally:
+            fl0 = (table_v[:, 0] * jnp.asarray(0, table_v.dtype)).astype(
+                flux.dtype
+            )[None, :]
+            init = init + (fl0,)
+        out = lax.while_loop(cond, body, init)
+        it, s, lelem, done, exited, pending = out[:6]
         s_out[:] = s
         lelem_out[:] = lelem
         done_out[:] = done.astype(jnp.int8)
         exited_out[:] = exited.astype(jnp.int8)
         pending_out[:] = pending
         it_out[0] = it
-        flux_out[:] = fl
+        if tally:
+            flux_out[:] = out[6]
 
     tile = lambda: pl.BlockSpec((w_tile,), lambda t: (t,))  # noqa: E731
     tile3 = lambda: pl.BlockSpec((w_tile, 3), lambda t: (t, 0))  # noqa: E731
-    s_o, lelem_o, done_o, exited_o, pending_o, iters, fparts = pl.pallas_call(
-        kernel,
-        grid=(T,),
-        in_specs=[
-            pl.BlockSpec((L, TABLE_PAD_COLS), lambda t: (0, 0)),
-            tile3(), tile(), tile3(), tile(), tile(), tile(),
-        ],
-        out_specs=[
-            tile(), tile(), tile(), tile(), tile(),
-            pl.BlockSpec((1,), lambda t: (t,)),
-            pl.BlockSpec((1, L), lambda t: (t, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T * w_tile,), fdtype, vma=vma),
-            jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
-            jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
-            jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
-            jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
-            jax.ShapeDtypeStruct((T,), jnp.int32, vma=vma),
-            jax.ShapeDtypeStruct((T, L), flux.dtype, vma=vma),
-        ],
-        interpret=interpret,
-    )(table_p, x, lelem, dest, eff_w,
-      done.astype(jnp.int8), exited.astype(jnp.int8))
+    out_specs = [
+        tile(), tile(), tile(), tile(), tile(),
+        pl.BlockSpec((1,), lambda t: (t,)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T * w_tile,), fdtype, vma=vma),
+        jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
+        jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
+        jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((T,), jnp.int32, vma=vma),
+    ]
+    if tally:
+        out_specs.append(pl.BlockSpec((1, L), lambda t: (t, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((T, L), flux.dtype, vma=vma))
+    s_o, lelem_o, done_o, exited_o, pending_o, iters, *fparts = (
+        pl.pallas_call(
+            kernel,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((L, TABLE_PAD_COLS), lambda t: (0, 0)),
+                tile3(), tile(), tile3(), tile(), tile(), tile(),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(table_p, x, lelem, dest, eff_w,
+          done.astype(jnp.int8), exited.astype(jnp.int8))
+    )
 
     s_o, lelem_o = s_o[:n], lelem_o[:n]
     done_o = done_o[:n] != 0
@@ -276,7 +303,8 @@ def vmem_walk_local(
     pending_o = pending_o[:n]
     dest, d0 = dest[:n], d0[:n]
     x0 = dest - d0
-    flux = flux + jnp.sum(fparts, axis=0)
+    if tally:
+        flux = flux + jnp.sum(fparts[0], axis=0)
     # Same materialization rule as walk_local: reached-dest commits
     # dest bit-exactly; everyone else (boundary leavers AND paused
     # particles) commits x0 + s·d0.

@@ -1,0 +1,80 @@
+"""BASELINE config-2 scale proof (slow tier): the ~1M-tet assembly
+lattice builds, walks, and conserves track length — monolithic and
+partitioned over the 8-virtual-device mesh.
+
+Particle counts are small (CPU backend); the on-chip rate rows for
+this geometry come from tools/exp_r4_scale.py via the measurement
+suite. Element ids at this scale still fit f32 exactly (< 2^24), so
+the packed walk table is in play — the unpacked-fallback semantics
+are pinned separately by test_box_mesh.py's forced-fallback parity
+test.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu import PumiTally, TallyConfig
+from pumiumtally_tpu.mesh.pincell import build_lattice
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def lattice_1m():
+    mesh, mat, cell = build_lattice(
+        10, 10, n_theta=24, n_rings_fuel=4, n_rings_pad=4, nz=10
+    )
+    assert mesh.nelems > 1_000_000
+    return mesh
+
+
+def test_lattice_1m_geometry(lattice_1m):
+    mesh = lattice_1m
+    pitch, height = 1.26, 1.0
+    np.testing.assert_allclose(
+        float(np.asarray(mesh.volumes, np.float64).sum()),
+        10 * 10 * pitch * pitch * height, rtol=1e-9,
+    )
+
+
+def test_lattice_1m_walk_conservation(lattice_1m):
+    mesh = lattice_1m
+    n = 20_000
+    rng = np.random.default_rng(42)
+    box = np.array([10 * 1.26, 10 * 1.26, 1.0])
+    src = rng.uniform(0.02, 0.98, (n, 3)) * box
+    # Short steps: segment length bounds the crossing count per move.
+    dest = np.clip(src + rng.normal(scale=0.05, size=(n, 3)),
+                   0.01 * box, 0.99 * box)
+    t = PumiTally(mesh, n, TallyConfig(check_found_all=False))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dest.reshape(-1).copy())
+    got = float(np.float64(jnp.sum(t.flux)))
+    want = float(np.linalg.norm(dest - src, axis=1).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_lattice_1m_partitioned(lattice_1m):
+    from pumiumtally_tpu import PartitionedPumiTally
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = lattice_1m
+    n = 1_000
+    rng = np.random.default_rng(43)
+    box = np.array([10 * 1.26, 10 * 1.26, 1.0])
+    src = rng.uniform(0.05, 0.95, (n, 3)) * box
+    dest = np.clip(src + rng.normal(scale=0.05, size=(n, 3)),
+                   0.01 * box, 0.99 * box)
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
+                    check_found_all=False),
+    )
+    assert t.engine.part.L >= mesh.nelems // 8
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dest.reshape(-1).copy())
+    got = float(np.asarray(t.flux, np.float64).sum())
+    want = float(np.linalg.norm(dest - src, axis=1).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)

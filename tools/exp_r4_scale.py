@@ -1,0 +1,88 @@
+"""BASELINE config-2 on-chip scale rows: walk rate + memory headroom
+on the ~1M-tet assembly lattice (round-4 item 6).
+
+Measures, on whatever accelerator is attached:
+  - mesh build + precompute + upload wall time;
+  - continue-mode tallied move rate at N particles (the headline
+    metric's protocol) for a few segment lengths (crossings/move
+    scales with length — the rate story needs both);
+  - device memory in use after upload (walk table [E,20] f32 ~80 MB at
+    1M tets) via jax's memory stats when the backend exposes them;
+  - the same on the 48k-tet box for a same-run reference point.
+
+Usage:  python tools/exp_r4_scale.py [n_particles]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.mesh.pincell import build_lattice
+
+
+def mem_mb() -> str:
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if not stats:
+            return "n/a"
+        return f"{stats.get('bytes_in_use', 0) / 1e6:.0f} MB in use"
+    except Exception:  # noqa: BLE001 — diagnostic only
+        return "n/a"
+
+
+def drive(mesh, box, n, mean_step, moves=4, seed=0) -> float:
+    rng = np.random.default_rng(seed)
+    t = PumiTally(mesh, n, TallyConfig(check_found_all=False,
+                                       fenced_timing=False))
+    src = rng.uniform(0.05, 0.95, (n, 3)) * box
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    d = src
+    # warmup (compile)
+    d = np.clip(d + rng.normal(scale=mean_step / np.sqrt(3), size=d.shape),
+                0.02 * box, 0.98 * box)
+    t.MoveToNextLocation(None, d.reshape(-1).copy())
+    float(jnp.sum(t.flux))
+    t0 = time.perf_counter()
+    for _ in range(moves):
+        d = np.clip(d + rng.normal(scale=mean_step / np.sqrt(3),
+                                   size=d.shape),
+                    0.02 * box, 0.98 * box)
+        t.MoveToNextLocation(None, d.reshape(-1).copy())
+    float(jnp.sum(t.flux))
+    return n * moves / (time.perf_counter() - t0)
+
+
+def main(n: int) -> None:
+    print(f"backend={jax.default_backend()}  start mem: {mem_mb()}")
+
+    t0 = time.perf_counter()
+    mesh48 = build_box(1, 1, 1, 20, 20, 20, dtype=jnp.float32)
+    print(f"box 48k built in {time.perf_counter() - t0:.2f}s")
+    for step in (0.25, 0.05):
+        r = drive(mesh48, np.ones(3), n, step, seed=1)
+        print(f"box48k  step={step}: {r / 1e6:.2f}M moves/s  ({mem_mb()})")
+
+    t0 = time.perf_counter()
+    mesh1m, _, _ = build_lattice(10, 10, n_theta=24, n_rings_fuel=4,
+                                 n_rings_pad=4, nz=10, dtype=jnp.float32)
+    build_s = time.perf_counter() - t0
+    E = mesh1m.nelems
+    print(f"lattice {E} tets built+precomputed in {build_s:.2f}s; "
+          f"table ~{E * 20 * 4 / 1e6:.0f} MB f32  ({mem_mb()})")
+    box = np.array([10 * 1.26, 10 * 1.26, 1.0])
+    for step in (0.25, 0.05):
+        r = drive(mesh1m, box, n, step, seed=2)
+        print(f"lattice1M step={step}: {r / 1e6:.2f}M moves/s  ({mem_mb()})")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 500_000)
