@@ -13,6 +13,10 @@ reference selects its Kokkos backend at build time:
                                   replicated mode for `mono`/`streaming`)
     PUMIUMTALLY_CHUNK_SIZE        streaming chunk size (default 1e6)
     PUMIUMTALLY_CAPACITY_FACTOR   partitioned slot over-provisioning
+    PUMIUMTALLY_VMEM_MAX_ELEMS    partitioned engines: per-chip element
+                                  bound under which the local walk runs
+                                  as the VMEM one-hot MXU Pallas kernel
+                                  (TallyConfig.walk_vmem_max_elems)
     PUMIUMTALLY_TOLERANCE         walk tolerance override
     PUMIUMTALLY_OUTPUT            default VTK output path
     PUMIUMTALLY_LOCALIZATION      walk (default) | locate — see
@@ -67,6 +71,14 @@ def native_create(mesh_filename: str, num_particles: int):
     auto = env_flag("PUMIUMTALLY_AUTO_CONTINUE")
     if auto is not None:
         kwargs["auto_continue"] = auto
+    vmem = os.environ.get("PUMIUMTALLY_VMEM_MAX_ELEMS")
+    if vmem:
+        if engine not in ("partitioned", "streaming_partitioned"):
+            raise ValueError(
+                "PUMIUMTALLY_VMEM_MAX_ELEMS applies only to the "
+                f"partitioned engines, not PUMIUMTALLY_ENGINE={engine!r}"
+            )
+        kwargs["walk_vmem_max_elems"] = int(vmem)
     fenced = env_flag("PUMIUMTALLY_FENCED_TIMING")
     check = env_flag("PUMIUMTALLY_CHECK_FOUND_ALL")
     if fenced is not None:

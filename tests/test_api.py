@@ -129,6 +129,17 @@ def test_native_create_engine_selection(monkeypatch, tmp_path):
     assert type(t) is PartitionedPumiTally
     assert t.engine.ndev == 4
 
+    monkeypatch.setenv("PUMIUMTALLY_VMEM_MAX_ELEMS", "100000")
+    t = native_create(mesh_path, 50)
+    assert t.engine.use_vmem_walk  # env knob reaches the engine
+    # Engine-scoped knob: a non-partitioned engine must error loudly
+    # (same contract as PUMIUMTALLY_DEVICE_GROUPS), not ignore it.
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "mono")
+    with pytest.raises(ValueError, match="VMEM_MAX_ELEMS"):
+        native_create(mesh_path, 50)
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "partitioned")
+    monkeypatch.delenv("PUMIUMTALLY_VMEM_MAX_ELEMS")
+
     monkeypatch.setenv("PUMIUMTALLY_ENGINE", "streaming_partitioned")
     t = native_create(mesh_path, 50)
     assert type(t) is StreamingPartitionedTally

@@ -66,6 +66,12 @@ def main() -> None:
     section("VMEM one-hot/pallas prototype (win or kill per L)")
     show_matching(os.path.join(d, "vmem.log"),
                   [r"^L=", r"walk_gather", r"onehot", r"pallas", r"FAILED"])
+    section("PRODUCTION vmem walk (ops/vmem_walk.py): compile/parity/rates")
+    show_matching(os.path.join(d, "vmem_prod.log"),
+                  [r"COMPILE", r"PARITY", r"^L=", r"ENGINE", r"FAILED"])
+    section("scale rows (BASELINE config 2: ~1M-tet lattice)")
+    show_matching(os.path.join(d, "scale.log"),
+                  [r"box48k", r"lattice", r"built", r"backend"])
     section("API protocol A/B (two_phase / forced / continue)")
     show_matching(os.path.join(d, "api_ab.log"),
                   [r"moves/s", r"two_phase", r"continue", r"rate"])
