@@ -84,7 +84,10 @@ class PartitionedPumiTally(PumiTally):
         if not out.endswith(".pvtu"):
             return super().WriteTallyResults(filename)
         t0 = time.perf_counter()
-        owner = self.engine.part.owner
+        # part.owner is at PART granularity; with the VMEM sub-split a
+        # chip owns a contiguous run of blocks_per_chip parts — pieces
+        # stay one-per-CHIP (the reference's rank-aware layout).
+        owner = self.engine.part.owner // self.engine.blocks_per_chip
         write_pvtu(
             out,
             np.asarray(self.mesh.coords),

@@ -379,6 +379,7 @@ class StreamingPartitionedTally(StreamingTally):
         from pumiumtally_tpu.parallel.partition import (
             PartitionedEngine,
             build_partition,
+            derive_blocks_per_chip,
         )
 
         # Device groups: dp × part hybrid. The flat device list splits
@@ -407,10 +408,15 @@ class StreamingPartitionedTally(StreamingTally):
             Mesh(devs[g * per : (g + 1) * per], (ax,))
             for g in range(ngroups)
         ]
-        # The partition depends only on (mesh, ndev-per-group): build it
-        # once; every group shares the tables. Compiled programs bake
-        # the device mesh, so each group keeps its own jit cache.
-        part = build_partition(mesh, per)
+        # The partition depends only on (mesh, parts-per-group): build
+        # it once; every group shares the tables. Compiled programs bake
+        # the device mesh, so each group keeps its own jit cache. The
+        # VMEM sub-split (walk_vmem_max_elems) multiplies the part
+        # count so each BLOCK fits the bound; the engines derive their
+        # blocks_per_chip back from the part's shape.
+        part = build_partition(mesh, per * derive_blocks_per_chip(
+            mesh.nelems, per, self.config.walk_vmem_max_elems
+        ))
         caches = [dict() for _ in range(ngroups)]
         # Each engine is sized to its chunk's REAL particle count (a
         # padded slot would otherwise be a live particle piling onto

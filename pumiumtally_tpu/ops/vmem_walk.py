@@ -149,6 +149,7 @@ def vmem_walk_local(
     w_tile: int = W_TILE_DEFAULT,
     interpret: Optional[bool] = None,
     vma: Optional[frozenset] = None,
+    blocks: int = 1,
 ) -> Tuple[jnp.ndarray, ...]:
     """Drop-in for ``parallel.partition.walk_local`` (minus its cascade
     knobs): returns ``(x, lelem, done, exited, pending, flux, iters)``
@@ -157,6 +158,20 @@ def vmem_walk_local(
 
     Requires local adjacency ids representable in the float table
     (``adj_int is None`` partitions — always true at VMEM-scale L).
+
+    ``blocks``: sub-split mode. The table is ``blocks`` stacked
+    [L,cols] block tables ([blocks*L, cols] rows), the slot arrays are
+    grouped by block (``cap_b = S // blocks`` slots each, ``lelem``
+    block-local, flux [blocks*L]), and the pallas grid becomes
+    (blocks × tiles) — each grid step pins ONE block's [L,32] table in
+    VMEM. Cross-block exits pause exactly like cross-chip exits (the
+    partition's adjacency encodes every non-local neighbor as a
+    remote glid); the caller migrates between rounds at block
+    granularity. This is how a chip whose whole partition exceeds VMEM
+    still runs the one-hot kernel: L is the BLOCK size, not the chip's
+    element count. Requires ``S % blocks == 0`` and
+    ``cap_b % w_tile == 0`` (the engine rounds its per-block capacity
+    up to the tile size).
 
     ``vma``: the mesh axis names the outputs vary over when called
     inside ``shard_map`` with varying-axis checking on. Currently
@@ -172,31 +187,42 @@ def vmem_walk_local(
     if interpret is None:
         interpret = backend_needs_interpret()
     fdtype = x.dtype
-    L = table.shape[0]
+    blocks = int(blocks)
+    L = table.shape[0] // blocks
     n = x.shape[0]
     if n == 0:  # walk_local handles the empty batch; match it
         return (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
                 flux, jnp.asarray(0, jnp.int32))
-    w_tile = min(int(w_tile), max(n, 1))
-    pad = (-n) % w_tile
-
-    if pad:
-        def padv(a, fill):
-            return jnp.concatenate(
-                [a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)]
+    if blocks > 1:
+        # Sub-split layout is engine-arranged: no padding here, the
+        # slot grouping IS the block routing.
+        if n % blocks or (n // blocks) % w_tile:
+            raise ValueError(
+                f"blocked vmem walk needs slots divisible into "
+                f"blocks x k x w_tile, got S={n}, blocks={blocks}, "
+                f"w_tile={w_tile}"
             )
+        pad = 0
+    else:
+        w_tile = min(int(w_tile), max(n, 1))
+        pad = (-n) % w_tile
+        if pad:
+            def padv(a, fill):
+                return jnp.concatenate(
+                    [a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)]
+                )
 
-        x, dest = padv(x, 0.0), padv(dest, 0.0)
-        lelem = padv(lelem, 0)
-        flying = padv(flying, 0)
-        weight = padv(weight, 0.0)
-        done = padv(done, True)  # pad slots are inert
-        exited = padv(exited, False)
+            x, dest = padv(x, 0.0), padv(dest, 0.0)
+            lelem = padv(lelem, 0)
+            flying = padv(flying, 0)
+            weight = padv(weight, 0.0)
+            done = padv(done, True)  # pad slots are inert
+            exited = padv(exited, False)
 
     d0 = dest - x
     seg_len = jnp.linalg.norm(d0, axis=1)
     eff_w = jnp.where(flying.astype(bool), weight * seg_len, 0.0)
-    T = (n + pad) // w_tile
+    T = (n + pad) // w_tile // blocks  # tiles per block
     max_iters = int(max_iters)
     table_p = pad_table(table)
 
@@ -265,29 +291,37 @@ def vmem_walk_local(
         if tally:
             flux_out[:] = out[6]
 
-    tile = lambda: pl.BlockSpec((w_tile,), lambda t: (t,))  # noqa: E731
-    tile3 = lambda: pl.BlockSpec((w_tile, 3), lambda t: (t, 0))  # noqa: E731
+    # Uniform (blocks, tiles-per-block) grid: blocks=1 degenerates to
+    # the flat tiling. Each grid step (b, t) pins block b's [L,32]
+    # table in VMEM and walks tile t of that block's slot group.
+    S = T * w_tile * blocks
+    tile = lambda: pl.BlockSpec(  # noqa: E731
+        (w_tile,), lambda b, t: (b * T + t,))
+    tile3 = lambda: pl.BlockSpec(  # noqa: E731
+        (w_tile, 3), lambda b, t: (b * T + t, 0))
     out_specs = [
         tile(), tile(), tile(), tile(), tile(),
-        pl.BlockSpec((1,), lambda t: (t,)),
+        pl.BlockSpec((1,), lambda b, t: (b * T + t,)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((T * w_tile,), fdtype, vma=vma),
-        jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
-        jax.ShapeDtypeStruct((T * w_tile,), jnp.int8, vma=vma),
-        jax.ShapeDtypeStruct((T * w_tile,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((T,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((S,), fdtype, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int8, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int8, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((T * blocks,), jnp.int32, vma=vma),
     ]
     if tally:
-        out_specs.append(pl.BlockSpec((1, L), lambda t: (t, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((T, L), flux.dtype, vma=vma))
+        out_specs.append(pl.BlockSpec((1, L), lambda b, t: (b * T + t, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((T * blocks, L), flux.dtype, vma=vma)
+        )
     s_o, lelem_o, done_o, exited_o, pending_o, iters, *fparts = (
         pl.pallas_call(
             kernel,
-            grid=(T,),
+            grid=(blocks, T),
             in_specs=[
-                pl.BlockSpec((L, TABLE_PAD_COLS), lambda t: (0, 0)),
+                pl.BlockSpec((L, TABLE_PAD_COLS), lambda b, t: (b, 0)),
                 tile3(), tile(), tile3(), tile(), tile(), tile(),
             ],
             out_specs=out_specs,
@@ -304,7 +338,11 @@ def vmem_walk_local(
     dest, d0 = dest[:n], d0[:n]
     x0 = dest - d0
     if tally:
-        flux = flux + jnp.sum(fparts[0], axis=0)
+        # Per-(block, tile) partials reduce within the block, then lay
+        # out as the [blocks*L] padded flux.
+        flux = flux + fparts[0].reshape(blocks, T, L).sum(axis=1).reshape(
+            blocks * L
+        )
     # Same materialization rule as walk_local: reached-dest commits
     # dest bit-exactly; everyone else (boundary leavers AND paused
     # particles) commits x0 + s·d0.

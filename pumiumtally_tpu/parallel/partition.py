@@ -130,6 +130,20 @@ class MeshPartition:
         return flux_padded[self.glid_of_orig]
 
 
+def derive_blocks_per_chip(
+    nelems: int, ndev: int, vmem_walk_max_elems: Optional[int]
+) -> int:
+    """Blocks per chip for the VMEM sub-split: the smallest k whose
+    balanced ndev*k-way partition keeps every block within the VMEM
+    bound (RCB is balanced ±1, so ceil(E/nparts) bounds the padded
+    block length). 1 when the knob is unset."""
+    if vmem_walk_max_elems is None:
+        return 1
+    return max(
+        1, -(-int(nelems) // (int(ndev) * int(vmem_walk_max_elems)))
+    )
+
+
 def build_partition(
     mesh: TetMesh,
     ndev: int,
@@ -614,9 +628,15 @@ class PartitionedEngine:
 
         ``vmem_walk_max_elems`` (TallyConfig.walk_vmem_max_elems): use
         the VMEM one-hot MXU local walk (ops/vmem_walk.py) when the
-        per-chip element count fits the bound; oversized partitions
-        (or ones needing the int adjacency sidecar) keep the gather
-        walk silently — the knob is a ceiling, not a demand."""
+        per-chip element count fits the bound. A chip whose partition
+        EXCEEDS the bound is SUB-SPLIT instead: the mesh is partitioned
+        into ``ndev * blocks_per_chip`` blocks (``blocks_per_chip``
+        derived so each block fits), each chip owns a contiguous run of
+        blocks, and migration routes at BLOCK granularity — cross-block
+        moves inside one chip pause and re-bucket exactly like
+        cross-chip moves, minus the collectives. Only partitions
+        needing the int adjacency sidecar keep the gather walk
+        silently."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -625,13 +645,31 @@ class PartitionedEngine:
         # The full TetMesh is consumed here once and NOT retained: after
         # build_partition every engine path (localization included)
         # touches only per-chip sharded tables.
-        self.part = part if part is not None else build_partition(
-            mesh, self.ndev
-        )
-        self.cap_per_chip = int(
-            -(-self.n // self.ndev) * capacity_factor + 1
-        )
-        self.cap = self.ndev * self.cap_per_chip
+        if part is not None:
+            self.part = part
+            nparts = self.part.ndev  # build_partition's part count
+        else:
+            nparts = self.ndev * derive_blocks_per_chip(
+                mesh.nelems, self.ndev, vmem_walk_max_elems
+            )
+            self.part = build_partition(mesh, nparts)
+        if nparts % self.ndev:
+            raise ValueError(
+                f"partition has {nparts} parts, not a multiple of the "
+                f"{self.ndev}-device mesh"
+            )
+        self.nparts = nparts
+        self.blocks_per_chip = nparts // self.ndev
+        cap_b = int(-(-self.n // nparts) * capacity_factor + 1)
+        if self.blocks_per_chip > 1:
+            # The blocked vmem kernel tiles each block's slot group:
+            # round the per-block capacity up to whole tiles.
+            from pumiumtally_tpu.ops.vmem_walk import W_TILE_DEFAULT
+
+            cap_b = -(-cap_b // W_TILE_DEFAULT) * W_TILE_DEFAULT
+        self.cap_per_block = cap_b
+        self.cap_per_chip = self.blocks_per_chip * cap_b
+        self.cap = nparts * cap_b
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
@@ -642,8 +680,15 @@ class PartitionedEngine:
             and self.part.L <= int(vmem_walk_max_elems)
             and self.part.adj_int is None
         )
+        if self.blocks_per_chip > 1 and not self.use_vmem_walk:
+            raise ValueError(
+                "sub-split partitions (blocks_per_chip > 1) exist only "
+                "for the vmem walk; this mesh needs the int-adjacency "
+                "sidecar (or the block size exceeds the bound) — unset "
+                "walk_vmem_max_elems"
+            )
         dtype = mesh.coords.dtype
-        self.flux_padded = jnp.zeros((self.ndev * self.part.L,), dtype)
+        self.flux_padded = jnp.zeros((self.nparts * self.part.L,), dtype)
         # Initial layout: particle pid occupies slot pid (chips get
         # contiguous pid blocks); lelem/pending meaningless until the
         # first localization.
@@ -695,8 +740,11 @@ class PartitionedEngine:
             return self._jit_cache[key]
         pp = P(self.axis)
         ax = self.axis
-        L = self.part.L
-        sentinel = jnp.asarray(self.ndev * L, jnp.int32)
+        # A chip's table slice holds blocks_per_chip stacked blocks, so
+        # its local row index spans k*L rows and glids are offset by
+        # the chip's first block.
+        rows_per_chip = self.blocks_per_chip * self.part.L
+        sentinel = jnp.asarray(self.nparts * self.part.L, jnp.int32)
         tol = self.tol
         C = self._locate_chunk_size
 
@@ -713,7 +761,7 @@ class PartitionedEngine:
                 pts.reshape(-1, C, 3),
             ).reshape(-1)
             d = lax.axis_index(ax).astype(jnp.int32)
-            glid = jnp.where(le >= 0, d * L + le, sentinel)
+            glid = jnp.where(le >= 0, d * rows_per_chip + le, sentinel)
             # Lowest claiming glid wins (deterministic tie-break on
             # shared partition faces).
             return lax.pmin(glid, ax)
@@ -723,10 +771,11 @@ class PartitionedEngine:
 
     @property
     def _locate_chunk_size(self) -> int:
-        # Bound the [C, 4L] matmul intermediate to ~32M floats per chip
-        # (128 MB f32) so point location cannot OOM on meshes whose L
-        # reaches hundreds of thousands of elements.
-        cap = max(8, (1 << 23) // max(self.part.L, 1))
+        # Bound the [C, 4·rows] matmul intermediate to ~32M floats per
+        # chip (128 MB f32) so point location cannot OOM on meshes
+        # whose per-chip row count reaches hundreds of thousands.
+        rows = self.blocks_per_chip * self.part.L
+        cap = max(8, (1 << 23) // max(rows, 1))
         return min(2048, cap, self.n)
 
     def _locate_points(self, pts_n: jnp.ndarray) -> jnp.ndarray:
@@ -766,7 +815,7 @@ class PartitionedEngine:
         this path.
         """
         glid = self._locate_points(dest_n)
-        sentinel = self.ndev * self.part.L
+        sentinel = self.nparts * self.part.L
         found = glid < sentinel
         st = dict(self.state)
         st["x"] = self._by_pid(dest_n, jnp.zeros((), dest_n.dtype))
@@ -778,8 +827,8 @@ class PartitionedEngine:
         st["done"] = ~st["alive"]
         st["exited"] = jnp.zeros((self.cap,), bool)
         self.state, overflow = migrate(
-            part_L=self.part.L, ndev=self.ndev,
-            cap_per_chip=self.cap_per_chip, state=st,
+            part_L=self.part.L, ndev=self.nparts,
+            cap_per_chip=self.cap_per_block, state=st,
         )
         # Mark the phase finished for all particles.
         self.state["done"] = jnp.ones((self.cap,), bool)
@@ -836,12 +885,14 @@ class PartitionedEngine:
         # last, smaller chunk's capacity).
         key = ("phase", tally, self.cap_per_chip, self.max_rounds,
                self.max_iters, self.tol, self.cond_every, self.min_window,
-               self.use_vmem_walk, id(self.part))
+               self.use_vmem_walk, self.blocks_per_chip, id(self.part))
         if key in self._jit_cache:
             return self._jit_cache[key]
         pp = P(self.axis)
         ax = self.axis
-        part_L, ndev, cpc = self.part.L, self.ndev, self.cap_per_chip
+        part_L = self.part.L
+        nparts, cap_b = self.nparts, self.cap_per_block
+        blocks = self.blocks_per_chip
         tol, max_iters = self.tol, self.max_iters
         max_rounds = self.max_rounds
         cond_every = self.cond_every
@@ -862,6 +913,7 @@ class PartitionedEngine:
                 x, lelem, done, exited, pending, flux, _ = vmem_walk_local(
                     table, x, lelem, dest, fly, w, done, exited, flux,
                     tally=tally, tol=tol, max_iters=max_iters,
+                    blocks=blocks,
                 )
             else:
                 x, lelem, done, exited, pending, flux, _ = walk_local(
@@ -928,7 +980,7 @@ class PartitionedEngine:
 
             def body(c):
                 it, st, fx, n_p, n_nd, ovf = c
-                st2, ovf2 = _migrate_impl(part_L, ndev, cpc, st)
+                st2, ovf2 = _migrate_impl(part_L, nparts, cap_b, st)
                 # An overflowing migrate scatters colliding slots: do
                 # NOT walk (and tally) from that corrupted state — the
                 # loop cond exits on ovf and the host raises.
@@ -1039,7 +1091,7 @@ class PartitionedEngine:
         """Re-locate lost particles whose resampled origin lies inside
         the mesh; they rejoin transport from that origin."""
         glid = self._locate_points(origins_n)
-        sentinel = self.ndev * self.part.L
+        sentinel = self.nparts * self.part.L
         st = dict(self.state)
         pend = self._by_pid(jnp.where(glid < sentinel, glid, -1), -1)
         revive = st["lost"] & (pend >= 0)
@@ -1051,8 +1103,8 @@ class PartitionedEngine:
         st["pending"] = jnp.where(revive, pend, -1).astype(jnp.int32)
         st["lost"] = st["lost"] & ~revive
         self.state, overflow = migrate(
-            part_L=self.part.L, ndev=self.ndev,
-            cap_per_chip=self.cap_per_chip, state=st,
+            part_L=self.part.L, ndev=self.nparts,
+            cap_per_chip=self.cap_per_block, state=st,
         )
         self._check_overflow(overflow)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
@@ -1080,7 +1132,7 @@ class PartitionedEngine:
         o = self._order()
         glid = (
             (jnp.cumsum(jnp.ones_like(self.state["pid"])) - 1)
-            // self.cap_per_chip
+            // self.cap_per_block
         ) * self.part.L + self.state["lelem"]
         ids = np.asarray(self.part.orig_of_glid[glid[o]]).copy()
         ids[np.asarray(self.state["lost"][o])] = -1

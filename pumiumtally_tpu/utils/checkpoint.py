@@ -212,9 +212,12 @@ def _restore_partitioned_engine(eng, x, elem, flux, dtype) -> None:
     st["lost"] = jnp.asarray(lostf)
     from pumiumtally_tpu.parallel.partition import migrate
 
+    # Slot routing is at BLOCK granularity (nparts groups of
+    # cap_per_block) — sub-split engines (blocks_per_chip > 1) have
+    # more slot groups than chips.
     eng.state, overflow = migrate(
-        part_L=eng.part.L, ndev=eng.ndev,
-        cap_per_chip=eng.cap_per_chip, state=st,
+        part_L=eng.part.L, ndev=eng.nparts,
+        cap_per_chip=eng.cap_per_block, state=st,
     )
     eng._check_overflow(overflow)
     eng.state["done"] = jnp.ones((eng.cap,), bool)
@@ -223,7 +226,7 @@ def _restore_partitioned_engine(eng, x, elem, flux, dtype) -> None:
     eng._n_lost_cache = int(lost.sum())
     if flux is not None:
         # Owned flux layout: original order -> padded glid slots.
-        fpad = np.zeros((eng.ndev * eng.part.L,), np.float64)
+        fpad = np.zeros((eng.nparts * eng.part.L,), np.float64)
         fpad[glid_all] = flux
         eng.flux_padded = jnp.asarray(fpad, dtype=dtype)
     else:

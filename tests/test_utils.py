@@ -84,6 +84,13 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
             build_box(*mesh_args), n,
             TallyConfig(device_mesh=make_device_mesh(4), capacity_factor=4.0),
         ),
+        # Sub-split engine: restore must route slots and size flux at
+        # BLOCK granularity (nparts groups), not chip granularity.
+        "part_vmem_blocked": PartitionedPumiTally(
+            build_box(*mesh_args), n,
+            TallyConfig(device_mesh=make_device_mesh(4),
+                        capacity_factor=4.0, walk_vmem_max_elems=40),
+        ),
         "stream_part": StreamingPartitionedTally(
             build_box(*mesh_args), n, chunk_size=250,
             config=TallyConfig(
@@ -91,6 +98,7 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
             ),
         ),
     }
+    assert targets["part_vmem_blocked"].engine.blocks_per_chip > 1
     dst2 = np.clip(dst - 0.15, _LO, _HI)
     t.MoveToNextLocation(None, dst2.reshape(-1).copy())
     for name, t2 in targets.items():

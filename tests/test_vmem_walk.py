@@ -136,17 +136,119 @@ def test_partitioned_engine_with_vmem_walk_matches_default():
     np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
 
 
-def test_vmem_gate_rejects_oversized_partitions():
-    """The engine must fall back to the gather walk (not crash, not
-    silently mis-tally) when the per-chip element count exceeds the
-    knob."""
+def test_vmem_subsplit_blocks_match_default_engine(tmp_path):
+    """A chip whose partition exceeds walk_vmem_max_elems is sub-split
+    into VMEM-sized blocks (migration at block granularity, in-chip
+    cross-block moves pause and re-bucket); results match the
+    unblocked gather engine and conserve track length."""
     from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
     from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)  # 1296 tets
+    n = 600
+    rng = np.random.default_rng(11)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for knob in (None, 40):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=make_device_mesh(8),
+                        capacity_factor=8.0,
+                        walk_vmem_max_elems=knob),
+        )
+        if knob is None:
+            assert t.engine.blocks_per_chip == 1
+        else:
+            # ceil(1296 / (8*40)) = 5 blocks per chip, block size <= 40.
+            assert t.engine.blocks_per_chip == 5
+            assert t.engine.use_vmem_walk
+            assert t.engine.part.L <= 40
+            assert t.engine.nparts == 40
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        t.MoveToNextLocation(None, d2.reshape(-1).copy())
+        out.append((np.asarray(t.flux, np.float64), t.positions,
+                    t.elem_ids))
+        # Rank-aware output stays one piece per CHIP under the
+        # sub-split (part.owner is at BLOCK granularity — a raw
+        # pass-through once crashed the pvtu writer here).
+        pv = str(tmp_path / f"b{knob}.pvtu")
+        t.WriteTallyResults(pv)
+        import glob
+
+        assert len(glob.glob(str(tmp_path / f"b{knob}_p*.vtu"))) == 8
+    np.testing.assert_allclose(out[0][0], out[1][0],
+                               rtol=1e-10, atol=1e-13)
+    np.testing.assert_allclose(out[0][1], out[1][1],
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(out[0][2], out[1][2])
+    expect = (np.linalg.norm(d1 - src, axis=1)
+              + np.linalg.norm(d2 - d1, axis=1)).sum()
+    np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
+
+
+def test_vmem_subsplit_streaming_partitioned():
+    """The dp x part hybrid derives the same sub-split for its shared
+    partition; chunked + blocked still conserves."""
+    from pumiumtally_tpu import StreamingPartitionedTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)  # 384 tets
+    n = 400
+    rng = np.random.default_rng(12)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    t = StreamingPartitionedTally(
+        mesh, n, chunk_size=200,
+        config=TallyConfig(device_mesh=make_device_mesh(8),
+                           capacity_factor=8.0,
+                           walk_vmem_max_elems=20),  # 384/(8*20) -> k=3
+    )
+    for e in t.engines:
+        assert e.blocks_per_chip == 3 and e.use_vmem_walk
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, d1.reshape(-1).copy())
+    got = float(np.asarray(t.flux, np.float64).sum())
+    want = float(np.linalg.norm(d1 - src, axis=1).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_vmem_gate_oversized_subsplits_and_adj_sidecar_falls_back():
+    """An oversized partition SUB-SPLITS to fit the bound (the knob is
+    satisfied by blocking, not ignored); only the int-adjacency
+    sidecar keeps the gather walk — silently at blocks=1, loudly when
+    a sub-split would be required."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.parallel.partition import (
+        PartitionedEngine,
+        build_partition,
+    )
 
     mesh = build_box(1, 1, 1, 4, 4, 4)  # 384 tets over 8 chips: L=48
     t = PartitionedPumiTally(
         mesh, 100,
         TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
-                    walk_vmem_max_elems=10),  # below L
+                    walk_vmem_max_elems=10),  # below L -> sub-split
     )
-    assert t.engine.use_vmem_walk is False
+    assert t.engine.use_vmem_walk and t.engine.blocks_per_chip == 5
+    assert t.engine.part.L <= 10
+
+    dm = make_device_mesh(8)
+    # blocks=1 + int-adjacency sidecar: silent gather fallback.
+    e = PartitionedEngine(
+        mesh, dm, 100, capacity_factor=8.0, tol=1e-8, max_iters=4096,
+        part=build_partition(mesh, 8, force_split_adj=True),
+        vmem_walk_max_elems=10_000,
+    )
+    assert e.use_vmem_walk is False and e.blocks_per_chip == 1
+
+    # A sub-split that would need the sidecar cannot run at all: loud.
+    with pytest.raises(ValueError, match="sub-split"):
+        PartitionedEngine(
+            mesh, dm, 100, capacity_factor=8.0, tol=1e-8, max_iters=4096,
+            part=build_partition(mesh, 16, force_split_adj=True),
+            vmem_walk_max_elems=10_000,
+        )

@@ -108,38 +108,65 @@ def main(n: int) -> None:
         print(f"L={part.L}: " + "  ".join(f"{k}={v}"
                                           for k, v in rows.items()))
 
-    # -- 4. engine sanity on the chip --------------------------------------
-    try:
-        from jax.sharding import Mesh
+    # -- 4. THE headline experiment: single-chip 48k mesh, blocked vmem
+    # sub-split vs the monolithic gather walk (continue protocol) ---------
+    from jax.sharding import Mesh as DeviceMesh
 
-        from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        PumiTally,
+        TallyConfig,
+    )
 
-        dm = Mesh(np.array(jax.devices()[:1]), ("dp",))
-        mesh = build_box(1, 1, 1, 8, 8, 8, dtype=jnp.float32)
-        nn = min(n, 200_000)
-        t = PartitionedPumiTally(
-            mesh, nn,
-            TallyConfig(device_mesh=dm, capacity_factor=2.0,
-                        walk_vmem_max_elems=10_000,
-                        check_found_all=False, fenced_timing=False),
-        )
-        assert t.engine.use_vmem_walk
-        rng = np.random.default_rng(3)
-        src = rng.uniform(0.05, 0.95, (nn, 3))
+    mesh48 = build_box(1, 1, 1, 20, 20, 20, dtype=jnp.float32)  # 48k tets
+    nn = min(n, 500_000)
+    rng = np.random.default_rng(3)
+    src = rng.uniform(0.05, 0.95, (nn, 3))
+    moves = 4
+
+    def drive(t, seed):
+        r = np.random.default_rng(seed)
         t.CopyInitialPosition(src.reshape(-1).copy())
         d = src
+        d = np.clip(d + r.normal(scale=0.15, size=d.shape), 0.02, 0.98)
+        t.MoveToNextLocation(None, d.reshape(-1).copy())  # warmup/compile
+        float(np.asarray(jnp.sum(t.flux)))
         t0 = time.perf_counter()
-        moves = 4
         for _ in range(moves):
-            d = np.clip(d + rng.normal(scale=0.15, size=d.shape),
+            d = np.clip(d + r.normal(scale=0.15, size=d.shape),
                         0.02, 0.98)
             t.MoveToNextLocation(None, d.reshape(-1).copy())
         total = float(np.asarray(jnp.sum(t.flux)))
-        dt = time.perf_counter() - t0
-        print(f"ENGINE OK: {nn * moves / dt / 1e6:.2f}M moves/s "
-              f"(1 chip, L={t.engine.part.L}, sum flux {total:.2f})")
+        return nn * moves / (time.perf_counter() - t0), total
+
+    try:
+        t = PumiTally(mesh48, nn, TallyConfig(
+            check_found_all=False, fenced_timing=False))
+        rate, total = drive(t, 4)
+        print(f"ENGINE mono-gather: {rate / 1e6:.2f}M moves/s "
+              f"(sum flux {total:.2f})")
     except Exception as e:  # noqa: BLE001
-        print(f"ENGINE FAILED: {type(e).__name__}: {str(e)[:300]}")
+        print(f"ENGINE mono FAILED: {type(e).__name__}: {str(e)[:200]}")
+
+    dm = DeviceMesh(np.array(jax.devices()[:1]), ("dp",))
+    for bound in (512, 1024, 2048, 4096):
+        try:
+            t = PartitionedPumiTally(
+                mesh48, nn,
+                TallyConfig(device_mesh=dm, capacity_factor=2.0,
+                            walk_vmem_max_elems=bound,
+                            check_found_all=False, fenced_timing=False),
+            )
+            assert t.engine.use_vmem_walk
+            rate, total = drive(t, 4)
+            print(f"ENGINE vmem bound={bound} "
+                  f"(blocks={t.engine.blocks_per_chip}, "
+                  f"L={t.engine.part.L}): {rate / 1e6:.2f}M moves/s "
+                  f"(rounds={t.engine.last_walk_rounds}, "
+                  f"sum flux {total:.2f})")
+        except Exception as e:  # noqa: BLE001
+            print(f"ENGINE vmem bound={bound} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}")
 
 
 if __name__ == "__main__":
