@@ -192,6 +192,42 @@ def run_workload(n: int, moves: int, mode: str) -> dict:
     return timed_moves(t, pts, moves, drive)
 
 
+def run_vmem_blocked(n: int, moves: int) -> dict:
+    """Continue-mode rate of the single-chip VMEM sub-split engine on
+    the same box workload (ops/vmem_walk.py): the mesh splits into
+    VMEM-sized blocks (PUMIUMTALLY_BENCH_VMEM_BOUND, default 1024
+    elements) and the local walk runs as the one-hot MXU Pallas
+    kernel. Recorded alongside the headline so the driver captures an
+    on-chip number for the blocked path whenever it runs; best-effort
+    in main() — a Mosaic lowering failure must not cost the bench."""
+    import jax
+
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+    from jax.sharding import Mesh
+
+    bound = int(os.environ.get("PUMIUMTALLY_BENCH_VMEM_BOUND", 1024))
+    mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
+    dm = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(device_mesh=dm, capacity_factor=2.0,
+                    walk_vmem_max_elems=bound,
+                    check_found_all=False, fenced_timing=False),
+    )
+    rng = np.random.default_rng(3)
+    pts = make_trajectory(rng, n, moves + 1)
+    t.CopyInitialPosition(pts[0].reshape(-1).copy())
+
+    def drive(m: int) -> None:
+        t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+
+    res = timed_moves(t, pts, moves, drive)
+    res["blocks_per_chip"] = t.engine.blocks_per_chip
+    res["block_elems"] = t.engine.part.L
+    res["walk_rounds_last_move"] = t.engine.last_walk_rounds
+    return res
+
+
 def run_pincell(n: int, moves: int) -> dict:
     """Continue-mode rate on the pincell O-grid (~22k tets) — the
     BASELINE configs[0-1] geometry: anisotropic tets, curved fuel
@@ -312,6 +348,16 @@ def main() -> None:
     forced = run_workload(N, MOVES, "two_phase_forced")
     cont = run_workload(N, MOVES, "continue")
     pincell = run_pincell(N, 4)
+    blocked = None
+    if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
+        try:
+            blocked = run_vmem_blocked(N, MOVES)
+        except (Exception, SystemExit) as e:  # noqa: BLE001
+            # Best-effort EXTRA metric: neither a Mosaic failure nor
+            # this row's own conservation exit (check_conservation
+            # raises SystemExit) may cost the already-measured
+            # headline numbers.
+            print(f"# vmem-blocked workload failed: {e}", file=sys.stderr)
 
     vs_baseline = None
     cpu_rate = None
@@ -364,6 +410,12 @@ def main() -> None:
         "two_phase_forced_moves_per_sec": forced["moves_per_sec"],
         "continue_moves_per_sec": cont["moves_per_sec"],
         "pincell_moves_per_sec": pincell["moves_per_sec"],
+        "vmem_blocked": None if blocked is None else {
+            "moves_per_sec": blocked["moves_per_sec"],
+            "blocks_per_chip": blocked["blocks_per_chip"],
+            "block_elems": blocked["block_elems"],
+            "walk_rounds_last_move": blocked["walk_rounds_last_move"],
+        },
         "histories_per_sec": two["histories_per_sec"],
         "cpu_two_phase_moves_per_sec": cpu_rate,
         "conservation_rel_err": max(

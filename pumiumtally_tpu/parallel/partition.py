@@ -683,9 +683,11 @@ class PartitionedEngine:
         if self.blocks_per_chip > 1 and not self.use_vmem_walk:
             raise ValueError(
                 "sub-split partitions (blocks_per_chip > 1) exist only "
-                "for the vmem walk; this mesh needs the int-adjacency "
-                "sidecar (or the block size exceeds the bound) — unset "
-                "walk_vmem_max_elems"
+                "for the vmem walk, but this configuration cannot use "
+                "it (walk_vmem_max_elems unset/exceeded, or the mesh "
+                "needs the int-adjacency sidecar). Set a satisfiable "
+                "walk_vmem_max_elems, or pass a partition with one "
+                "part per device"
             )
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.nparts * self.part.L,), dtype)

@@ -116,3 +116,15 @@ def test_pincell_workload(bench):
     res = bench.run_pincell(2000, 2)
     assert res["moves_per_sec"] > 0
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+
+
+@pytest.mark.slow
+def test_vmem_blocked_workload(bench, monkeypatch):
+    """The blocked-vmem extra metric: conserves, reports its sub-split
+    shape (on this 6^3 mesh a bound of 100 forces >1 block)."""
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_VMEM_BOUND", "100")
+    res = bench.run_vmem_blocked(bench.N, bench.MOVES)
+    assert res["moves_per_sec"] > 0
+    assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+    assert res["blocks_per_chip"] > 1
+    assert res["block_elems"] <= 100

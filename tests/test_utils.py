@@ -84,13 +84,6 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
             build_box(*mesh_args), n,
             TallyConfig(device_mesh=make_device_mesh(4), capacity_factor=4.0),
         ),
-        # Sub-split engine: restore must route slots and size flux at
-        # BLOCK granularity (nparts groups), not chip granularity.
-        "part_vmem_blocked": PartitionedPumiTally(
-            build_box(*mesh_args), n,
-            TallyConfig(device_mesh=make_device_mesh(4),
-                        capacity_factor=4.0, walk_vmem_max_elems=40),
-        ),
         "stream_part": StreamingPartitionedTally(
             build_box(*mesh_args), n, chunk_size=250,
             config=TallyConfig(
@@ -98,7 +91,6 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
             ),
         ),
     }
-    assert targets["part_vmem_blocked"].engine.blocks_per_chip > 1
     dst2 = np.clip(dst - 0.15, _LO, _HI)
     t.MoveToNextLocation(None, dst2.reshape(-1).copy())
     for name, t2 in targets.items():
@@ -125,6 +117,45 @@ def test_checkpoint_cross_engine_roundtrip(tmp_path):
         np.asarray(t3.flux), np.asarray(targets["part"].flux), atol=1e-14
     )
     np.testing.assert_array_equal(t3.elem_ids, targets["part"].elem_ids)
+
+
+@pytest.mark.slow
+def test_checkpoint_restores_into_subsplit_engine(tmp_path):
+    """Restore must route slots and size flux at BLOCK granularity
+    (nparts groups of cap_per_block) — a chip-granular restore once
+    silently dropped particles / crashed on the flux size."""
+    from pumiumtally_tpu import PartitionedPumiTally
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    n = 600
+    mesh_args = (1, 1, 1, 4, 4, 4)
+    rng = np.random.default_rng(10)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), _LO, _HI)
+    t = PumiTally(build_box(*mesh_args), n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    ckpt = str(tmp_path / "b.npz")
+    save_tally_state(t, ckpt)
+
+    t2 = PartitionedPumiTally(
+        build_box(*mesh_args), n,
+        TallyConfig(device_mesh=make_device_mesh(4),
+                    capacity_factor=4.0, walk_vmem_max_elems=40),
+    )
+    assert t2.engine.blocks_per_chip > 1
+    load_tally_state(t2, ckpt)
+    np.testing.assert_allclose(
+        np.asarray(t2.flux), np.load(ckpt)["flux"], atol=1e-14
+    )
+    np.testing.assert_array_equal(t2.elem_ids, np.load(ckpt)["elem"][:n])
+    dst2 = np.clip(dst - 0.15, _LO, _HI)
+    t.MoveToNextLocation(None, dst2.reshape(-1).copy())
+    t2.MoveToNextLocation(None, dst2.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(t2.flux), np.asarray(t.flux), rtol=1e-11, atol=1e-12
+    )
+    np.testing.assert_array_equal(t2.elem_ids, t.elem_ids)
 
 
 def test_checkpoint_mismatch_raises(tmp_path):

@@ -100,10 +100,12 @@ def test_vmem_walk_local_tile_padding_invariance():
                                    rtol=1e-12, atol=1e-15)
 
 
-def test_partitioned_engine_with_vmem_walk_matches_default():
+def test_partitioned_engine_with_vmem_walk_conserves():
     """TallyConfig.walk_vmem_max_elems wires the kernel into the
-    partitioned engine; flux/positions agree with the gather engine to
-    f64 rounding and conservation holds."""
+    partitioned engine and the engine conserves track length exactly.
+    (Full flux/position parity against the gather engine — including
+    the sub-split — lives in the slow tier; the kernel-level parity
+    tests above stay fast.)"""
     from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
     from pumiumtally_tpu.parallel import make_device_mesh
 
@@ -112,30 +114,21 @@ def test_partitioned_engine_with_vmem_walk_matches_default():
     rng = np.random.default_rng(9)
     src = rng.uniform(0.05, 0.95, (n, 3))
     d1 = rng.uniform(0.05, 0.95, (n, 3))
-    d2 = rng.uniform(0.05, 0.95, (n, 3))
-    out = []
-    for knob in (None, 10_000):
-        t = PartitionedPumiTally(
-            mesh, n,
-            TallyConfig(device_mesh=make_device_mesh(8),
-                        capacity_factor=8.0,
-                        walk_vmem_max_elems=knob),
-        )
-        assert t.engine.use_vmem_walk is (knob is not None)
-        t.CopyInitialPosition(src.reshape(-1).copy())
-        t.MoveToNextLocation(None, d1.reshape(-1).copy())
-        t.MoveToNextLocation(None, d2.reshape(-1).copy())
-        out.append((np.asarray(t.flux, np.float64), t.positions))
-    np.testing.assert_allclose(out[0][0], out[1][0],
-                               rtol=1e-10, atol=1e-13)
-    np.testing.assert_allclose(out[0][1], out[1][1],
-                               rtol=1e-12, atol=1e-12)
-    # Conservation on the vmem engine: total flux == total track length.
-    expect = (np.linalg.norm(d1 - src, axis=1)
-              + np.linalg.norm(d2 - d1, axis=1)).sum()
-    np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
+                    walk_vmem_max_elems=10_000),
+    )
+    assert t.engine.use_vmem_walk
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, d1.reshape(-1).copy())
+    expect = np.linalg.norm(d1 - src, axis=1).sum()
+    np.testing.assert_allclose(
+        np.asarray(t.flux, np.float64).sum(), expect, rtol=1e-9
+    )
 
 
+@pytest.mark.slow
 def test_vmem_subsplit_blocks_match_default_engine(tmp_path):
     """A chip whose partition exceeds walk_vmem_max_elems is sub-split
     into VMEM-sized blocks (migration at block granularity, in-chip
@@ -189,6 +182,7 @@ def test_vmem_subsplit_blocks_match_default_engine(tmp_path):
     np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_vmem_subsplit_streaming_partitioned():
     """The dp x part hybrid derives the same sub-split for its shared
     partition; chunked + blocked still conserves."""
@@ -220,23 +214,25 @@ def test_vmem_gate_oversized_subsplits_and_adj_sidecar_falls_back():
     satisfied by blocking, not ignored); only the int-adjacency
     sidecar keeps the gather walk — silently at blocks=1, loudly when
     a sub-split would be required."""
-    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
     from pumiumtally_tpu.parallel import make_device_mesh
     from pumiumtally_tpu.parallel.partition import (
         PartitionedEngine,
         build_partition,
+        derive_blocks_per_chip,
     )
 
     mesh = build_box(1, 1, 1, 4, 4, 4)  # 384 tets over 8 chips: L=48
-    t = PartitionedPumiTally(
-        mesh, 100,
-        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=8.0,
-                    walk_vmem_max_elems=10),  # below L -> sub-split
-    )
-    assert t.engine.use_vmem_walk and t.engine.blocks_per_chip == 5
-    assert t.engine.part.L <= 10
-
     dm = make_device_mesh(8)
+
+    # Construction-only checks (the sub-split engine is DRIVEN by the
+    # slow-tier parity test; here just the gating/derivation).
+    assert derive_blocks_per_chip(384, 8, 10) == 5
+    e0 = PartitionedEngine(
+        mesh, dm, 100, capacity_factor=8.0, tol=1e-8, max_iters=4096,
+        vmem_walk_max_elems=10,
+    )
+    assert e0.use_vmem_walk and e0.blocks_per_chip == 5
+    assert e0.part.L <= 10 and e0.nparts == 40
     # blocks=1 + int-adjacency sidecar: silent gather fallback.
     e = PartitionedEngine(
         mesh, dm, 100, capacity_factor=8.0, tol=1e-8, max_iters=4096,

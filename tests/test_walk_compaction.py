@@ -13,7 +13,11 @@ import jax.numpy as jnp
 from pumiumtally_tpu import build_box
 from pumiumtally_tpu.ops.walk import walk
 
-N = 4000
+# Sized for coverage-per-second: the cascade properties are
+# size-independent, but each extra halving stage lengthens the unrolled
+# jit program (= compile time, the bulk of this file's cost). 2048 ->
+# windows 2048/1024/512/256: four stages, multi-stage coverage intact.
+N = 2048
 DIV = 6  # 1296 tets
 
 
