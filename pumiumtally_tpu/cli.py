@@ -63,13 +63,27 @@ def cmd_msh2osh(args) -> None:
 
 
 def cmd_describe(args) -> None:
-    coords, tets = _load(args.mesh)
+    mesh_path = args.mesh.rstrip("/")
+    tags = {}
+    if mesh_path.endswith(".osh"):
+        from pumiumtally_tpu.io.osh import read_osh
+
+        # One parse serves both the geometry lines and the tag listing
+        # (legacy directories return an empty tag dict, no error).
+        coords, tets, tags = read_osh(mesh_path, with_tags=True)
+    else:
+        coords, tets = _load(mesh_path)
     lo, hi = coords.min(axis=0), coords.max(axis=0)
     print(f"vertices : {coords.shape[0]}")
     print(f"tets     : {tets.shape[0]}")
     print(f"x range  : [{lo[0]:.6g}, {hi[0]:.6g}]")
     print(f"y range  : [{lo[1]:.6g}, {hi[1]:.6g}]")
     print(f"z range  : [{lo[2]:.6g}, {hi[2]:.6g}]")
+    for name, v in tags.items():
+        v = np.asarray(v)
+        kinds = np.unique(v).size if v.dtype.kind in "iu" else None
+        extra = f", {kinds} distinct" if kinds is not None else ""
+        print(f"tag      : {name} [{v.dtype}{extra}]")
 
 
 def cmd_scale(args) -> None:

@@ -81,7 +81,7 @@ from __future__ import annotations
 import os
 import struct
 import zlib
-from typing import BinaryIO, Dict, List, Optional, Tuple
+from typing import BinaryIO, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -287,8 +287,15 @@ def _read_tags(
             raise OshFormatError(
                 f"unknown tag data type {typ} for tag {name!r}"
             )
+        if ncomps < 1:
+            # Omega_h tags always have >= 1 component; a non-positive
+            # count would bypass the size validation below and hand a
+            # misaligned array to downstream consumers.
+            raise OshFormatError(
+                f"implausible component count {ncomps} for tag {name!r}"
+            )
         data = _read_array(f, typ, compressed, end)
-        if ncomps > 0 and data.size != nents * ncomps:
+        if data.size != nents * ncomps:
             raise OshFormatError(
                 f"tag {name!r}: {data.size} values for {nents} entities "
                 f"x {ncomps} comps"
@@ -692,13 +699,25 @@ def write_osh(
             )
 
 
-def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
+def read_osh(
+    path: str, with_tags: bool = False
+) -> Union[
+    Tuple[np.ndarray, np.ndarray],
+    Tuple[np.ndarray, np.ndarray, Dict[str, np.ndarray]],
+]:
     """Read an ``.osh`` directory → (coords[V,3] f64, tet2vert[E,4] i32).
 
     Accepts both genuine Omega_h directories (single- or multi-part;
     multi-part needs the ``global`` tags Omega_h writes on distributed
     meshes) and directories written by this package's round-1 legacy
     format (kept for back-compat with existing converted meshes).
+
+    ``with_tags=True`` additionally returns the per-ELEMENT tag arrays
+    (dimension-3 tags except the structural ``global``), aligned with
+    the returned element order — e.g. the ``class_id`` material
+    classification ``msh2osh`` meshes carry, ready for
+    ``utils.postprocess.label_totals``/``label_averages``. Legacy
+    round-1 directories have no tags ({}).
     """
     if not os.path.isdir(path):
         raise ValueError(
@@ -707,7 +726,8 @@ def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
         )
     legacy = os.path.join(path, "format")
     if os.path.exists(legacy):
-        return _read_legacy(path)
+        coords, tets = _read_legacy(path)
+        return (coords, tets, {}) if with_tags else (coords, tets)
     nparts_file = os.path.join(path, "nparts")
     nparts = 1
     if os.path.exists(nparts_file):
@@ -738,11 +758,24 @@ def read_osh(path: str) -> Tuple[np.ndarray, np.ndarray]:
                 ) from e
     if nparts == 1:
         p = parts[0]
+        if with_tags:
+            return p["coords"], p["tet2vert"], _elem_tags(p["tags"][3])
         return p["coords"], p["tet2vert"]
-    return _merge_parts(parts)
+    merged = _merge_parts(parts, with_tags=with_tags)
+    return merged
 
 
-def _merge_parts(parts: List[dict]) -> Tuple[np.ndarray, np.ndarray]:
+def _elem_tags(dim3_tags: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Element tags minus the structural ``global`` ids."""
+    return {k: v for k, v in dim3_tags.items() if k != "global"}
+
+
+def _merge_parts(
+    parts: List[dict], with_tags: bool = False
+) -> Union[
+    Tuple[np.ndarray, np.ndarray],
+    Tuple[np.ndarray, np.ndarray, Dict[str, np.ndarray]],
+]:
     """Merge multi-part streams through their ``global`` id tags."""
     for i, p in enumerate(parts):
         if "global" not in p["tags"][0] or "global" not in p["tags"][3]:
@@ -770,7 +803,21 @@ def _merge_parts(parts: List[dict]) -> Tuple[np.ndarray, np.ndarray]:
     uniq_e, efirst = np.unique(eg_all, return_index=True)
     if not np.array_equal(uniq_e, np.arange(uniq_e.size)):
         raise ValueError("multi-part .osh element globals are not dense")
-    return coords, tet_all[efirst].astype(np.int32)
+    out_tets = tet_all[efirst].astype(np.int32)
+    if not with_tags:
+        return coords, out_tets
+    # Element tags present on EVERY part merge through the same
+    # selection (dedup keeps the first part's copy of each element).
+    names = set(_elem_tags(parts[0]["tags"][3]))
+    for p in parts[1:]:
+        names &= set(_elem_tags(p["tags"][3]))
+    tags_out = {
+        name: np.concatenate(
+            [np.asarray(p["tags"][3][name]) for p in parts]
+        )[efirst]
+        for name in sorted(names)
+    }
+    return coords, out_tets, tags_out
 
 
 # ---------------------------------------------------------------------------

@@ -355,6 +355,40 @@ def test_osh_elem_tag_validation(tmp_path):
     np.testing.assert_array_equal(tags["mat"], np.arange(ne))
 
 
+def test_osh_elem_tags_read_back(tmp_path):
+    """read_osh(with_tags=True): per-element tags survive the round
+    trip in the returned ELEMENT order — single part, multi-part
+    (merged through globals), and the C++-written fixture's msh2osh
+    tag set."""
+    from pumiumtally_tpu.io.osh import read_osh, write_osh
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    ne = len(tets)
+    mat = (np.arange(ne, dtype=np.int32) % 3) + 1
+    dens = np.linspace(0.5, 2.0, ne)
+    for nparts in (1, 3):
+        p = str(tmp_path / f"t{nparts}.osh")
+        write_osh(p, coords, tets, nparts=nparts,
+                  elem_tags={"mat": mat, "density": dens})
+        c2, t2, tags = read_osh(p, with_tags=True)
+        # Identify each returned element by its vertex set and check
+        # its tag rode along (multi-part merge may reorder elements).
+        key = {tuple(sorted(t)): i for i, t in enumerate(tets.tolist())}
+        back = np.array([key[tuple(sorted(t))] for t in t2.tolist()])
+        np.testing.assert_array_equal(tags["mat"], mat[back])
+        np.testing.assert_allclose(tags["density"], dens[back],
+                                   rtol=1e-15)
+    # Plain read is unchanged.
+    assert len(read_osh(str(tmp_path / "t1.osh"))) == 2
+    # The C++ transcription fixture carries class_id/class_dim.
+    _, _, ftags = read_osh(
+        os.path.join(_FIX, "cube_omega_cpp.osh"), with_tags=True
+    )
+    np.testing.assert_array_equal(ftags["class_id"], np.ones(6, np.int32))
+    np.testing.assert_array_equal(ftags["class_dim"],
+                                  np.full(6, 3, np.int8))
+
+
 def test_pvtu_pieces_round_trip(tmp_path):
     """write_pvtu: per-owner pieces cover every element exactly once;
     piece cell data concatenated in owner order equals the original."""
