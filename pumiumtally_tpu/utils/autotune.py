@@ -51,6 +51,14 @@ DEFAULT_CANDIDATES: Tuple[dict, ...] = (
     {"walk_perm_mode": "indirect", "walk_cond_every": 4,
      "walk_window_factor": 4},
     {"walk_perm_mode": "arrays", "walk_cond_every": 4},
+    # Corners the CPU-tuned set above does not reach, in case the
+    # on-chip optimum sits outside it: a coarse cascade with a large
+    # unroll (fewest while-loop conds AND fewest stage boundaries),
+    # and no cascade at all (pure lock-step — wins if compaction's
+    # permutes cost more than the lock-step waste on this backend).
+    {"walk_perm_mode": "packed", "walk_cond_every": 8,
+     "walk_window_factor": 8},
+    {"walk_cond_every": 4, "walk_min_window": 1 << 30},
 )
 
 

@@ -636,13 +636,22 @@ def test_cli_autotune_verb(tmp_path, capsys):
     assert text.count("->") >= len(DEFAULT_CANDIDATES)
 
 
-def test_osh_truncation_fuzz():
+@pytest.mark.parametrize("fixture,with_version_file", [
+    # Big-endian stream carrying its own version, no version file.
+    ("cube_omega1.osh", False),
+    # The C++ transcription's upstream-protocol framing: little-endian,
+    # version only in the directory file (compressed + raw variants) —
+    # the variant auto-detection must stay fuzz-clean on ALL framings.
+    ("cube_omega_cpp.osh", True),
+    ("cube_omega_cpp_raw.osh", True),
+])
+def test_osh_truncation_fuzz(fixture, with_version_file):
     """Every truncation of a valid stream must fail with a clean
     ValueError/OshFormatError — never a crash, hang, or silent
     success (the reader is fed real user files)."""
     from pumiumtally_tpu.io.osh import read_osh
 
-    src = os.path.join(_FIX, "cube_omega1.osh", "0.osh")
+    src = os.path.join(_FIX, fixture, "0.osh")
     with open(src, "rb") as f:
         data = f.read()
     import tempfile
@@ -654,12 +663,15 @@ def test_osh_truncation_fuzz():
         os.makedirs(d)
         with open(os.path.join(d, "nparts"), "w") as f:
             f.write("1\n")
+        if with_version_file:
+            with open(os.path.join(d, "version"), "w") as f:
+                f.write("9\n")
         for cut in cuts:
             with open(os.path.join(d, "0.osh"), "wb") as f:
                 f.write(data[:cut])
             with pytest.raises(ValueError):
                 read_osh(d)
-        # and byte corruption in the zlib payloads
+        # and byte corruption in the payloads
         for _ in range(10):
             b = bytearray(data)
             pos = int(rng.integers(60, len(data)))
@@ -673,6 +685,8 @@ def test_osh_truncation_fuzz():
                 assert coords.shape[1] == 3 and tets.shape[1] == 4
             except ValueError:
                 pass  # the expected outcome
+        if fixture != "cube_omega1.osh":
+            return  # the crafted-bomb tail below is framing-specific
         # crafted inflate bomb: small declared count, huge payload —
         # a minimal self-contained stream (no fixture-layout coupling)
         import struct

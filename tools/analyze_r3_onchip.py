@@ -1,14 +1,14 @@
 """Summarize the r3 on-chip suite logs into a PERF_NOTES-ready digest.
 
 The detached recovery loop (/tmp/r3_probe_loop.sh) runs the suite once
-when the TPU tunnel answers and mirrors logs into tools/r3_onchip/.
+when the TPU tunnel answers and mirrors logs into tools/r4_onchip/.
 This script condenses them: cascade sweep table, VMEM-prototype
 win/kill per mesh size, protocol A/B rates, locate A/B, the native
 bench_host row, and the final bench JSON — so whoever picks up the
 logs (this session, the round driver's auto-commit, or the next
 session) gets the numbers without re-reading raw logs.
 
-Usage: python tools/analyze_r3_onchip.py [logdir]   (default: tools/r3_onchip)
+Usage: python tools/analyze_r3_onchip.py [logdir]   (default: tools/r4_onchip)
 """
 
 from __future__ import annotations
@@ -49,14 +49,14 @@ def show_matching(path: str, patterns, max_lines=40) -> bool:
 def main() -> None:
     d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "r3_onchip" if os.path.basename(os.getcwd()) == "tools"
-        else "tools/r3_onchip",
+        "r4_onchip" if os.path.basename(os.getcwd()) == "tools"
+        else "tools/r4_onchip",
     )
     status = os.path.join(d, "status")
     if not os.path.exists(status):
         print(f"no suite run found under {d!r} (status file missing)")
         return
-    print("# r3 on-chip suite digest")
+    print("# on-chip suite digest")
     with open(status) as f:
         print(f.read().strip())
 
@@ -101,6 +101,7 @@ def main() -> None:
                               "two_phase_moves_per_sec",
                               "continue_moves_per_sec",
                               "autotuned_knobs", "link_mb_per_sec",
+                              "vmem_blocked",
                               "conservation_rel_err"):
                         if k in j:
                             print(f"  {k}: {j[k]}")
