@@ -54,7 +54,7 @@ def sample_step(rng, pos):
     return np.clip(d, 0.01, 0.99), rng.uniform(0.5, 1.5, pos.shape[0])
 
 
-def make_tally(mode: str, mesh):
+def make_tally(mode: str, mesh, vmem_bound=None):
     if mode == "stream":
         return StreamingTally(mesh, N, chunk_size=8192)
     if mode == "part":
@@ -62,7 +62,8 @@ def make_tally(mode: str, mesh):
 
         return PartitionedPumiTally(
             mesh, N,
-            TallyConfig(device_mesh=make_device_mesh(), capacity_factor=4.0),
+            TallyConfig(device_mesh=make_device_mesh(), capacity_factor=4.0,
+                        walk_vmem_max_elems=vmem_bound),
         )
     return PumiTally(mesh, N)
 
@@ -75,10 +76,15 @@ def main():
                     default="fast",
                     help="reference = origins passed every move (the "
                          "host-side echo is deduped automatically)")
+    ap.add_argument("--vmem-bound", type=int, default=None,
+                    help="part mode: per-chip element bound for the "
+                         "VMEM one-hot walk (oversized partitions "
+                         "sub-split into blocks; see "
+                         "TallyConfig.walk_vmem_max_elems)")
     args = ap.parse_args()
 
     mesh = build_box(1.0, 1.0, 1.0, 8, 8, 8)  # stand-in for mesh.osh
-    tally = make_tally(args.mode, mesh)
+    tally = make_tally(args.mode, mesh, vmem_bound=args.vmem_bound)
     rng = np.random.default_rng(0)
 
     total_expected = 0.0

@@ -209,6 +209,53 @@ def test_vmem_subsplit_streaming_partitioned():
     np.testing.assert_allclose(got, want, rtol=1e-9)
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [105, 206, 307])
+def test_vmem_walk_local_parity_seed_sweep(seed):
+    """Same contract as the fast parity test, across more random
+    workloads (different pause/exit/hold mixtures and mesh sizes)."""
+    args = _chip_workload(seed=seed, n=500, ndev=3 + seed % 3,
+                          divs=3 + seed % 3)
+    ref = walk_local(*args, tally=True, tol=1e-8, max_iters=4096)
+    out = vmem_walk_local(*args, tally=True, tol=1e-8, max_iters=4096,
+                          w_tile=128, interpret=True)
+    for i in (1, 2, 3, 4):  # lelem, done, exited, pending
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(ref[i]), err_msg=str(i))
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out[5]), np.asarray(ref[5]),
+                               rtol=1e-10, atol=1e-13)
+
+
+@pytest.mark.slow
+def test_vmem_subsplit_overflow_raises_not_corrupts():
+    """Flooding one block past its slot capacity must raise the
+    documented overflow error (block-granular capacity check), never
+    scatter-collide silently."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.parallel.partition import OVERFLOW_MESSAGE
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)  # 1296 tets, 40 blocks at bound 40
+    n = 4000  # cap_per_block rounds to 256 < n: one block cannot hold all
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=1.01,
+                    walk_vmem_max_elems=40),
+    )
+    assert t.engine.blocks_per_chip > 1
+    assert t.engine.cap_per_block < n
+    rng = np.random.default_rng(13)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    # Every particle heads into one corner element's neighborhood: the
+    # owning block must overflow during migration.
+    corner = np.tile([0.02, 0.02, 0.02], (n, 1))
+    with pytest.raises(RuntimeError, match=OVERFLOW_MESSAGE[:30]):
+        t.MoveToNextLocation(None, corner.reshape(-1).copy())
+
+
 def test_vmem_gate_oversized_subsplits_and_adj_sidecar_falls_back():
     """An oversized partition SUB-SPLITS to fit the bound (the knob is
     satisfied by blocking, not ignored); only the int-adjacency

@@ -47,10 +47,10 @@ def show_matching(path: str, patterns, max_lines=40) -> bool:
 
 
 def main() -> None:
+    # Anchored to this file, so the default works from any cwd.
     d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "r4_onchip" if os.path.basename(os.getcwd()) == "tools"
-        else "tools/r4_onchip",
+        "tools", "r4_onchip",
     )
     status = os.path.join(d, "status")
     if not os.path.exists(status):
