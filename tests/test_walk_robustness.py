@@ -91,3 +91,52 @@ def test_corner_to_corner_diagonal():
     np.testing.assert_allclose(t.positions, dests, atol=1e-6)
     total = float(np.asarray(t.flux).sum())
     np.testing.assert_allclose(total, 2 * np.sqrt(3.0), rtol=1e-7)
+
+
+def test_overlay_tally_mesh_smaller_than_domain():
+    """Overlay-tally usage (the BASELINE DAGMC-config shape, minus the
+    CAD host): the tally mesh covers only part of the transport
+    domain, and the host hands surface-to-surface track legs the way
+    event-based transport does. Legs ENTERING on a face and leaving
+    beyond the far side must tally exactly the in-mesh chord (the
+    vacuum clamp commits the exit point); successive legs chain
+    through the hull without losing particles."""
+    rng = np.random.default_rng(17)
+    n = 200
+    # Entry points on the -x face (surface-crossing leg origins), flight
+    # directions with a positive x component, dests beyond the +x face.
+    entry = np.column_stack([
+        np.zeros(n), rng.uniform(0.05, 0.95, n), rng.uniform(0.05, 0.95, n)
+    ])
+    dirs = np.column_stack([
+        rng.uniform(0.5, 1.0, n), rng.uniform(-0.3, 0.3, n),
+        rng.uniform(-0.3, 0.3, n),
+    ])
+    dirs /= np.linalg.norm(dirs, axis=1)[:, None]
+    dests = entry + 3.0 * dirs  # far outside the unit tally box
+
+    t = _drive(entry, dests, div=4)
+    # Each particle's contribution = its chord through the unit box.
+    lo = np.zeros(3)
+    hi = np.ones(3)
+    with np.errstate(divide="ignore"):
+        t_lo = (lo - entry) / dirs
+        t_hi = (hi - entry) / dirs
+    t_exit = np.maximum(t_lo, t_hi).min(axis=1)
+    chord = np.minimum(t_exit, 3.0)
+    total = float(np.asarray(t.flux).sum())
+    np.testing.assert_allclose(total, chord.sum(), rtol=1e-9)
+    # Exit commits ON the hull (the clamp), never outside.
+    assert (t.positions <= 1.0 + 1e-9).all()
+    assert (t.positions >= -1e-9).all()
+    # The NEXT leg re-enters from a resampled surface point (a fresh
+    # batch in the host's loop): localization + transport keep working
+    # from the clamped state without CopyInitialPosition.
+    entry2 = np.column_stack([
+        rng.uniform(0.05, 0.95, n), np.zeros(n), rng.uniform(0.05, 0.95, n)
+    ])
+    dest2 = entry2 + np.array([0.0, 0.4, 0.0])
+    t.MoveToNextLocation(entry2.reshape(-1).copy(), dest2.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    total2 = float(np.asarray(t.flux).sum())
+    np.testing.assert_allclose(total2, chord.sum() + n * 0.4, rtol=1e-9)
