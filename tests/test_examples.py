@@ -37,16 +37,17 @@ def _run_example(name: str, tmp_path, args=(), timeout=420):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode,protocol", [
-    ("mono", "fast"),
-    ("mono", "reference"),  # origins passed every move (echo-dedup path)
-    ("stream", "fast"),
-    ("part", "fast"),
+@pytest.mark.parametrize("mode,protocol,extra", [
+    ("mono", "fast", []),
+    ("mono", "reference", []),  # origins every move (echo-dedup path)
+    ("stream", "fast", []),
+    ("part", "fast", []),
+    ("part", "fast", ["--vmem-bound", "200"]),  # blocked vmem local walk
 ])
-def test_openmc_style_driver_runs(tmp_path, mode, protocol):
+def test_openmc_style_driver_runs(tmp_path, mode, protocol, extra):
     proc = _run_example(
         "openmc_style_driver.py", tmp_path,
-        args=["--mode", mode, "--protocol", protocol],
+        args=["--mode", mode, "--protocol", protocol, *extra],
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out_files = os.listdir(tmp_path)
