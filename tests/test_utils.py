@@ -1,6 +1,5 @@
 """Tests for aux subsystems: checkpoint round-trip, logging, timers."""
 
-import logging
 
 import numpy as np
 import pytest

@@ -7,7 +7,6 @@ particle (elem = -1), or tally a wrong total length.
 """
 
 import numpy as np
-import jax.numpy as jnp
 
 from pumiumtally_tpu import PumiTally, TallyConfig, build_box
 
