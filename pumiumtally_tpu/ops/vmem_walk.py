@@ -63,6 +63,17 @@ _O0 = WALK_TABLE_OFFSETS.start
 _A0 = WALK_TABLE_ADJ.start
 TABLE_PAD_COLS = 32
 W_TILE_DEFAULT = 256
+# Mosaic block-shape law (jax pallas/mosaic/lowering.py
+# _check_block_mappings): a rank-1 block must equal the whole array or
+# be a multiple of 128*(32/bitwidth) lanes; a rank-2 block's minor dim
+# must be a 128-multiple (or whole) and its second-minor an 8-multiple
+# (or whole). Every ref this kernel touches is therefore f32/int32 in
+# 128-multiple tiles — int8/bool would demand 512-wide rank-1 blocks.
+LANE = 128
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
 
 
 def pad_table(table: jnp.ndarray) -> jnp.ndarray:
@@ -193,6 +204,12 @@ def vmem_walk_local(
     if n == 0:  # walk_local handles the empty batch; match it
         return (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
                 flux, jnp.asarray(0, jnp.int32))
+    # Mosaic-legal tile width: rank-1 f32/int32 blocks must be LANE
+    # multiples (see block-shape law above). Rounding up (not clamping
+    # to n) keeps every layout the hardware path accepts; interpret
+    # mode uses the identical layout so CPU parity tests exercise
+    # exactly what lowers.
+    w_tile = _round_up(max(int(w_tile), 1), LANE)
     if blocks > 1:
         # Sub-split layout is engine-arranged: no padding here, the
         # slot grouping IS the block routing.
@@ -204,7 +221,6 @@ def vmem_walk_local(
             )
         pad = 0
     else:
-        w_tile = min(int(w_tile), max(n, 1))
         pad = (-n) % w_tile
         if pad:
             def padv(a, fill):
@@ -224,7 +240,19 @@ def vmem_walk_local(
     eff_w = jnp.where(flying.astype(bool), weight * seg_len, 0.0)
     T = (n + pad) // w_tile // blocks  # tiles per block
     max_iters = int(max_iters)
+    # Pad each block's table to Lp rows (LANE multiple): the [Lp,32]
+    # input block and the [Lp] flux output block are then Mosaic-legal
+    # for ANY mesh size, and Lp is the MXU-friendly contraction dim.
+    # lelem < L always, so padded rows are never selected by the
+    # one-hot and contribute nothing.
+    Lp = _round_up(L, LANE)
     table_p = pad_table(table)
+    if Lp != L:
+        cols = table_p.shape[1]
+        table_p = jnp.concatenate(
+            [table_p.reshape(blocks, L, cols),
+             jnp.zeros((blocks, Lp - L, cols), table_p.dtype)], axis=1
+        ).reshape(blocks * Lp, cols)
 
     def kernel(table_ref, x_ref, lelem_ref, dest_ref, effw_ref, done_ref,
                exited_ref, s_out, lelem_out, done_out, exited_out,
@@ -236,7 +264,7 @@ def vmem_walk_local(
         d0_c = dest_c - x0
         effw_c = effw_ref[:]
         one_k = jnp.asarray(1.0, x0.dtype)
-        iota = lax.broadcasted_iota(jnp.int32, (w_tile, L), 1)
+        iota = lax.broadcasted_iota(jnp.int32, (w_tile, Lp), 1)
         if vma:
             # Under shard_map's varying-axis checking, primitive
             # outputs computed from no input (the iota) stay
@@ -244,11 +272,46 @@ def vmem_walk_local(
             # data — promote explicitly.
             iota = lax.pvary(iota, tuple(vma))
 
+        # flux and iters live in per-BLOCK output blocks revisited by
+        # every tile t of the block (index_map ignores t): zero them on
+        # the block's first tile, then reduce in VMEM across tiles —
+        # the standard Pallas revisited-block reduction. This replaces
+        # per-(block, tile) partials, whose (1, L) block shape the
+        # Mosaic law forbids.
+        t_id = pl.program_id(1)
+
+        @pl.when(t_id == 0)
+        def _init():
+            it_out[:] = jnp.zeros_like(it_out)
+            if tally:
+                flux_out[:] = jnp.zeros_like(flux_out)
+
+        # Loop state lives in the per-tile OUTPUT refs, mutated in
+        # place each iteration; the while carry is two scalars. Mosaic
+        # cannot legalize big functional while carries — the round-4
+        # on-chip log (tools/r4_onchip/bench.log) shows `scf.yield`
+        # failing with the flux vector unrolled into hundreds of vregs
+        # — so ref mutation is not a style choice here, it is what
+        # lowers. The active count rides the carry (computed by the
+        # previous body pass) so `cond` stays a pure function of the
+        # carry. Ref seeds are derived from kernel INPUTS, not literal
+        # constants (x*0 instead of zeros_like): under shard_map a
+        # literal is "unvarying" while the ref data varies over the
+        # partition axis — same hazard walk_local documents; do not
+        # "simplify" these.
+        s_out[:] = x0[:, 0] * jnp.asarray(0, x0.dtype)
+        lelem_out[:] = lelem_ref[:]
+        done_out[:] = done_ref[:]
+        exited_out[:] = exited_ref[:]
+        pending_out[:] = (lelem_ref[:] - lelem_ref[:]) - 1
+
         def body(carry):
-            # The flux partial rides the carry only when tallying — a
-            # no-tally walk (localization, phase A) then carries,
-            # writes and reduces nothing provably zero.
-            it, s, lelem, done, exited, pending, *fl = carry
+            it, _n_active = carry
+            s = s_out[:]
+            lelem = lelem_out[:]
+            done = done_out[:] != 0
+            exited = exited_out[:] != 0
+            pending = pending_out[:]
             oh = (lelem[:, None] == iota).astype(table_v.dtype)
             row = jnp.dot(oh, table_v,
                           preferred_element_type=table_v.dtype)
@@ -256,43 +319,33 @@ def vmem_walk_local(
                 row, s, lelem, done, exited, pending, dest_c, d0_c,
                 effw_c, tol, one_k, tally,
             )
+            s_out[:] = s
+            lelem_out[:] = lelem
+            done_out[:] = done.astype(jnp.int32)
+            exited_out[:] = exited.astype(jnp.int32)
+            pending_out[:] = pending
             if tally:
-                fl = [fl[0] + jnp.dot(contrib[None, :], oh,
-                                      preferred_element_type=fl[0].dtype)]
-            return (it + jnp.int32(1), s, lelem, done, exited, pending,
-                    *fl)
+                # A no-tally walk (localization, phase A) accumulates
+                # nothing provably zero.
+                flux_out[:] = flux_out[:] + jnp.dot(
+                    contrib[None, :], oh,
+                    preferred_element_type=flux_out.dtype,
+                )[0]
+            n_active = jnp.sum(
+                ((~done) & (pending < 0)).astype(jnp.int32)
+            )
+            return it + jnp.int32(1), n_active
 
         def cond(carry):
-            it, _s, _le, done, _ex, pending = carry[:6]
-            return (it < max_iters) & jnp.any((~done) & (pending < 0))
+            it, n_active = carry
+            return (it < max_iters) & (n_active > 0)
 
-        # Initial carries derived from kernel INPUTS, not literal
-        # constants: under shard_map a literal is "unvarying" while the
-        # loop outputs vary over the partition axis, which breaks the
-        # while_loop carry typing (same hazard walk_local documents).
-        lelem0 = lelem_ref[:]
-        s0_k = x0[:, 0] * jnp.asarray(0, x0.dtype)
-        pending0 = (lelem0 - lelem0) - 1
-        init = (jnp.int32(0), s0_k, lelem0,
-                done_ref[:] != 0, exited_ref[:] != 0, pending0)
-        if tally:
-            fl0 = (table_v[:, 0] * jnp.asarray(0, table_v.dtype)).astype(
-                flux.dtype
-            )[None, :]
-            init = init + (fl0,)
-        out = lax.while_loop(cond, body, init)
-        it, s, lelem, done, exited, pending = out[:6]
-        s_out[:] = s
-        lelem_out[:] = lelem
-        done_out[:] = done.astype(jnp.int8)
-        exited_out[:] = exited.astype(jnp.int8)
-        pending_out[:] = pending
-        it_out[0] = it
-        if tally:
-            flux_out[:] = out[6]
+        n0 = jnp.sum((done_ref[:] == 0).astype(jnp.int32))
+        it, _ = lax.while_loop(cond, body, (jnp.int32(0), n0))
+        it_out[:] = jnp.maximum(it_out[:], it)
 
     # Uniform (blocks, tiles-per-block) grid: blocks=1 degenerates to
-    # the flat tiling. Each grid step (b, t) pins block b's [L,32]
+    # the flat tiling. Each grid step (b, t) pins block b's [Lp,32]
     # table in VMEM and walks tile t of that block's slot group.
     S = T * w_tile * blocks
     tile = lambda: pl.BlockSpec(  # noqa: E731
@@ -301,34 +354,34 @@ def vmem_walk_local(
         (w_tile, 3), lambda b, t: (b * T + t, 0))
     out_specs = [
         tile(), tile(), tile(), tile(), tile(),
-        pl.BlockSpec((1,), lambda b, t: (b * T + t,)),
+        pl.BlockSpec((LANE,), lambda b, t: (b,)),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((S,), fdtype, vma=vma),
         jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int8, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int8, vma=vma),
         jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((T * blocks,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((blocks * LANE,), jnp.int32, vma=vma),
     ]
     if tally:
-        out_specs.append(pl.BlockSpec((1, L), lambda b, t: (b * T + t, 0)))
+        out_specs.append(pl.BlockSpec((Lp,), lambda b, t: (b,)))
         out_shape.append(
-            jax.ShapeDtypeStruct((T * blocks, L), flux.dtype, vma=vma)
+            jax.ShapeDtypeStruct((blocks * Lp,), flux.dtype, vma=vma)
         )
     s_o, lelem_o, done_o, exited_o, pending_o, iters, *fparts = (
         pl.pallas_call(
             kernel,
             grid=(blocks, T),
             in_specs=[
-                pl.BlockSpec((L, TABLE_PAD_COLS), lambda b, t: (b, 0)),
+                pl.BlockSpec((Lp, TABLE_PAD_COLS), lambda b, t: (b, 0)),
                 tile3(), tile(), tile3(), tile(), tile(), tile(),
             ],
             out_specs=out_specs,
             out_shape=out_shape,
             interpret=interpret,
         )(table_p, x, lelem, dest, eff_w,
-          done.astype(jnp.int8), exited.astype(jnp.int8))
+          done.astype(jnp.int32), exited.astype(jnp.int32))
     )
 
     s_o, lelem_o = s_o[:n], lelem_o[:n]
@@ -338,9 +391,9 @@ def vmem_walk_local(
     dest, d0 = dest[:n], d0[:n]
     x0 = dest - d0
     if tally:
-        # Per-(block, tile) partials reduce within the block, then lay
-        # out as the [blocks*L] padded flux.
-        flux = flux + fparts[0].reshape(blocks, T, L).sum(axis=1).reshape(
+        # Per-block accumulated partials [blocks, Lp]: drop the row
+        # padding, flatten back to the [blocks*L] flux layout.
+        flux = flux + fparts[0].reshape(blocks, Lp)[:, :L].reshape(
             blocks * L
         )
     # Same materialization rule as walk_local: reached-dest commits
