@@ -40,8 +40,42 @@ from __future__ import annotations
 import os
 
 
+def _ensure_backend() -> None:
+    """Fall back to automatic backend selection when JAX_PLATFORMS
+    names a platform that never registered.
+
+    An embedding host initializes CPython itself, so interpreter-
+    startup hooks that register PJRT *plugin* backends (installed via
+    sitecustomize/.pth) may not have run — while JAX_PLATFORMS in the
+    inherited environment still names the plugin's platform. jax then
+    refuses to initialize any backend at the first device use, deep
+    inside the first jit. Probe once up front and drop to automatic
+    selection (tpu/cpu, whatever actually initializes) instead of
+    handing the host an unusable library.
+    """
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError as e:
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if plat and "not in the list of known backends" in str(e):
+            from pumiumtally_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "JAX_PLATFORMS=%r is not a registered backend in this "
+                "(embedded) interpreter; falling back to automatic "
+                "backend selection", plat
+            )
+            jax.config.update("jax_platforms", None)
+            jax.devices()  # raises only if NO backend works
+        else:
+            raise
+
+
 def native_create(mesh_filename: str, num_particles: int):
     """Build the engine the environment asks for (see module doc)."""
+    _ensure_backend()
     from pumiumtally_tpu import (
         PartitionedPumiTally,
         PumiTally,

@@ -413,9 +413,14 @@ class StreamingPartitionedTally(StreamingTally):
         # the device mesh, so each group keeps its own jit cache. The
         # VMEM sub-split (walk_vmem_max_elems) multiplies the part
         # count so each BLOCK fits the bound; the engines derive their
-        # blocks_per_chip back from the part's shape.
+        # blocks_per_chip back from the part's shape. Clamp the bound
+        # through the same helper the engines use, or a prebuilt part
+        # could carry blocks the kernel cannot compile on hardware.
+        from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
+
+        vmem_bound = effective_vmem_bound(self.config.walk_vmem_max_elems)
         part = build_partition(mesh, per * derive_blocks_per_chip(
-            mesh.nelems, per, self.config.walk_vmem_max_elems
+            mesh.nelems, per, vmem_bound
         ))
         caches = [dict() for _ in range(ngroups)]
         # Each engine is sized to its chunk's REAL particle count (a
@@ -434,7 +439,7 @@ class StreamingPartitionedTally(StreamingTally):
                 part=part, shared_jit_cache=caches[g],
                 cond_every=self.config.resolved_cond_every(),
                 min_window=self.config.resolved_min_window(),
-                vmem_walk_max_elems=self.config.walk_vmem_max_elems,
+                vmem_walk_max_elems=vmem_bound,
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

@@ -149,6 +149,11 @@ class TallyConfig:
     # cost model); larger partitions silently keep the gather walk.
     # Not bitwise vs the gather walk (documented rounding-level
     # divergence); conservation gates apply unchanged.
+    # Hardware feasibility (measured via chipless AOT compile,
+    # tools/aot_vmem_compile.py): on v5e's 16 MB VMEM with the 1024
+    # particle tile, bounds up to 2048 compile; ~4096 exceeds the
+    # scoped-VMEM stack (the [w_tile, Lp] one-hot dominates at
+    # 4·w_tile·Lp bytes). Keep the bound <= 2048 on current chips.
     walk_vmem_max_elems: Optional[int] = None
     # StreamingPartitionedTally only: split the device mesh into this
     # many disjoint groups — chunks round-robin across them, so G

@@ -62,14 +62,52 @@ _N0 = WALK_TABLE_NORMALS.start
 _O0 = WALK_TABLE_OFFSETS.start
 _A0 = WALK_TABLE_ADJ.start
 TABLE_PAD_COLS = 32
-W_TILE_DEFAULT = 256
 # Mosaic block-shape law (jax pallas/mosaic/lowering.py
 # _check_block_mappings): a rank-1 block must equal the whole array or
 # be a multiple of 128*(32/bitwidth) lanes; a rank-2 block's minor dim
 # must be a 128-multiple (or whole) and its second-minor an 8-multiple
-# (or whole). Every ref this kernel touches is therefore f32/int32 in
-# 128-multiple tiles — int8/bool would demand 512-wide rank-1 blocks.
-LANE = 128
+# (or whole). Every ref this kernel touches is therefore f32/int32 —
+# int8/bool would demand 512-wide rank-1 blocks.
+#
+# The lowering check is necessary, not sufficient: XLA lays out 1-D
+# f32/s32 arrays in T(1024) tiles (one (8,128) vreg set), and Mosaic
+# verifies the operand layout against the BLOCK size — a 256-wide
+# rank-1 block on a 4096-long array fails with "XLA layout {0:T(1024)}
+# does not match Mosaic layout {0:T(256)}" (first-contact log,
+# tools/r4_onchip/). So every rank-1 tile — w_tile, the padded block
+# row count Lp, and the iters output — is a TILE_1D multiple.
+TILE_1D = 1024
+W_TILE_DEFAULT = 1024
+# Measured VMEM feasibility (chipless AOT sweep,
+# tools/aot_vmem_compile.py, v5e 16 MB/core): at the TILE_1D particle
+# tile the scoped-VMEM stack holds the [w_tile, Lp] one-hot through
+# Lp=2048; Lp=4096 exceeds the limit by ~9 MB. Engines clamp the
+# user's walk_vmem_max_elems to this on compiled-TPU backends
+# (interpret mode has no such ceiling).
+VMEM_FEASIBLE_MAX_ELEMS = 2048
+
+
+def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
+    """The walk_vmem_max_elems value an engine may actually use:
+    clamped to the measured scoped-VMEM ceiling on compiled-TPU
+    backends (a larger bound would die in Mosaic's allocator at first
+    compile), untouched in interpret mode. EVERY path that derives a
+    partition from the knob must clamp through here — clamping after
+    a partition is built leaves blocks the kernel cannot run (the
+    sub-split constructor then rejects the configuration)."""
+    if bound is None:
+        return None
+    bound = int(bound)
+    if not backend_needs_interpret() and bound > VMEM_FEASIBLE_MAX_ELEMS:
+        from pumiumtally_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "walk_vmem_max_elems=%d exceeds the measured scoped-VMEM "
+            "feasibility ceiling (%d) on this backend; clamping",
+            bound, VMEM_FEASIBLE_MAX_ELEMS,
+        )
+        return VMEM_FEASIBLE_MAX_ELEMS
+    return bound
 
 
 def _round_up(v: int, m: int) -> int:
@@ -204,12 +242,12 @@ def vmem_walk_local(
     if n == 0:  # walk_local handles the empty batch; match it
         return (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
                 flux, jnp.asarray(0, jnp.int32))
-    # Mosaic-legal tile width: rank-1 f32/int32 blocks must be LANE
-    # multiples (see block-shape law above). Rounding up (not clamping
-    # to n) keeps every layout the hardware path accepts; interpret
-    # mode uses the identical layout so CPU parity tests exercise
-    # exactly what lowers.
-    w_tile = _round_up(max(int(w_tile), 1), LANE)
+    # Mosaic-legal tile width: rank-1 blocks must be TILE_1D multiples
+    # (see layout law above). Rounding up (not clamping to n) keeps
+    # every layout the hardware path accepts; interpret mode uses the
+    # identical layout so CPU parity tests exercise exactly what
+    # lowers.
+    w_tile = _round_up(max(int(w_tile), 1), TILE_1D)
     if blocks > 1:
         # Sub-split layout is engine-arranged: no padding here, the
         # slot grouping IS the block routing.
@@ -240,12 +278,12 @@ def vmem_walk_local(
     eff_w = jnp.where(flying.astype(bool), weight * seg_len, 0.0)
     T = (n + pad) // w_tile // blocks  # tiles per block
     max_iters = int(max_iters)
-    # Pad each block's table to Lp rows (LANE multiple): the [Lp,32]
-    # input block and the [Lp] flux output block are then Mosaic-legal
-    # for ANY mesh size, and Lp is the MXU-friendly contraction dim.
-    # lelem < L always, so padded rows are never selected by the
-    # one-hot and contribute nothing.
-    Lp = _round_up(L, LANE)
+    # Pad each block's table to Lp rows (TILE_1D multiple): the
+    # [Lp,32] input block and the rank-1 [Lp] flux output block are
+    # then layout-legal for ANY mesh size, and Lp is the MXU-friendly
+    # contraction dim. lelem < L always, so padded rows are never
+    # selected by the one-hot and contribute nothing.
+    Lp = _round_up(L, TILE_1D)
     table_p = pad_table(table)
     if Lp != L:
         cols = table_p.shape[1]
@@ -354,7 +392,7 @@ def vmem_walk_local(
         (w_tile, 3), lambda b, t: (b * T + t, 0))
     out_specs = [
         tile(), tile(), tile(), tile(), tile(),
-        pl.BlockSpec((LANE,), lambda b, t: (b,)),
+        pl.BlockSpec((TILE_1D,), lambda b, t: (b,)),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((S,), fdtype, vma=vma),
@@ -362,7 +400,7 @@ def vmem_walk_local(
         jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
         jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
         jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((blocks * LANE,), jnp.int32, vma=vma),
+        jax.ShapeDtypeStruct((blocks * TILE_1D,), jnp.int32, vma=vma),
     ]
     if tally:
         out_specs.append(pl.BlockSpec((Lp,), lambda b, t: (b,)))

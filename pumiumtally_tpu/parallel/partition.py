@@ -645,6 +645,14 @@ class PartitionedEngine:
         # The full TetMesh is consumed here once and NOT retained: after
         # build_partition every engine path (localization included)
         # touches only per-chip sharded tables.
+        # Hardware ceiling, measured by the chipless AOT sweep: clamp
+        # the bound — finer sub-split, same intent — instead of dying
+        # in Mosaic's scoped-VMEM allocator at first compile. Callers
+        # that prebuild a partition (streaming) clamp through the same
+        # helper before deriving it, so part= and the bound agree.
+        from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
+
+        vmem_walk_max_elems = effective_vmem_bound(vmem_walk_max_elems)
         if part is not None:
             self.part = part
             nparts = self.part.ndev  # build_partition's part count

@@ -59,6 +59,10 @@ DEFAULT_CANDIDATES: Tuple[dict, ...] = (
     {"walk_perm_mode": "packed", "walk_cond_every": 8,
      "walk_window_factor": 8},
     {"walk_cond_every": 4, "walk_min_window": 1 << 30},
+    # Round-4 first on-chip capture: indirect won bench's runtime sweep
+    # (1.09M moves/s) while packed's best static corner was cond_every
+    # 8 — probe their combination too (tools/r4_onchip/digest.md).
+    {"walk_perm_mode": "indirect", "walk_cond_every": 8},
 )
 
 

@@ -219,3 +219,38 @@ def test_c_abi_echo_protocol_dedup(native_lib, tmp_path):
         assert abs(flux.sum() - expect) / expect < 1e-9
     finally:
         lib.pumiumtally_destroy(h)
+
+
+def test_embedded_boot_unregistered_platform_fallback(tmp_path):
+    """An embedding host's interpreter may inherit JAX_PLATFORMS naming
+    a PJRT *plugin* backend whose registration hook (sitecustomize)
+    never ran — the exact failure the round-4 on-chip native bench hit.
+    native_create must fall back to automatic backend selection instead
+    of dying inside the first jit (api/native.py _ensure_backend)."""
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # hook no-ops without it
+    env["JAX_PLATFORMS"] = "axon"  # ...but the env still names it
+    env["PUMIUMTALLY_ENGINE"] = "mono"
+    code = (
+        "import numpy as np\n"
+        "from pumiumtally_tpu.api.native import native_create\n"
+        f"t = native_create({msh!r}, 8)\n"
+        "src = np.full((8, 3), 0.3) + np.arange(8)[:, None] * 0.05\n"
+        "t.CopyInitialPosition(src.reshape(-1).copy())\n"
+        "dest = src + 0.1\n"
+        "t.MoveToNextLocation(src.reshape(-1).copy(),"
+        " dest.reshape(-1).copy(), np.ones(8, np.int8), np.ones(8))\n"
+        "import jax.numpy as jnp\n"
+        "print('SUM', float(jnp.sum(t.flux)))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "falling back to automatic backend selection" in (
+        r.stderr + r.stdout
+    )
+    got = float(r.stdout.strip().split("SUM", 1)[1])
+    want = float(np.linalg.norm(np.full((8, 3), 0.1), axis=1).sum())
+    assert abs(got - want) < 1e-6

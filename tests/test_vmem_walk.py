@@ -79,10 +79,15 @@ def test_vmem_walk_local_matches_gather_walk(tally):
 
 
 def test_vmem_walk_local_tile_padding_invariance():
-    """Results must not depend on the tile size / padding split."""
-    args = _chip_workload(seed=6, n=333)  # deliberately not a multiple
+    """Results must not depend on the tile size / padding split.
+
+    w_tile rounds up to the TILE_1D=1024 layout granule, so the
+    distinct splits at n=2500 are 1024 (3 tiles), 2048 (2 tiles) and
+    4096 (1 tile, maximal padding); n is deliberately not a multiple
+    of any of them."""
+    args = _chip_workload(seed=6, n=2500)
     outs = []
-    for w_tile in (64, 333, 512):
+    for w_tile in (1024, 2048, 4096):
         outs.append(vmem_walk_local(
             *args, tally=True, tol=1e-8, max_iters=4096,
             w_tile=w_tile, interpret=True,
@@ -295,3 +300,56 @@ def test_vmem_gate_oversized_subsplits_and_adj_sidecar_falls_back():
             part=build_partition(mesh, 16, force_split_adj=True),
             vmem_walk_max_elems=10_000,
         )
+
+
+@pytest.mark.slow
+def test_vmem_kernel_mosaic_compiles_chipless():
+    """The kernel must STAY Mosaic-compilable — round 4 found three
+    lowering laws the interpret path never checks (block-shape
+    multiples, scf carry legalization, XLA T(1024) rank-1 layout).
+    Chipless AOT against the local libtpu needs no TPU device and no
+    tunnel (tools/aot_vmem_compile.py); skip only when libtpu itself
+    is unavailable."""
+    import os
+    import subprocess
+    import sys
+
+    ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "aot_vmem_compile.py"),
+         "2048", "1024", "1024", "4", "1"],
+        capture_output=True, text=True, timeout=600,
+        env={k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS", "XLA_FLAGS")},
+    )
+    out = r.stdout + r.stderr
+    if r.returncode != 0 and (
+        "topology not implemented" in out  # jax: no TPU support built
+        or "libtpu.so" in out  # plugin present but .so unloadable
+    ):
+        pytest.skip(f"libtpu unavailable for AOT: {out[-300:]}")
+    assert r.returncode == 0 and "COMPILE OK" in out, out[-2000:]
+
+
+def test_vmem_bound_clamped_on_compiled_backends(monkeypatch, caplog):
+    """On a compiled-TPU backend a bound past the measured scoped-VMEM
+    ceiling is clamped (finer sub-split, same intent) instead of dying
+    in Mosaic's allocator at first walk. CPU interpret mode keeps the
+    exact bound (asserted by the surrounding suite's block counts)."""
+    import pumiumtally_tpu.ops.vmem_walk as vw
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    monkeypatch.setattr(vw, "backend_needs_interpret", lambda: False)
+    mesh = build_box(1, 1, 1, 8, 8, 8)  # 3072 tets
+    t = PartitionedPumiTally(
+        mesh, 64,
+        TallyConfig(device_mesh=make_device_mesh(1), capacity_factor=4.0,
+                    walk_vmem_max_elems=100_000),
+    )
+    # Unclamped, 3072 <= 100k would give one 3072-elem block; the clamp
+    # forces ceil(3072/2048) = 2 blocks of <= 2048.
+    assert t.engine.blocks_per_chip == 2
+    assert t.engine.part.L <= vw.VMEM_FEASIBLE_MAX_ELEMS
+    assert t.engine.use_vmem_walk

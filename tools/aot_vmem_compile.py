@@ -1,0 +1,97 @@
+"""Chipless Mosaic compile harness for the production VMEM walk kernel.
+
+Compiles `ops/vmem_walk.py` AOT against a single-chip v5e topology
+using the locally-installed libtpu — NO device, NO tunnel. This exists
+because the round-4 remote compile of this kernel hung the device
+tunnel's compile helper (tools/r4_onchip/, PERF_NOTES r4): iterating on
+Mosaic lowering through the tunnel risks wedging the only chip, while
+this path costs nothing and fails (or hangs) in a killable local
+process.
+
+The main backend is pinned to CPU (the topology client is
+compile-only); `jax.experimental.topologies.get_topology_desc` wants
+`chips_per_host_bounds` as a LIST of ints — string forms are rejected.
+
+Usage: python tools/aot_vmem_compile.py [n] [w_tile] [max_iters] [divs] [blocks]
+Prints COMPILE OK <seconds> or the full compiler error; exit code 0/1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# The TPU data path is f32; an inherited JAX_ENABLE_X64 (the CPU parity
+# suite's env) would promote the workload to f64, which Mosaic rejects.
+jax.config.update("jax_enable_x64", False)
+
+from functools import partial  # noqa: E402
+
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def topology_sharding():
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:1x1x1",
+        chips_per_host_bounds=[1, 1, 1],
+    )
+    mesh = topologies.make_mesh(topo, (1,), ("x",))
+    return NamedSharding(mesh, P())
+
+
+def compile_kernel(n=4096, w_tile=1024, max_iters=2048, divs=6, ndev=2,
+                   blocks=1, tally=True):
+    from tools.exp_r4_vmem_compile import chip_workload
+
+    from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
+
+    s = topology_sharding()
+    part, args = chip_workload(divs=divs, ndev=ndev, n=n)
+    f = partial(vmem_walk_local, tally=tally, tol=1e-6,
+                max_iters=max_iters, w_tile=w_tile, interpret=False,
+                blocks=blocks)
+    shaped = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+              for a in args]
+    t0 = time.perf_counter()
+    lowered = jax.jit(f).lower(*shaped)
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    lowered.compile()
+    return t_lower, time.perf_counter() - t0, part.L
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    n = int(argv[0]) if len(argv) > 0 else 4096
+    w_tile = int(argv[1]) if len(argv) > 1 else 1024
+    max_iters = int(argv[2]) if len(argv) > 2 else 2048
+    divs = int(argv[3]) if len(argv) > 3 else 6
+    blocks = int(argv[4]) if len(argv) > 4 else 1
+    try:
+        t_lower, t_compile, L = compile_kernel(
+            n=n, w_tile=w_tile, max_iters=max_iters, divs=divs,
+            blocks=blocks,
+        )
+    except Exception as e:  # noqa: BLE001 — the harness's question
+        print(f"COMPILE FAILED: {type(e).__name__}: {str(e)[:4000]}")
+        return 1
+    print(f"COMPILE OK: lower {t_lower:.1f}s, mosaic+xla {t_compile:.1f}s "
+          f"(L={L}, n={n}, w_tile={w_tile}, max_iters={max_iters}, "
+          f"blocks={blocks})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

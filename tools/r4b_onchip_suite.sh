@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-4 second-window suite: fires once when the TPU tunnel recovers
+# from the 04:33 UTC re-wedge. ORDER MATTERS: the clean bench re-run
+# (uncontended headline) comes first because it is known-good; the
+# production-VMEM Mosaic compile goes LAST because its compile request
+# is the prime suspect for the re-wedge (the helper hung rather than
+# erroring on the third attempt).
+set -u
+OUT=/tmp/r4b_onchip
+mkdir -p "$OUT"
+cd /root/repo
+echo "suite started $(date)" > "$OUT/status"
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$OUT/status"
+  mkdir -p /root/repo/tools/r4_onchip
+  cp "$OUT/$name.log" /root/repo/tools/r4_onchip/r4b_$name.log 2>/dev/null
+  cp "$OUT/status" /root/repo/tools/r4_onchip/r4b_status 2>/dev/null
+}
+run bench_clean 2700 python bench.py
+run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$OUT/status"
+cp "$OUT/status" /root/repo/tools/r4_onchip/r4b_status 2>/dev/null
