@@ -335,11 +335,77 @@ def measure_link_bandwidth(mb: float = 8.0) -> float | None:
         return None
 
 
+def run_vmem_blocked_subprocess() -> dict | None:
+    """run_vmem_blocked in a timeout-capped child process.
+
+    The row involves a Mosaic kernel compile, and the round-4 capture
+    showed that compile HANGING the device tunnel's remote compile
+    helper (>25 min, no error) — an in-process hang would eat the
+    whole bench along with the already-measured headline. A child can
+    be killed; its JSON line is the only coupling.
+
+    On hardware the child opens a SECOND device client while the
+    parent still holds its own — concurrent clients are observed to
+    work on this tunnel (round-4 capture: a stray client ran inside
+    bench's window and both completed), but if a grant ever becomes
+    exclusive the cap below is the cost, paid once and reported. The
+    cap is sized from measured compiles (~40 s chipless, minutes-not-
+    tens-of-minutes on the helper) plus the row's runtime."""
+    import jax
+
+    tmo = float(os.environ.get("PUMIUMTALLY_BENCH_VMEM_TIMEOUT", "420"))
+    env = dict(os.environ)
+    env["PUMIUMTALLY_BENCH_VMEM_CHILD"] = "1"
+    # A fresh interpreter's startup hook re-points JAX at the device
+    # tunnel regardless of env vars (only an in-process config update
+    # wins) — so tell the child which backend the PARENT measured on
+    # and let it config-update itself. Without this, a CPU test run's
+    # child dials the possibly-wedged tunnel and hangs to the cap.
+    env["PUMIUMTALLY_BENCH_VMEM_CHILD_PLATFORM"] = jax.default_backend()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=tmo,
+        )
+    except subprocess.TimeoutExpired as e:
+        # The child's partial stderr is the only triage signal for the
+        # wrapper's primary failure mode (wedged helper vs slow
+        # compile) — relay it.
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                text = stream if isinstance(stream, str) else stream.decode(
+                    "utf-8", "replace")
+                sys.stderr.write(text[-2000:])
+        print(f"# vmem-blocked child timed out after {tmo:.0f}s "
+              "(wedged compile helper?)", file=sys.stderr)
+        return None
+    sys.stderr.write(out.stderr[-2000:])
+    if out.returncode != 0:
+        print(f"# vmem-blocked child rc={out.returncode}",
+              file=sys.stderr)
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
 def main() -> None:
     if os.environ.get("PUMIUMTALLY_BENCH_CPU") == "1":
         # Subprocess mode: CPU baseline on the IDENTICAL workload.
         res = run_workload(N, MOVES, "two_phase")
         print(json.dumps({"cpu_two_phase_rate": res["moves_per_sec"]}))
+        return
+    if os.environ.get("PUMIUMTALLY_BENCH_VMEM_CHILD") == "1":
+        # Subprocess mode: the blocked-vmem row (see wrapper above).
+        want = os.environ.get("PUMIUMTALLY_BENCH_VMEM_CHILD_PLATFORM")
+        if want:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        # default=float: numpy scalars (block counts etc.) must not
+        # kill the only line the parent parses.
+        print(json.dumps(run_vmem_blocked(N, MOVES), default=float))
         return
 
     preflight_device()
@@ -351,12 +417,12 @@ def main() -> None:
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
-            blocked = run_vmem_blocked(N, MOVES)
-        except (Exception, SystemExit) as e:  # noqa: BLE001
-            # Best-effort EXTRA metric: neither a Mosaic failure nor
-            # this row's own conservation exit (check_conservation
-            # raises SystemExit) may cost the already-measured
-            # headline numbers.
+            blocked = run_vmem_blocked_subprocess()
+        except Exception as e:  # noqa: BLE001
+            # Best-effort EXTRA metric: a spawn/parse failure may not
+            # cost the already-measured headline numbers. (Mosaic
+            # failures, hangs, and the row's conservation exit all
+            # happen inside the child and surface as None above.)
             print(f"# vmem-blocked workload failed: {e}", file=sys.stderr)
 
     vs_baseline = None

@@ -128,3 +128,23 @@ def test_vmem_blocked_workload(bench, monkeypatch):
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
     assert res["blocks_per_chip"] > 1
     assert res["block_elems"] <= 100
+
+
+def test_vmem_blocked_child_hang_contained(bench, monkeypatch):
+    """A child that exceeds its budget is killed and yields None —
+    never an exception, never a stall: a hung Mosaic compile (the
+    round-4 tunnel wedge) must not eat the bench headline."""
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_VMEM_TIMEOUT", "0.01")
+    assert bench.run_vmem_blocked_subprocess() is None
+
+
+@pytest.mark.slow
+def test_vmem_blocked_subprocess_wrapper(bench, monkeypatch):
+    """The real child round-trip (interpreter boot + engine compile,
+    ~25 s): the wrapper must relay the parent's backend to the child
+    (a fresh interpreter's startup hook would otherwise re-point it at
+    the device tunnel) and parse its JSON line."""
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_VMEM_BOUND", "100")
+    res = bench.run_vmem_blocked_subprocess()
+    assert res is not None and res["blocks_per_chip"] >= 2
+    assert res["conservation_rel_err"] < 1e-5

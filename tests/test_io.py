@@ -419,6 +419,7 @@ def test_pvtu_pieces_round_trip(tmp_path):
     assert sum(counts) == ne
 
 
+@pytest.mark.slow
 def test_partitioned_write_pvtu(tmp_path):
     """PartitionedPumiTally writes rank-aware .pvtu pieces whose
     assembled flux matches the engine's normalized flux."""

@@ -156,7 +156,12 @@ def test_c_abi_continue_and_accessors(native_lib, tmp_path):
         lib.pumiumtally_destroy(h)
 
 
+@pytest.mark.slow
 def test_cpp_demo_host(native_lib, tmp_path):
+    # Slow tier: boots a whole embedded interpreter (~17 s); the C ABI
+    # itself stays covered fast via the ctypes tests above. CI's
+    # native job runs both tiers of this file explicitly (test.yml),
+    # so the embedded path keeps a job that pre-builds native/.
     """Full embedding path: a pure-C++ binary hosts the engine."""
     r = subprocess.run(
         ["make", "-C", NATIVE, "-s", "demo", f"PY={sys.executable}"],

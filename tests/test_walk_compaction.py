@@ -41,6 +41,7 @@ def _setup(seed=0):
     return mesh, r.x, r.elem, dest, fly, w
 
 
+@pytest.mark.slow
 def test_cascade_matches_plain_walk():
     mesh, x, elem, dest, fly, w = _setup()
     flux0 = jnp.zeros((mesh.nelems,))
@@ -166,12 +167,15 @@ def test_cond_every_k_is_exact():
                                   np.asarray(outs[1].x))
 
 
+@pytest.mark.slow
 def test_perm_modes_bitwise_identical():
     """The three stage-boundary permutation strategies ("arrays",
     "packed", "indirect" — ops/walk.py _PERM_MODES) are implementation
     details of the SAME computation: identical values gathered/permuted
     through different layouts, identical scatter order. Results must be
-    BITWISE equal, flux included."""
+    BITWISE equal, flux included. Slow tier: the three-mode sweep pays
+    three full jit compiles; the fast tier still covers each mode's
+    correctness through the autotune and walk-kw tests."""
     mesh, x, elem, dest, fly, w = _setup(seed=7)
     flux0 = jnp.zeros((mesh.nelems,))
     outs = {
