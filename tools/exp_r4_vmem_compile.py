@@ -81,16 +81,17 @@ def main(n: int) -> None:
     # -- 3. rate sweep -----------------------------------------------------
     from functools import partial
 
-    for divs, ndev in ((6, 2), (8, 2), (8, 1), (12, 2)):
+    # w_tile is pinned by the T(1024) layout law; the meaningful axis
+    # is the block size L (the table the one-hot contracts against).
+    for divs, ndev in ((6, 2), (8, 2), (8, 1), (12, 8)):
         part, args = chip_workload(divs=divs, ndev=ndev, n=n)
         rows = {}
         for name, fn in (
             ("gather", partial(walk_local, tally=True, tol=1e-6,
                                max_iters=4096)),
-            *[(f"vmem_w{w}", partial(vmem_walk_local, tally=True,
-                                     tol=1e-6, max_iters=4096,
-                                     w_tile=w, interpret=False))
-              for w in (128, 256, 512)],
+            ("vmem", partial(vmem_walk_local, tally=True,
+                             tol=1e-6, max_iters=4096,
+                             w_tile=1024, interpret=False)),
         ):
             try:
                 g = jax.jit(fn)
