@@ -57,12 +57,14 @@ W_TILE = 256  # particles per pallas tile / jnp chunk
 
 
 def _padded_table(mesh):
-    """[L,32] f32: 12 normals, 4 offsets, 4 adjacency ids, 12 zeros."""
-    t = np.asarray(mesh.walk_table, np.float32)
-    L = t.shape[0]
-    out = np.zeros((L, 32), np.float32)
-    out[:, : t.shape[1]] = t
-    return jnp.asarray(out)
+    """[L,32] f32: 12 normals, 4 offsets, 4 adjacency ids, 12 zeros.
+
+    Pure jnp (no numpy round-trip): under jit the mesh arrays are
+    tracers — the r4 on-chip run died here with
+    TracerArrayConversionError before any prototype number landed."""
+    t = jnp.asarray(mesh.walk_table, jnp.float32)
+    L, c = t.shape
+    return jnp.concatenate([t, jnp.zeros((L, 32 - c), jnp.float32)], axis=1)
 
 
 def _advance_cols(row, s, elem, dest, d0, eff_w, done, tol, one):
