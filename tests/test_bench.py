@@ -78,6 +78,9 @@ def test_preflight_max_wait_env_caps_budget(bench, monkeypatch):
     import subprocess as sp
 
     monkeypatch.setenv("PUMIUMTALLY_BENCH_MAX_WAIT", "45")
+    # Point the stale-result fallback at nothing: this test asserts the
+    # hard-failure path (the fallback has its own test).
+    monkeypatch.setattr(bench, "LAST_SUCCESS_PATH", "/nonexistent/x.json")
     seen_timeouts = []
 
     def fake_run(cmd, **kw):
@@ -148,3 +151,65 @@ def test_vmem_blocked_subprocess_wrapper(bench, monkeypatch):
     res = bench.run_vmem_blocked_subprocess()
     assert res is not None and res["blocks_per_chip"] >= 2
     assert res["conservation_rel_err"] < 1e-5
+
+
+def test_stale_result_fallback(bench, monkeypatch, tmp_path, capsys):
+    """Device unreachable at report time: bench must fall back to this
+    round's last successful measurement, conspicuously flagged stale —
+    and refuse a cache old enough to be another round's number."""
+    import json
+    import time as _time
+
+    path = tmp_path / "last.json"
+    monkeypatch.setattr(bench, "LAST_SUCCESS_PATH", str(path))
+
+    # No cache -> still a hard failure.
+    with pytest.raises(SystemExit) as e:
+        bench._report_stale_result_or_die()
+    assert e.value.code == 1
+
+    bench.record_success({"metric": "particle_moves_per_sec",
+                          "value": 123.0, "vs_baseline": 2.0})
+    with pytest.raises(SystemExit) as e:
+        bench._report_stale_result_or_die()
+    assert e.value.code == 0
+    out = capsys.readouterr()
+    line = [l for l in out.out.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["stale"] is True and rec["value"] == 123.0
+    assert "measured_at_utc" in rec and "stale_reason" in rec
+    assert "STALE" in out.err
+
+    # Too old -> refuse.
+    old = json.load(open(path))
+    old["measured_at_epoch"] = _time.time() - bench.STALE_MAX_AGE_S - 60
+    json.dump(old, open(path, "w"))
+    with pytest.raises(SystemExit) as e:
+        bench._report_stale_result_or_die()
+    assert e.value.code == 1
+
+
+def test_stale_result_round_mismatch_refused(bench, monkeypatch, tmp_path):
+    """A cached result stamped with a different round id must be
+    refused even when it is young enough for the age backstop."""
+    import json
+
+    path = tmp_path / "last.json"
+    monkeypatch.setattr(bench, "LAST_SUCCESS_PATH", str(path))
+    monkeypatch.setattr(bench, "_current_round", lambda: 5)
+    bench.record_success({"value": 1.0})
+    rec = json.load(open(path))
+    assert rec["measured_in_round"] == 5
+    rec["measured_in_round"] = 4
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(SystemExit) as e:
+        bench._report_stale_result_or_die()
+    assert e.value.code == 1
+
+    # Opt-out kills the fallback outright.
+    rec["measured_in_round"] = 5
+    json.dump(rec, open(path, "w"))
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_NO_STALE", "1")
+    with pytest.raises(SystemExit) as e:
+        bench._report_stale_result_or_die()
+    assert e.value.code == 1
