@@ -15,6 +15,7 @@ this package's ``.osh`` directories (io/osh.py).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
@@ -163,6 +164,63 @@ def cmd_autotune(args) -> None:
           f"TallyConfig({settings})")
 
 
+def cmd_aot_check(args) -> None:
+    """Certify that the Pallas walk kernel (and optionally the full
+    multi-chip programs) compile for a real TPU target WITHOUT a
+    device, via the locally installed libtpu (chipless AOT — the
+    mechanism that caught three lowering bugs interpret mode cannot
+    see; tools/aot_vmem_compile.py holds the lowering-law notes).
+    Useful as a cluster pre-flight: a green aot-check means the
+    deployment's jax/libtpu pair can build every kernel this package
+    ships before any TPU time is booked."""
+    import subprocess
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if not os.path.isdir(tools):
+        # Installed without the repo checkout: the harnesses live in
+        # the source tree, not the wheel.
+        raise SystemExit(
+            "aot-check needs the repository's tools/ directory "
+            "(run from a source checkout)"
+        )
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    jobs = [("walk kernel (single chip)",
+             [sys.executable, os.path.join(tools, "aot_vmem_compile.py"),
+              "2048", "1024", "1024", "4", "1"])]
+    if args.multichip:
+        jobs.append(("multi-chip phase programs",
+                     [sys.executable,
+                      os.path.join(tools, "aot_multichip_compile.py"),
+                      "2048"]))
+    rc = 0
+    for label, cmd in jobs:
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1800, env=env)
+            job_rc, text = r.returncode, (r.stdout + r.stderr)
+        except subprocess.TimeoutExpired as e:
+            # A hung compile is a result too (the harness exists
+            # because one hung a remote helper) — report it and move
+            # on to the remaining jobs.
+            job_rc = 1
+            text = "".join(
+                s if isinstance(s, str) else s.decode("utf-8", "replace")
+                for s in (e.stdout, e.stderr) if s
+            ) + "\n(compile timed out after 1800s)"
+        lines = text.strip().splitlines()
+        # Success: a terse tail. Failure: the whole child output, so
+        # the root cause (e.g. a libtpu-missing error above jax's
+        # warning chatter) is never truncated away.
+        shown = lines[-4:] if job_rc == 0 else lines
+        print(f"[{'OK' if job_rc == 0 else 'FAILED'}] {label}\n  "
+              + ("\n  ".join(shown) if shown else "(no output)"))
+        rc |= 1 if job_rc else 0
+    if rc:
+        raise SystemExit(1)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         prog="pumiumtally",
@@ -230,6 +288,14 @@ def main(argv=None) -> None:
     c.add_argument("--particles", type=int, default=200_000)
     c.add_argument("--moves", type=int, default=3)
     c.set_defaults(fn=cmd_autotune)
+
+    c = sub.add_parser(
+        "aot-check",
+        help="compile the TPU kernels chipless (local libtpu, no device)",
+    )
+    c.add_argument("--multichip", action="store_true",
+                   help="also compile the 4-chip phase programs")
+    c.set_defaults(fn=cmd_aot_check)
 
     args = p.parse_args(argv)
     args.fn(args)

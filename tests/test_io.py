@@ -819,3 +819,22 @@ def test_vtk_truncation_fuzz(tmp_path, kind):
             assert out.shape[0] == 48, kind
         except (ValueError, KeyError):
             pass
+
+
+@pytest.mark.slow
+def test_cli_aot_check_verb(capsys):
+    """`pumiumtally aot-check` compiles the walk kernel chipless via
+    the local libtpu and reports OK (cluster pre-flight; skips where
+    libtpu itself is absent)."""
+    from pumiumtally_tpu.cli import main as cli
+
+    try:
+        cli(["aot-check"])
+    except SystemExit as e:
+        out = capsys.readouterr()
+        if ("topology not implemented" in out.out + out.err
+                or "libtpu.so" in out.out + out.err):
+            pytest.skip("libtpu unavailable for AOT")
+        raise AssertionError(out.out + out.err) from e
+    out = capsys.readouterr()
+    assert "[OK] walk kernel" in out.out
