@@ -213,3 +213,14 @@ def test_stale_result_round_mismatch_refused(bench, monkeypatch, tmp_path):
     with pytest.raises(SystemExit) as e:
         bench._report_stale_result_or_die()
     assert e.value.code == 1
+
+
+def test_record_success_gating(bench, monkeypatch, tmp_path):
+    """Env-resized runs must never become the cached 'official' round
+    measurement (the bench fixture itself sets the resize envs, so
+    this process is exactly the case the gate exists for)."""
+    assert bench._is_standard_workload() is False
+    for k in ("PUMIUMTALLY_BENCH_N", "PUMIUMTALLY_BENCH_DIV",
+              "PUMIUMTALLY_BENCH_MOVES"):
+        monkeypatch.delenv(k, raising=False)
+    assert bench._is_standard_workload() is True
