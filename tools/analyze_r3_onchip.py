@@ -84,11 +84,12 @@ def main() -> None:
     section("native C-ABI host")
     show_matching(os.path.join(d, "native.log"),
                   [r"native_two_phase_moves_per_sec", r"error", r"FAIL"])
-    section("bench.py JSON")
-    bench_log = os.path.join(d, "bench.log")
-    if os.path.exists(bench_log):
+    def bench_json(path: str) -> None:
+        if not os.path.exists(path):
+            print(f"(missing: {os.path.basename(path)})")
+            return
         found = False
-        with open(bench_log, errors="replace") as f:
+        with open(path, errors="replace") as f:
             for line in f:
                 line = line.strip()
                 if line.startswith("{"):
@@ -97,7 +98,7 @@ def main() -> None:
                     except json.JSONDecodeError:
                         continue
                     found = True
-                    for k in ("value", "vs_baseline",
+                    for k in ("value", "vs_baseline", "stale",
                               "two_phase_moves_per_sec",
                               "continue_moves_per_sec",
                               "autotuned_knobs", "link_mb_per_sec",
@@ -106,9 +107,27 @@ def main() -> None:
                         if k in j:
                             print(f"  {k}: {j[k]}")
         if not found:
-            show_matching(bench_log, [r"FATAL", r"probe", r"#"])
-    else:
-        print("(missing: bench.log)")
+            show_matching(path, [r"FATAL", r"probe", r"#"])
+
+    section("bench.py JSON")
+    bench_json(os.path.join(d, "bench.log"))
+
+    # Second-window suite (tools/r4b_onchip_suite.sh) artifacts, if it
+    # ever fired: clean bench re-run, native re-run, production-vmem
+    # compile+rates with the layout-law fixes in.
+    if os.path.exists(os.path.join(d, "r4b_status")):
+        section("SECOND WINDOW (r4b): status")
+        with open(os.path.join(d, "r4b_status")) as f:
+            print(f.read().strip())
+        section("r4b clean bench JSON")
+        bench_json(os.path.join(d, "r4b_bench_clean.log"))
+        section("r4b production vmem compile/rates")
+        show_matching(os.path.join(d, "r4b_vmem_prod.log"),
+                      [r"COMPILE", r"PARITY", r"^L=", r"ENGINE", r"FAILED"])
+        section("r4b native C-ABI host")
+        show_matching(os.path.join(d, "r4b_native.log"),
+                      [r"native_two_phase_moves_per_sec", r"error",
+                       r"FAIL"])
 
 
 if __name__ == "__main__":
