@@ -39,6 +39,7 @@ from pumiumtally_tpu.api.tally import (
     _move_step,
     _move_step_continue,
     adopt_located,
+    check_finite,
     host_positions,
     locate_or_committed,
     zero_flying_side_effect,
@@ -104,7 +105,8 @@ class StreamingTally(PumiTally):
         return lo, min(lo + self.chunk_size, self.num_particles)
 
     def _stage_chunk_positions(
-        self, host: np.ndarray, k: int, retain: bool = False
+        self, host: np.ndarray, k: int, retain: bool = False,
+        what: Optional[str] = None,
     ) -> jnp.ndarray:
         """host is the caller's [3n] buffer (f64); returns [chunk,3] on
         device, padded by repeating the last row (pad slots never fly).
@@ -120,6 +122,15 @@ class StreamingTally(PumiTally):
         lo, hi = self._chunk_bounds(k)
         a = host[3 * lo : 3 * hi].reshape(hi - lo, 3)
         a = np.asarray(a, dtype=np.dtype(self.dtype))  # host pre-cast
+        if (what is not None and self.config.validate_inputs
+                and np.dtype(self.dtype) != np.float64):
+            # AFTER the working-dtype cast (an f64 value that overflows
+            # f32 to inf must be caught too — same rule as the
+            # monolithic facade), per chunk so the streaming design's
+            # no-full-batch-copies property holds. Skipped in f64 mode:
+            # the cast is an identity there and the raw batch was
+            # already checked at entry.
+            check_finite(a, what, offset=3 * lo)
         if hi - lo < self.chunk_size:
             a = np.concatenate(
                 [a, np.repeat(a[-1:], self.chunk_size - (hi - lo), axis=0)]
@@ -128,12 +139,16 @@ class StreamingTally(PumiTally):
             a = self._owned(a)
         return jnp.asarray(a)
 
-    def _stage_chunk_vec(self, host, k: int, dtype, fill) -> jnp.ndarray:
+    def _stage_chunk_vec(self, host, k: int, dtype, fill,
+                         what: Optional[str] = None) -> jnp.ndarray:
         lo, hi = self._chunk_bounds(k)
         # copy=True: jnp.asarray may alias a same-dtype numpy buffer
         # zero-copy on the CPU backend, and the flying buffer is zeroed
         # in place after staging (see tally.zero_flying_side_effect).
         a = np.array(host[lo:hi], dtype=dtype, copy=True)
+        if (what is not None and self.config.validate_inputs
+                and np.dtype(dtype) != np.float64):
+            check_finite(a, what, offset=lo)  # see _stage_chunk_positions
         if hi - lo < self.chunk_size:
             a = np.concatenate(
                 [a, np.full(self.chunk_size - (hi - lo), fill, dtype=dtype)]
@@ -147,11 +162,13 @@ class StreamingTally(PumiTally):
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
         host = host_positions(init_particle_positions, size, self.num_particles)
+        if self.config.validate_inputs:
+            check_finite(host, "positions")
         # Dispatch every chunk first (staging of chunk k+1 overlaps the
         # walk of chunk k); evaluate the convergence flags only after.
         dones = []
         for k in range(self.nchunks):
-            dest = self._stage_chunk_positions(host, k)
+            dest = self._stage_chunk_positions(host, k, what="positions")
             dones.append(self._chunk_localize(k, dest))
         self._after_chunk_dispatch()
         if self.config.check_found_all and not all(
@@ -179,6 +196,10 @@ class StreamingTally(PumiTally):
             if particle_origin is None
             else host_positions(particle_origin, size, n)
         )
+        if self.config.validate_inputs:
+            check_finite(dests_h, "destinations")
+            if origins_h is not None:
+                check_finite(origins_h, "origins")
         # Origin-echo dedup (TallyConfig.auto_continue), chunk-wise: when
         # the caller's origins equal the previous move's destinations
         # bit-for-bit in the working dtype (same rule as the monolithic
@@ -196,6 +217,8 @@ class StreamingTally(PumiTally):
             if weights is None
             else np.asarray(weights, np.float64).reshape(-1)
         )
+        if self.config.validate_inputs and w_h is not None:
+            check_finite(w_h[: self.num_particles], "weights")
 
         retain = origins_h is not None and self._retain_echo_snapshots()
         oks = []
@@ -203,7 +226,8 @@ class StreamingTally(PumiTally):
         for k in range(self.nchunks):
             # Stage chunk k, dispatch its walk, move on: dispatches are
             # async, so chunk k+1's staging overlaps chunk k's walk.
-            dest = self._stage_chunk_positions(dests_h, k, retain=retain)
+            dest = self._stage_chunk_positions(dests_h, k, retain=retain,
+                                               what="destinations")
             dest_chunks.append(dest)
             fly = (
                 jnp.ones((self.chunk_size,), jnp.int8)
@@ -213,7 +237,8 @@ class StreamingTally(PumiTally):
             w = (
                 jnp.ones((self.chunk_size,), self.dtype)
                 if w_h is None
-                else self._stage_chunk_vec(w_h, k, np.dtype(self.dtype), 0.0)
+                else self._stage_chunk_vec(w_h, k, np.dtype(self.dtype),
+                                           0.0, what="weights")
             )
             lo, hi = self._chunk_bounds(k)
             if hi - lo < self.chunk_size:  # pad slots never fly
@@ -225,7 +250,8 @@ class StreamingTally(PumiTally):
             elif echo:
                 orig = self._last_dests_dev[k]
             else:
-                orig = self._stage_chunk_positions(origins_h, k)
+                orig = self._stage_chunk_positions(origins_h, k,
+                                                   what="origins")
             oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
         if retain:
@@ -234,7 +260,11 @@ class StreamingTally(PumiTally):
             # cannot fool the next compare. Reuse the already-converted
             # flat buffer — a list/non-f64 input must not convert twice.
             # Only retained for origin-passing drivers (see tally.py).
-            self._last_dests_host = self._as_positions_host(dests_h, size)
+            # what=None: dests_h was validated at entry (and per
+            # chunk for the narrow-dtype corner) — skip a third
+            # full-batch pass.
+            self._last_dests_host = self._as_positions_host(
+                dests_h, size, what=None)
             self._last_dests_dev = dest_chunks
         self.iter_count += 1
         self._after_chunk_dispatch()

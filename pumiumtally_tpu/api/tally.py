@@ -113,6 +113,33 @@ def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
     return a[: 3 * n]
 
 
+def check_finite(a: np.ndarray, what: str, offset: int = 0) -> None:
+    """Raise on NaN/Inf in a staged host array (TallyConfig.
+    validate_inputs): one non-finite destination or weight silently
+    poisons the whole accumulated flux (nan scatter-add), so refusing
+    BEFORE upload keeps the engine's committed state clean.
+
+    The monolithic facade checks the whole batch after the
+    working-dtype cast (so f64 values that overflow f32 to inf are
+    caught) before anything is dispatched. The streaming facade checks
+    the raw f64 batch up front (NaN/Inf inputs refuse before any chunk
+    dispatches) AND each chunk's cast (the f32-overflow corner); in
+    that corner earlier chunks of the refused move may already be
+    applied — a loud mid-move raise, never silent poisoning."""
+    if not np.isfinite(a).all():
+        flat = np.asarray(a).reshape(-1)
+        bad = np.flatnonzero(~np.isfinite(flat))
+        # ``offset``: flat index of a[0] in the CALLER's buffer (chunked
+        # staging passes the chunk base) so the report locates the bad
+        # element in what the host actually handed over.
+        raise ValueError(
+            f"{what} contains {bad.size} non-finite value(s); first at "
+            f"flat index {offset + bad[0]} ({flat[bad[0]]!r}). Fix the "
+            "host buffer, or set TallyConfig(validate_inputs=False) to "
+            "stage unchecked"
+        )
+
+
 def zero_flying_side_effect(flying, n: int) -> None:
     """Zero the caller's flying buffer in place after staging — the
     reference's documented host side effect OpenMC relies on
@@ -353,16 +380,23 @@ class PumiTally:
         return a
 
     # -- staging helpers -------------------------------------------------
-    def _as_positions_cast(self, buf, size: Optional[int]) -> np.ndarray:
+    def _as_positions_cast(self, buf, size: Optional[int],
+                       what: Optional[str] = "positions") -> np.ndarray:
         """[n,3] working-dtype host array; MAY be a view of the
         caller's buffer (f64 working dtype). Cast on the host with
         numpy BEFORE handing to jax: letting jnp.asarray do the
         f64→f32 conversion goes through a slow backend path (measured
         ~100× slower than a numpy pre-cast + plain transfer)."""
         a = host_positions(buf, size, self.num_particles)
-        return np.asarray(
+        cast = np.asarray(
             a.reshape(self.num_particles, 3), dtype=np.dtype(self.dtype)
         )
+        if what is not None and self.config.validate_inputs:
+            # Checked AFTER the working-dtype cast so an f64 value that
+            # overflows f32 to inf is caught too. ``what=None`` opts
+            # out for buffers a caller has already validated.
+            check_finite(cast, what)
+        return cast
 
     @staticmethod
     def _owned(h: np.ndarray) -> np.ndarray:
@@ -373,8 +407,9 @@ class PumiTally:
         recycled caller buffer would corrupt both."""
         return h if (h.base is None and h.flags.owndata) else h.copy()
 
-    def _as_positions_host(self, buf, size: Optional[int]) -> np.ndarray:
-        return self._owned(self._as_positions_cast(buf, size))
+    def _as_positions_host(self, buf, size: Optional[int],
+                       what: Optional[str] = "positions") -> np.ndarray:
+        return self._owned(self._as_positions_cast(buf, size, what))
 
     def _origins_echo_raw(self, buf, size: Optional[int]) -> bool:
         """Shared echo rule for every facade: the caller's origins,
@@ -553,7 +588,8 @@ class PumiTally:
                 "(reference invariant, PumiTallyImpl.cpp:437-438)"
             )
         t0 = time.perf_counter()
-        dests_host = self._as_positions_host(particle_destinations, size)
+        dests_host = self._as_positions_host(particle_destinations, size,
+                                             what="destinations")
         # Convert the origins buffer at most once (a list / non-f64
         # input would otherwise convert in the echo probe AND again on
         # the miss-path cast).
@@ -576,7 +612,8 @@ class PumiTally:
             origins = None
         else:
             origins = jnp.asarray(
-                self._owned(self._as_positions_cast(origins_h, size))
+                self._owned(self._as_positions_cast(origins_h, size,
+                                                    what="origins"))
             )
         dests = jnp.asarray(dests_host)
         n = self.num_particles
@@ -610,6 +647,8 @@ class PumiTally:
                 )
             # numpy pre-cast before transfer — see _as_positions_cast.
             w_cast = np.asarray(weights_np[:n], dtype=np.dtype(self.dtype))
+            if self.config.validate_inputs:
+                check_finite(w_cast, "weights")
             if (
                 self.config.auto_continue
                 and self._last_weights_host is not None

@@ -98,6 +98,12 @@ class TallyConfig:
     check_found_all: bool = True
     auto_continue: bool = True
     fenced_timing: bool = True
+    # Host-side np.isfinite check on staged positions and weights: a
+    # single NaN/Inf destination otherwise poisons the ENTIRE
+    # accumulated flux silently (scatter-add of nan — the reference's
+    # atomic_add has the same hole). ~1-2 ms per 500k-particle move on
+    # the host path; turn off only for maximum-rate trusted drivers.
+    validate_inputs: bool = True
     # "walk" reproduces the reference's localization exactly (walk from
     # the committed state — initially element 0's centroid,
     # PumiTallyImpl.cpp:195-221 — including the clamp-to-hull for

@@ -139,3 +139,88 @@ def test_overlay_tally_mesh_smaller_than_domain():
                          np.ones(n, np.int8), np.ones(n))
     total2 = float(np.asarray(t.flux).sum())
     np.testing.assert_allclose(total2, chord.sum() + n * 0.4, rtol=1e-9)
+
+
+def test_non_finite_inputs_rejected_before_staging():
+    """One NaN/Inf destination or weight silently poisons the WHOLE
+    accumulated flux (nan scatter-add — the reference's atomic_add has
+    the same hole); TallyConfig.validate_inputs (default on) refuses
+    such a batch BEFORE upload, keeping the committed state clean, and
+    the opt-out restores raw staging for trusted max-rate drivers."""
+    import pytest
+
+    from pumiumtally_tpu import PumiTally, StreamingTally, TallyConfig
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    n = 12
+    src = np.full((n, 3), 0.4) + np.arange(n)[:, None] * 0.01
+
+    t = PumiTally(mesh, n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    flux_before = np.asarray(t.flux).copy()
+    dest = src + 0.05
+    dest[3, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        t.MoveToNextLocation(src.reshape(-1).copy(),
+                             dest.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+    # The refusal happened before staging: committed flux unchanged.
+    np.testing.assert_array_equal(np.asarray(t.flux), flux_before)
+
+    good = src + 0.05
+    w = np.ones(n)
+    w[5] = np.inf
+    with pytest.raises(ValueError, match="weights"):
+        t.MoveToNextLocation(src.reshape(-1).copy(),
+                             good.reshape(-1).copy(),
+                             np.ones(n, np.int8), w)
+
+    # NaN source positions refused at initialization too.
+    bad_src = src.copy()
+    bad_src[0, 1] = np.inf
+    t2 = PumiTally(mesh, n)
+    with pytest.raises(ValueError, match="non-finite"):
+        t2.CopyInitialPosition(bad_src.reshape(-1).copy())
+
+    # Streaming facade shares the guard.
+    ts = StreamingTally(mesh, n, chunk_size=5)
+    ts.CopyInitialPosition(src.reshape(-1).copy())
+    with pytest.raises(ValueError, match="destinations"):
+        ts.MoveToNextLocation(None, dest.reshape(-1).copy())
+
+    # Opt-out: the unchecked path stages (flux may go nan — caller's
+    # choice), and must not hang.
+    t3 = PumiTally(mesh, n, TallyConfig(validate_inputs=False,
+                                        max_iters=200,
+                                        check_found_all=False))
+    t3.CopyInitialPosition(src.reshape(-1).copy())
+    t3.MoveToNextLocation(src.reshape(-1).copy(), dest.reshape(-1).copy(),
+                          np.ones(n, np.int8), np.ones(n))
+    assert not np.isfinite(np.asarray(t3.flux)).all()
+
+
+def test_f32_overflow_inputs_rejected_after_cast():
+    """A value finite in the caller's f64 buffer but inf after the
+    working-dtype (f32) cast must also be refused — the check runs
+    post-cast on both facades."""
+    import jax.numpy as jnp
+    import pytest
+
+    from pumiumtally_tpu import PumiTally, StreamingTally, build_box
+
+    mesh32 = build_box(1, 1, 1, 3, 3, 3, dtype=jnp.float32)
+    n = 8
+    src = np.full((n, 3), 0.4) + np.arange(n)[:, None] * 0.01
+    t = PumiTally(mesh32, n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    dest = src + 0.05
+    dest[2, 0] = 1e300  # finite f64, inf f32
+    with pytest.raises(ValueError, match="destinations"):
+        t.MoveToNextLocation(src.reshape(-1).copy(),
+                             dest.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+
+    ts = StreamingTally(mesh32, n, chunk_size=4)
+    ts.CopyInitialPosition(src.reshape(-1).copy())
+    with pytest.raises(ValueError, match="destinations"):
+        ts.MoveToNextLocation(None, dest.reshape(-1).copy())
