@@ -17,13 +17,58 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
+def test_dryrun_multichip_survives_sitecustomize_clobber(tmp_path):
+    """The driver's real environment: it sets JAX_PLATFORMS=cpu and an
+    8-device XLA_FLAGS — so the env LOOKS local-safe — but an
+    interpreter-startup hook (axon sitecustomize on PYTHONPATH) has
+    already imported jax and called
+    ``jax.config.update("jax_platforms", "axon,cpu")``, overriding the
+    env (the register/pjrt.py pattern). Rounds 2-4 died here: the
+    in-process branch trusted the env and dialed the device tunnel.
+    dryrun_multichip must detect the repointed config, fall to the
+    scrubbed subprocess, and succeed."""
+    hook_dir = tmp_path / "fake_axon_site"
+    hook_dir.mkdir()
+    (hook_dir / "sitecustomize.py").write_text(
+        # Faithful to the real hook: it does NOT touch the env var (the
+        # driver's JAX_PLATFORMS=cpu stays in place) — it imports jax
+        # and repoints jax.config, which is what wins at backend init.
+        "import os\n"
+        "if os.environ.get('PALLAS_AXON_POOL_IPS'):\n"
+        "    import jax\n"
+        "    try:\n"
+        "        jax.config.update('jax_platforms', 'axon,cpu')\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(hook_dir)
+    env["JAX_PLATFORMS"] = "cpu"  # the driver's (clobbered) intent
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # Blackhole tunnel: with no registered 'axon' plugin the clobbered
+    # config fails fast instead of hanging, which is still the red
+    # signal — the old code took the in-process branch and died there.
+    env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(2)"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK" in proc.stdout
+    # The detection must have routed around the poisoned in-process jax.
+    assert "spawning CPU-mesh child" in proc.stdout
+
+
+@pytest.mark.slow
 def test_dryrun_multichip_self_provisions_cpu_mesh():
     """dryrun_multichip must succeed from an environment that neither
     selects the CPU platform nor provides enough devices — the driver's
     situation — by re-executing itself onto a virtual CPU mesh. The
     tunnel env var is set to a value that would hang if any child
-    dialed it; the 240 s cap (vs the entry script's own 300 s child
-    budget) doubles as the wedge-proofing check."""
+    dialed it; the 240 s cap (comfortably above the entry script's own
+    120 s child fuse, so the script's diagnostic fires first) doubles
+    as the wedge-proofing check."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # not "cpu": forces the subprocess path
     env.pop("XLA_FLAGS", None)

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 on-chip suite: fired by tools/r5_probe_loop.sh the moment the
+# TPU tunnel answers. ORDER MATTERS (r4 lesson): the clean bench comes
+# first because it is known-good and gives the round a fresh headline;
+# the production-VMEM compile+measure goes LAST because its remote
+# compile request is the prime wedge suspect (r4's helper hung rather
+# than erroring).
+set -u
+OUT=/tmp/r5_onchip
+mkdir -p "$OUT"
+cd /root/repo
+echo "suite started $(date)" > "$OUT/status"
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$OUT/status"
+  mkdir -p /root/repo/tools/r5_onchip
+  cp "$OUT/$name.log" /root/repo/tools/r5_onchip/$name.log 2>/dev/null
+  cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
+}
+run bench_clean 2700 python bench.py
+run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$OUT/status"
+cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
