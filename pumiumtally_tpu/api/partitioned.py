@@ -44,9 +44,14 @@ class PartitionedPumiTally(PumiTally):
         t0 = time.perf_counter()
         mesh = self._init_common(mesh, num_particles, config)
         if self.device_mesh is None:
-            raise ValueError(
-                "PartitionedPumiTally requires TallyConfig.device_mesh"
-            )
+            # Single-device mode: mesh blocking without any multi-chip
+            # setup. With walk_vmem_max_elems set this sub-splits the
+            # whole mesh into VMEM-scale blocks on the one default
+            # device — the block-local walk (vmem or gather kernel)
+            # replaces the monolithic-table gather.
+            from pumiumtally_tpu.parallel import make_device_mesh
+
+            self.device_mesh = make_device_mesh(1)
         self.engine = PartitionedEngine(
             mesh,
             self.device_mesh,
@@ -59,6 +64,7 @@ class PartitionedPumiTally(PumiTally):
             cond_every=self.config.resolved_cond_every(),
             min_window=self.config.resolved_min_window(),
             vmem_walk_max_elems=self.config.walk_vmem_max_elems,
+            block_kernel=self.config.walk_block_kernel,
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

@@ -139,6 +139,27 @@ class StreamingTally(PumiTally):
             a = self._owned(a)
         return jnp.asarray(a)
 
+    def _prevalidate_narrow(self, dests_h, origins_h, w_h) -> None:
+        """Pre-dispatch working-dtype finite check for MoveToNextLocation
+        (see the call site): chunk-at-a-time casts, discarded after the
+        check, so a non-finite value anywhere in the batch raises before
+        ANY chunk dispatches. No-op in f64 mode (cast is identity; the
+        raw batch was checked at entry) or with validation off."""
+        if (not self.config.validate_inputs
+                or np.dtype(self.dtype) == np.float64):
+            return
+        dt = np.dtype(self.dtype)
+        for k in range(self.nchunks):
+            lo, hi = self._chunk_bounds(k)
+            check_finite(np.asarray(dests_h[3 * lo : 3 * hi], dtype=dt),
+                         "destinations", offset=3 * lo)
+            if origins_h is not None:
+                check_finite(np.asarray(origins_h[3 * lo : 3 * hi], dtype=dt),
+                             "origins", offset=3 * lo)
+            if w_h is not None:
+                check_finite(np.asarray(w_h[lo:hi], dtype=dt),
+                             "weights", offset=lo)
+
     def _stage_chunk_vec(self, host, k: int, dtype, fill,
                          what: Optional[str] = None) -> jnp.ndarray:
         lo, hi = self._chunk_bounds(k)
@@ -220,14 +241,24 @@ class StreamingTally(PumiTally):
         if self.config.validate_inputs and w_h is not None:
             check_finite(w_h[: self.num_particles], "weights")
 
+        # Pre-dispatch finite check in the working dtype (ADVICE r4):
+        # the narrow-dtype overflow corner (f64 input finite, f32 cast
+        # inf) used to raise from a mid-loop chunk stage AFTER earlier
+        # chunks had dispatched and tallied — a refused move left flux
+        # partially committed. Cast+check every chunk (discarding the
+        # cast) BEFORE any dispatch, so refusal is atomic like the
+        # monolithic facade's; the staging loop below then skips its
+        # per-chunk re-check (what=None). Costs one extra cast pass,
+        # only in validate+narrow mode, still chunk-at-a-time (the
+        # no-full-batch-copies property holds).
+        self._prevalidate_narrow(dests_h, None if echo else origins_h, w_h)
         retain = origins_h is not None and self._retain_echo_snapshots()
         oks = []
         dest_chunks = []
         for k in range(self.nchunks):
             # Stage chunk k, dispatch its walk, move on: dispatches are
             # async, so chunk k+1's staging overlaps chunk k's walk.
-            dest = self._stage_chunk_positions(dests_h, k, retain=retain,
-                                               what="destinations")
+            dest = self._stage_chunk_positions(dests_h, k, retain=retain)
             dest_chunks.append(dest)
             fly = (
                 jnp.ones((self.chunk_size,), jnp.int8)
@@ -237,8 +268,7 @@ class StreamingTally(PumiTally):
             w = (
                 jnp.ones((self.chunk_size,), self.dtype)
                 if w_h is None
-                else self._stage_chunk_vec(w_h, k, np.dtype(self.dtype),
-                                           0.0, what="weights")
+                else self._stage_chunk_vec(w_h, k, np.dtype(self.dtype), 0.0)
             )
             lo, hi = self._chunk_bounds(k)
             if hi - lo < self.chunk_size:  # pad slots never fly
@@ -250,8 +280,7 @@ class StreamingTally(PumiTally):
             elif echo:
                 orig = self._last_dests_dev[k]
             else:
-                orig = self._stage_chunk_positions(origins_h, k,
-                                                   what="origins")
+                orig = self._stage_chunk_positions(origins_h, k)
             oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
         if retain:
@@ -448,7 +477,12 @@ class StreamingPartitionedTally(StreamingTally):
         # could carry blocks the kernel cannot compile on hardware.
         from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
 
-        vmem_bound = effective_vmem_bound(self.config.walk_vmem_max_elems)
+        # The Mosaic scoped-VMEM clamp applies only to the vmem block
+        # kernel; the gather block kernel has no such ceiling.
+        if self.config.walk_block_kernel == "vmem":
+            vmem_bound = effective_vmem_bound(self.config.walk_vmem_max_elems)
+        else:
+            vmem_bound = self.config.walk_vmem_max_elems
         part = build_partition(mesh, per * derive_blocks_per_chip(
             mesh.nelems, per, vmem_bound
         ))
@@ -470,6 +504,7 @@ class StreamingPartitionedTally(StreamingTally):
                 cond_every=self.config.resolved_cond_every(),
                 min_window=self.config.resolved_min_window(),
                 vmem_walk_max_elems=vmem_bound,
+                block_kernel=self.config.walk_block_kernel,
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

@@ -161,6 +161,23 @@ class TallyConfig:
     # scoped-VMEM stack (the [w_tile, Lp] one-hot dominates at
     # 4·w_tile·Lp bytes). Keep the bound <= 2048 on current chips.
     walk_vmem_max_elems: Optional[int] = None
+    # Which kernel runs the per-block local walk when
+    # walk_vmem_max_elems sub-splits a chip's partition into
+    # blocks_per_chip > 1 blocks:
+    #   "vmem"   — the one-hot MXU Pallas kernel (above); requires the
+    #              float-table adjacency encoding and the Mosaic
+    #              scoped-VMEM ceiling (the bound clamps to <= 2048).
+    #   "gather" — the ownership-restricted HBM gather walk
+    #              (parallel/partition.py walk_local) run block-by-block
+    #              with lax.map: each step's [L,20] block table is small
+    #              enough to stay resident on-chip, capturing the
+    #              measured small-table gather speedup
+    #              (docs/PERF_NOTES.md round-4: 2.2-2.4M moves/s at
+    #              L<=3k vs ~1.1M on the monolithic 48k table) without
+    #              Pallas. No Mosaic ceiling, adjacency-sidecar meshes
+    #              supported, bitwise-comparable semantics to the
+    #              unblocked partitioned walk.
+    walk_block_kernel: str = "vmem"
     # StreamingPartitionedTally only: split the device mesh into this
     # many disjoint groups — chunks round-robin across them, so G
     # chunks transport concurrently (particle data parallelism across
@@ -208,6 +225,11 @@ class TallyConfig:
             raise ValueError(
                 f"walk_vmem_max_elems must be >= 1, "
                 f"got {self.walk_vmem_max_elems!r}"
+            )
+        if self.walk_block_kernel not in ("vmem", "gather"):
+            raise ValueError(
+                "walk_block_kernel must be 'vmem' or 'gather', "
+                f"got {self.walk_block_kernel!r}"
             )
 
     def resolved_min_window(self) -> int:

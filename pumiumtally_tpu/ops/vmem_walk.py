@@ -83,13 +83,41 @@ W_TILE_DEFAULT = 1024
 # tile the scoped-VMEM stack holds the [w_tile, Lp] one-hot through
 # Lp=2048; Lp=4096 exceeds the limit by ~9 MB. Engines clamp the
 # user's walk_vmem_max_elems to this on compiled-TPU backends
-# (interpret mode has no such ceiling).
+# (interpret mode has no such ceiling). The ceiling scales linearly
+# with per-core VMEM (the [w_tile, Lp] one-hot dominates), so chips
+# with more VMEM get a proportionally larger bound — see
+# _chip_vmem_ceiling (ADVICE r4: a v4/v5p with 32+ MB must not be
+# silently over-clamped into finer sub-splits).
 VMEM_FEASIBLE_MAX_ELEMS = 2048
+_VMEM_MEASURED_BYTES = 16 * 2**20  # the v5e core the sweep ran on
+
+
+def _chip_vmem_ceiling() -> int:
+    """VMEM_FEASIBLE_MAX_ELEMS scaled by the attached chip's per-core
+    VMEM. PUMIUMTALLY_VMEM_CEILING_ELEMS overrides outright (a new
+    chip generation can be measured and pinned without a code change).
+    Unknown chips keep the measured v5e value — clamping too fine is
+    migration overhead; not clamping is a compile failure."""
+    import os
+
+    env = os.environ.get("PUMIUMTALLY_VMEM_CEILING_ELEMS")
+    if env:
+        return int(env)
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — no backend: keep measured value
+        return VMEM_FEASIBLE_MAX_ELEMS
+    # Per-core VMEM by generation (public chip specs; conservative).
+    vmem = _VMEM_MEASURED_BYTES
+    if "v4" in kind or "v5p" in kind:
+        vmem = 32 * 2**20
+    scale = vmem // _VMEM_MEASURED_BYTES
+    return VMEM_FEASIBLE_MAX_ELEMS * max(1, int(scale))
 
 
 def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
     """The walk_vmem_max_elems value an engine may actually use:
-    clamped to the measured scoped-VMEM ceiling on compiled-TPU
+    clamped to the (chip-scaled) scoped-VMEM ceiling on compiled-TPU
     backends (a larger bound would die in Mosaic's allocator at first
     compile), untouched in interpret mode. EVERY path that derives a
     partition from the knob must clamp through here — clamping after
@@ -98,15 +126,18 @@ def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
     if bound is None:
         return None
     bound = int(bound)
-    if not backend_needs_interpret() and bound > VMEM_FEASIBLE_MAX_ELEMS:
+    if backend_needs_interpret():
+        return bound
+    ceiling = _chip_vmem_ceiling()
+    if bound > ceiling:
         from pumiumtally_tpu.utils.logging import get_logger
 
         get_logger().warning(
-            "walk_vmem_max_elems=%d exceeds the measured scoped-VMEM "
+            "walk_vmem_max_elems=%d exceeds the scoped-VMEM "
             "feasibility ceiling (%d) on this backend; clamping",
-            bound, VMEM_FEASIBLE_MAX_ELEMS,
+            bound, ceiling,
         )
-        return VMEM_FEASIBLE_MAX_ELEMS
+        return ceiling
     return bound
 
 

@@ -619,6 +619,7 @@ class PartitionedEngine:
         cond_every: int = COND_EVERY_DEFAULT,
         min_window: int = _MIN_WINDOW,
         vmem_walk_max_elems: Optional[int] = None,
+        block_kernel: str = "vmem",
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -650,9 +651,19 @@ class PartitionedEngine:
         # in Mosaic's scoped-VMEM allocator at first compile. Callers
         # that prebuild a partition (streaming) clamp through the same
         # helper before deriving it, so part= and the bound agree.
-        from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
+        # The gather block kernel has no Mosaic scoped-VMEM stack, so
+        # its block size is not clamped (the measured sweet spot is
+        # L<=~3k, above the vmem ceiling — docs/PERF_NOTES.md round 4).
+        if block_kernel not in ("vmem", "gather"):
+            raise ValueError(
+                f"block_kernel must be 'vmem' or 'gather', got "
+                f"{block_kernel!r}"
+            )
+        self.block_kernel = block_kernel
+        if block_kernel == "vmem":
+            from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
 
-        vmem_walk_max_elems = effective_vmem_bound(vmem_walk_max_elems)
+            vmem_walk_max_elems = effective_vmem_bound(vmem_walk_max_elems)
         if part is not None:
             self.part = part
             nparts = self.part.ndev  # build_partition's part count
@@ -669,9 +680,13 @@ class PartitionedEngine:
         self.nparts = nparts
         self.blocks_per_chip = nparts // self.ndev
         cap_b = int(-(-self.n // nparts) * capacity_factor + 1)
-        if self.blocks_per_chip > 1:
+        if self.blocks_per_chip > 1 and block_kernel == "vmem":
             # The blocked vmem kernel tiles each block's slot group:
-            # round the per-block capacity up to whole tiles.
+            # round the per-block capacity up to whole tiles. The
+            # gather block kernel only needs cap divisible by blocks
+            # (guaranteed by cap = blocks*cap_b) — tile-rounding it
+            # would inflate every block's lock-step walk with dead
+            # slots.
             from pumiumtally_tpu.ops.vmem_walk import W_TILE_DEFAULT
 
             cap_b = -(-cap_b // W_TILE_DEFAULT) * W_TILE_DEFAULT
@@ -684,18 +699,22 @@ class PartitionedEngine:
         self.cond_every = int(cond_every)
         self.min_window = int(min_window)
         self.use_vmem_walk = (
-            vmem_walk_max_elems is not None
+            block_kernel == "vmem"
+            and vmem_walk_max_elems is not None
             and self.part.L <= int(vmem_walk_max_elems)
             and self.part.adj_int is None
         )
-        if self.blocks_per_chip > 1 and not self.use_vmem_walk:
+        if self.blocks_per_chip > 1 and not self.use_vmem_walk and (
+            block_kernel != "gather"
+        ):
             raise ValueError(
-                "sub-split partitions (blocks_per_chip > 1) exist only "
-                "for the vmem walk, but this configuration cannot use "
-                "it (walk_vmem_max_elems unset/exceeded, or the mesh "
-                "needs the int-adjacency sidecar). Set a satisfiable "
-                "walk_vmem_max_elems, or pass a partition with one "
-                "part per device"
+                "sub-split partitions (blocks_per_chip > 1) with "
+                "block_kernel='vmem' need the VMEM walk, but this "
+                "configuration cannot use it (walk_vmem_max_elems "
+                "unset/exceeded, or the mesh needs the int-adjacency "
+                "sidecar). Set a satisfiable walk_vmem_max_elems, use "
+                "walk_block_kernel='gather', or pass a partition with "
+                "one part per device"
             )
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.nparts * self.part.L,), dtype)
@@ -925,6 +944,59 @@ class PartitionedEngine:
                     tally=tally, tol=tol, max_iters=max_iters,
                     blocks=blocks,
                 )
+            elif blocks > 1:
+                # Gather sub-split: run walk_local block-by-block with
+                # lax.map (sequential, NOT vmap — a batched gather over
+                # the stacked table would be the monolithic gather
+                # again). Each map step's [L,20] block table is a
+                # loop-invariant few hundred KB, so it stays resident
+                # on-chip for that block's whole while_loop — the
+                # measured small-table regime (2.2-2.4M moves/s at
+                # L<=3k, docs/PERF_NOTES.md round 4). Layout contract
+                # identical to the vmem sub-split: slots grouped by
+                # block, lelem block-local, flux [blocks*L].
+                ncap = x.shape[0]
+                cb = ncap // blocks
+                tb = table.reshape(blocks, part_L, table.shape[-1])
+
+                def one_block(args):
+                    if has_adj:
+                        (t_b, a_b, x_b, le_b, d_b, f_b, w_b, dn_b,
+                         ex_b, fx_b) = args
+                    else:
+                        (t_b, x_b, le_b, d_b, f_b, w_b, dn_b,
+                         ex_b, fx_b) = args
+                        a_b = None
+                    return walk_local(
+                        t_b, x_b, le_b, d_b, f_b, w_b, dn_b, ex_b, fx_b,
+                        tally=tally, tol=tol, max_iters=max_iters,
+                        adj_int=a_b, cond_every=cond_every,
+                        min_window=min_window,
+                    )
+
+                per_block = (
+                    (tb,) + ((adj.reshape(blocks, part_L, -1),)
+                             if has_adj else ())
+                    + (
+                        x.reshape(blocks, cb, 3),
+                        lelem.reshape(blocks, cb),
+                        dest.reshape(blocks, cb, 3),
+                        fly.reshape(blocks, cb),
+                        w.reshape(blocks, cb),
+                        done.reshape(blocks, cb),
+                        exited.reshape(blocks, cb),
+                        flux.reshape(blocks, part_L),
+                    )
+                )
+                xb, leb, dnb, exb, pb, fxb, _it = lax.map(
+                    one_block, per_block
+                )
+                x = xb.reshape(ncap, 3)
+                lelem = leb.reshape(ncap)
+                done = dnb.reshape(ncap)
+                exited = exb.reshape(ncap)
+                pending = pb.reshape(ncap)
+                flux = fxb.reshape(blocks * part_L)
             else:
                 x, lelem, done, exited, pending, flux, _ = walk_local(
                     table, x, lelem, dest, fly, w, done, exited, flux,

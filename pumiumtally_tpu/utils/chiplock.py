@@ -1,0 +1,75 @@
+"""Single-client interlock for the accelerator tunnel.
+
+The round-4 capture was contaminated: a verify-drive opened a second
+TPU client during bench.py's measurement window and the contended
+two_phase row read 0.963M moves/s vs 1.102M clean (docs/PERF_NOTES.md).
+Worse, a second client has historically wedged the tunnel outright.
+
+This is a cooperative flock(2) interlock every chip-touching tool takes
+around its device window:
+
+- ``bench.py`` holds it exclusively for the whole measurement;
+- drive/probe scripts take it (or skip, for probes) before dialing;
+- shell tools use ``flock <LOCK_PATH> cmd`` — same file, same
+  semantics.
+
+Reentrancy: a holder exports ``PUMIUMTALLY_CHIP_LOCK_HELD=1`` so its
+own subprocesses (bench's vmem child, e.g.) don't deadlock against the
+parent's lock. The lock protects a *window*, not correctness — a
+non-cooperating process can still dial the tunnel; the interlock makes
+the in-repo tools honest with each other.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+LOCK_PATH = os.environ.get(
+    "PUMIUMTALLY_CHIP_LOCK", "/tmp/pumiumtally_chip.lock"
+)
+_HELD_ENV = "PUMIUMTALLY_CHIP_LOCK_HELD"
+
+
+@contextmanager
+def chip_lock(timeout_s: float | None = None, *, blocking: bool = True):
+    """Acquire the accelerator window lock.
+
+    Yields True when the lock is held (or inherited from a parent
+    holder), False when ``blocking=False``/timeout expired and the lock
+    is busy — the caller decides whether to skip or proceed unlocked.
+    """
+    if os.environ.get(_HELD_ENV) == "1":
+        yield True  # parent already owns the window
+        return
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX: interlock degrades to a no-op
+        yield True
+        return
+    fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    acquired = False
+    try:
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                acquired = True
+                break
+            except OSError:
+                if not blocking or (
+                    deadline is not None and time.monotonic() >= deadline
+                ):
+                    break
+                time.sleep(1.0)
+        if acquired:
+            os.environ[_HELD_ENV] = "1"
+        try:
+            yield acquired
+        finally:
+            if acquired:
+                os.environ.pop(_HELD_ENV, None)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)

@@ -177,6 +177,9 @@ def test_stale_result_fallback(bench, monkeypatch, tmp_path, capsys):
     line = [l for l in out.out.splitlines() if l.startswith("{")][-1]
     rec = json.loads(line)
     assert rec["stale"] is True and rec["value"] == 123.0
+    # Distinct metric name (ADVICE r4): a consumer keying on
+    # metric/value alone must opt in to a cached number.
+    assert rec["metric"] == "particle_moves_per_sec_stale"
     assert "measured_at_utc" in rec and "stale_reason" in rec
     assert "STALE" in out.err
 

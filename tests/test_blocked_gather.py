@@ -1,0 +1,171 @@
+"""Gather-kernel sub-split (walk_block_kernel="gather").
+
+The blocked engine's second kernel: walk_local run block-by-block with
+lax.map, capturing the measured small-table gather regime
+(docs/PERF_NOTES.md round 4: 2.2-2.4M moves/s at L<=3k on chip vs ~1.1M
+on the monolithic 48k table) without Pallas/Mosaic constraints. Same
+layout contract as the vmem sub-split (slots grouped per block, lelem
+block-local, migration at block granularity), so parity against the
+unblocked engines is the whole correctness story.
+
+Reference semantics anchored the same way as the vmem tests: the walk
+is the reference's adjacency search (PumiTallyImpl.cpp:352-380), the
+sub-split is this port's TPU-native decomposition of it.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh
+from pumiumtally_tpu.parallel.partition import PartitionedEngine, build_partition
+
+
+def _workload(n, seed=5):
+    rng = np.random.default_rng(seed)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    # Some destinations exit the unit box: boundary clamp + exited
+    # bookkeeping must agree across engines too.
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), -0.1, 1.1)
+    return src, dst
+
+
+def test_single_device_gather_blocked_matches_plain_engine():
+    """PartitionedPumiTally with NO device_mesh runs on a default
+    1-device mesh; gather sub-split flux matches the monolithic engine
+    to f64 round-off."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)  # 1296 tets
+    n = 4000
+    src, dst = _workload(n)
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                           np.ones(n, np.int8), np.ones(n))
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(walk_vmem_max_elems=200, walk_block_kernel="gather",
+                    capacity_factor=3.0),
+    )
+    assert int(t.engine.device_mesh.devices.size) == 1
+    assert t.engine.blocks_per_chip > 1 and not t.engine.use_vmem_walk
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    np.testing.assert_allclose(
+        np.asarray(t.flux, np.float64), np.asarray(ref.flux, np.float64),
+        rtol=1e-10, atol=1e-13,
+    )
+
+
+def test_multichip_gather_blocked_matches_unblocked():
+    """8-chip mesh, sub-split with the gather kernel (vma checking stays
+    ON for this variant): flux, positions and conservation match the
+    unblocked partitioned engine."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)  # 1296 tets
+    n = 600
+    rng = np.random.default_rng(11)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    d2 = rng.uniform(0.05, 0.95, (n, 3))
+    out = []
+    for knob in (None, 40):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=make_device_mesh(8),
+                        capacity_factor=8.0,
+                        walk_vmem_max_elems=knob,
+                        walk_block_kernel="gather"),
+        )
+        if knob is None:
+            assert t.engine.blocks_per_chip == 1
+        else:
+            assert t.engine.blocks_per_chip == 5
+            assert not t.engine.use_vmem_walk
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())
+        t.MoveToNextLocation(None, d2.reshape(-1).copy())
+        out.append((np.asarray(t.flux, np.float64), t.positions))
+    np.testing.assert_allclose(out[0][0], out[1][0], rtol=1e-10, atol=1e-13)
+    np.testing.assert_allclose(out[0][1], out[1][1], rtol=1e-12, atol=1e-12)
+    expect = (np.linalg.norm(d1 - src, axis=1)
+              + np.linalg.norm(d2 - d1, axis=1)).sum()
+    np.testing.assert_allclose(out[1][0].sum(), expect, rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_gather_blocked_supports_adj_sidecar():
+    """Unlike the vmem kernel, the gather block kernel accepts
+    partitions carrying the int-adjacency sidecar (ids too large for
+    the float table) — the configuration the vmem gate rejects."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 600
+    src, dst = _workload(n, seed=13)
+    dm = make_device_mesh(8)
+    part = build_partition(mesh, 40, force_split_adj=True)
+    assert part.adj_int is not None
+    eng = PartitionedEngine(
+        mesh, dm, n, capacity_factor=8.0, tol=1e-8, max_iters=4096,
+        part=part, block_kernel="gather",
+    )
+    assert eng.blocks_per_chip == 5 and not eng.use_vmem_walk
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                           np.ones(n, np.int8), np.ones(n))
+    import jax.numpy as jnp
+
+    eng.localize(jnp.asarray(src))
+    eng.move(jnp.asarray(src), jnp.asarray(dst),
+             jnp.ones(n, jnp.int8), jnp.ones(n))
+    np.testing.assert_allclose(
+        np.asarray(eng.flux_original(), np.float64),
+        np.asarray(ref.flux, np.float64), rtol=1e-10, atol=1e-13,
+    )
+
+
+def test_vmem_kernel_gate_unchanged_and_config_validates():
+    """Default block kernel stays 'vmem' with its existing hard gate;
+    bad kernel names are rejected at config construction."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    with pytest.raises(ValueError, match="walk_block_kernel"):
+        TallyConfig(walk_block_kernel="mxu")
+    # vmem kernel + adj sidecar + sub-split still raises (the gather
+    # fallback must be explicit, not silent).
+    part = build_partition(mesh, 40, force_split_adj=True)
+    with pytest.raises(ValueError, match="sub-split"):
+        PartitionedEngine(
+            mesh, make_device_mesh(8), 100, capacity_factor=8.0,
+            tol=1e-8, max_iters=64, part=part,
+            vmem_walk_max_elems=40,
+        )
+
+
+@pytest.mark.slow
+def test_gather_blocked_streaming_partitioned():
+    """dp x part hybrid with the gather block kernel conserves."""
+    from pumiumtally_tpu import StreamingPartitionedTally
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)  # 384 tets
+    n = 400
+    rng = np.random.default_rng(12)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    d1 = rng.uniform(0.05, 0.95, (n, 3))
+    t = StreamingPartitionedTally(
+        mesh, n, chunk_size=200,
+        config=TallyConfig(device_mesh=make_device_mesh(8),
+                           capacity_factor=8.0,
+                           walk_vmem_max_elems=20,
+                           walk_block_kernel="gather"),
+    )
+    for e in t.engines:
+        assert e.blocks_per_chip == 3 and not e.use_vmem_walk
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, d1.reshape(-1).copy())
+    got = float(np.asarray(t.flux, np.float64).sum())
+    want = float(np.linalg.norm(d1 - src, axis=1).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)

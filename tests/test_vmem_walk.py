@@ -355,6 +355,29 @@ def test_vmem_bound_clamped_on_compiled_backends(monkeypatch, caplog):
     assert t.engine.use_vmem_walk
 
 
+def test_vmem_ceiling_keys_on_chip(monkeypatch):
+    """The feasibility ceiling scales with the attached chip's VMEM
+    (ADVICE r4: v4/v5p's 32 MB must not be over-clamped to the v5e
+    bound) and PUMIUMTALLY_VMEM_CEILING_ELEMS overrides outright."""
+    import pumiumtally_tpu.ops.vmem_walk as vw
+
+    monkeypatch.setattr(vw, "backend_needs_interpret", lambda: False)
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    for kind, want in (("TPU v5 lite", 2048), ("TPU v4", 4096),
+                       ("TPU v5p", 4096), ("weird-chip", 2048)):
+        monkeypatch.setattr(
+            vw.jax, "devices", lambda _k=kind: [_Dev(_k)]
+        )
+        assert vw.effective_vmem_bound(100_000) == want, kind
+    monkeypatch.setenv("PUMIUMTALLY_VMEM_CEILING_ELEMS", "512")
+    assert vw.effective_vmem_bound(100_000) == 512
+    assert vw.effective_vmem_bound(300) == 300  # under-ceiling untouched
+
+
 @pytest.mark.slow
 def test_multichip_tpu_programs_compile_chipless():
     """The FULL partitioned phase programs — shard_map over a 4-chip

@@ -222,5 +222,23 @@ def test_f32_overflow_inputs_rejected_after_cast():
 
     ts = StreamingTally(mesh32, n, chunk_size=4)
     ts.CopyInitialPosition(src.reshape(-1).copy())
+    flux_before = np.asarray(ts.flux, np.float64).copy()
     with pytest.raises(ValueError, match="destinations"):
         ts.MoveToNextLocation(None, dest.reshape(-1).copy())
+    # Atomic refusal (ADVICE r4): the bad value sits in chunk 0 of 2,
+    # but even a bad value in a LATER chunk must not leave earlier
+    # chunks' flux committed — the pre-dispatch validation pass checks
+    # every chunk before any dispatch.
+    np.testing.assert_array_equal(
+        np.asarray(ts.flux, np.float64), flux_before
+    )
+    dest2 = src + 0.05
+    dest2[n - 1, 0] = 1e300  # bad value in the LAST chunk
+    with pytest.raises(ValueError, match="destinations"):
+        ts.MoveToNextLocation(None, dest2.reshape(-1).copy())
+    np.testing.assert_array_equal(
+        np.asarray(ts.flux, np.float64), flux_before
+    )
+    # The engine is not poisoned: a clean follow-up move still works.
+    ts.MoveToNextLocation(None, (src + 0.05).reshape(-1).copy())
+    assert float(np.asarray(ts.flux, np.float64).sum()) > 0.0

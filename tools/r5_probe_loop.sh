@@ -5,15 +5,29 @@
 set -u
 OUT=/root/repo/tools/r5_onchip
 mkdir -p "$OUT"
+LOCK=${PUMIUMTALLY_CHIP_LOCK:-/tmp/pumiumtally_chip.lock}
 N=0
 while true; do
   N=$((N + 1))
-  if timeout 150 python -c "import jax, jax.numpy as jnp; print(float(jnp.sum(jnp.ones(8))))" >>"$OUT/probe.log" 2>&1; then
-    echo "probe $N OK $(date) — firing suite" >> "$OUT/probe.log"
-    bash /root/repo/tools/r5_onchip_suite.sh
+  # Single-client interlock (utils/chiplock.py): ONE lock acquisition
+  # (bounded wait — never block for another holder's whole window)
+  # covering probe AND suite, so the window cannot be stolen between
+  # them. Inner rc: 0 = suite ran, 3 = probe failed, 4 = lock busy.
+  flock -w 30 "$LOCK" bash -c '
+    if timeout 150 python -c "import jax, jax.numpy as jnp; print(float(jnp.sum(jnp.ones(8))))" >>'"$OUT"'/probe.log 2>&1; then
+      echo "probe OK $(date) — firing suite" >> '"$OUT"'/probe.log
+      PUMIUMTALLY_CHIP_LOCK_HELD=1 bash /root/repo/tools/r5_onchip_suite.sh
+      exit 0
+    fi
+    exit 3'
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "suite complete $(date)" >> "$OUT/probe.log"
     exit 0
+  elif [ "$rc" -eq 3 ]; then
+    echo "probe $N failed $(date)" >> "$OUT/probe.log"
+  else
+    echo "probe $N skipped (chip lock busy, rc=$rc) $(date)" >> "$OUT/probe.log"
   fi
-  echo "probe $N failed $(date)" >> "$OUT/probe.log"
   sleep 600
 done

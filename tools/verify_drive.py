@@ -3,8 +3,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from contextlib import ExitStack
+
 import numpy as np
+
 from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+from pumiumtally_tpu.utils.chiplock import chip_lock
+
+# Single-client interlock: a verify-drive inside bench's measurement
+# window contaminated the round-4 capture (docs/PERF_NOTES.md). Wait
+# for the window instead of contending for the chip.
+_stack = ExitStack()
+if not _stack.enter_context(chip_lock(timeout_s=1800)):
+    print("chip lock busy for 30 min; running anyway", file=sys.stderr)
 
 N = 10_000
 mesh = build_box(1, 1, 1, 10, 10, 10)
