@@ -482,6 +482,7 @@ class PumiTally:
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
+        self._xpoint_stash = None  # xpoints reset to the new positions
         dest = self._as_positions(init_particle_positions, size)
         found_all, n_exited = self._dispatch_localize(dest)
         if self.config.check_found_all:
@@ -693,6 +694,10 @@ class PumiTally:
         w = self._pad_particles(w, jnp.zeros((self._cap,), self.dtype))
         if origins is not None:
             origins = self._pad_particles(origins, self.x)
+        if self.config.record_xpoints:
+            # Pre-move committed state + staged inputs: everything
+            # intersection_points() needs to replay this move.
+            self._xpoint_stash = (self.x, self.elem, origins, dests, fly)
         if self.device_mesh is not None:
             from pumiumtally_tpu.parallel.sharded import (
                 sharded_move_step,
@@ -759,3 +764,64 @@ class PumiTally:
         """Committed particle positions (reference particle origin
         segment get<0>, post-search)."""
         return np.asarray(self.x)[: self.num_particles]
+
+    def intersection_points(self) -> np.ndarray:
+        """Each particle's last face-intersection point — the
+        reference's ``getIntersectionPoints()`` white-box debug surface
+        (PumiTallyImpl.h:177-178; test:464-467). Requires
+        ``TallyConfig.record_xpoints=True``.
+
+        Before any move (or for particles that crossed no face in the
+        last move) this is the particle's starting position, matching
+        the reference's ``UpdatePreviousXPoints(ptcls)`` initialization.
+        The production walk's s-parametrization discards per-crossing
+        positions, so this accessor REPLAYS the last move's transport
+        with an uncompacted recording walk (ops/walk.py walk_xpoints) —
+        an inspection path, not a hot path.
+        """
+        if not self.config.record_xpoints:
+            raise RuntimeError(
+                "intersection_points() needs TallyConfig.record_xpoints="
+                "True (the facade does not retain move inputs otherwise)"
+            )
+        if not self.is_initialized:
+            raise RuntimeError(
+                "CopyInitialPosition must be called before "
+                "intersection_points()"
+            )
+        from pumiumtally_tpu.ops.walk import walk_xpoints
+
+        if type(self)._dispatch_move is not PumiTally._dispatch_move or (
+            type(self).MoveToNextLocation is not PumiTally.MoveToNextLocation
+        ):
+            # A subclass routing moves through its own engine never
+            # populates the stash — returning start positions as
+            # "intersection points" would be silently wrong data.
+            raise NotImplementedError(
+                f"intersection_points() is implemented for the "
+                f"monolithic/sharded PumiTally facade only, not "
+                f"{type(self).__name__}"
+            )
+        stash = getattr(self, "_xpoint_stash", None)
+        if stash is None:
+            return self.positions  # no move yet: xpoints = start points
+        x0, e0, origins, dests, fly = stash
+        if origins is not None:
+            # Phase A relocation: recover the phase-B start state —
+            # skipped when it would walk zero distance (move_step's own
+            # trivial-skip; the common origins-echo case). The replay
+            # records only phase-B crossings; in the reference a
+            # NON-trivial phase A would also touch inter_points, but
+            # phase A normally walks zero distance (origins echo the
+            # committed positions), where the two agree exactly.
+            dest_a = jnp.where((fly == 1)[:, None], origins, x0)
+            if not bool(jnp.all(dest_a == x0)):
+                x0, e0, _, _ = _localize_step(
+                    self.mesh, x0, e0, dest_a, tol=self._tol,
+                    max_iters=self._max_iters, walk_kw=self._walk_kw,
+                )
+        xp = walk_xpoints(
+            self.mesh, x0, e0, dests, fly,
+            tol=self._tol, max_iters=self._max_iters,
+        )
+        return np.asarray(xp)[: self.num_particles]

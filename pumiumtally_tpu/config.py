@@ -178,6 +178,14 @@ class TallyConfig:
     #              supported, bitwise-comparable semantics to the
     #              unblocked partitioned walk.
     walk_block_kernel: str = "vmem"
+    # Debug surface (reference getIntersectionPoints(),
+    # PumiTallyImpl.h:177-178): when True the monolithic facade keeps
+    # the staged inputs of the last move so
+    # ``PumiTally.intersection_points()`` can replay the transport and
+    # return each particle's last face-intersection point. Off by
+    # default: the stash pins ~4 extra [n]-shaped device arrays and the
+    # accessor's replay walk is an uncompacted inspection pass.
+    record_xpoints: bool = False
     # StreamingPartitionedTally only: split the device mesh into this
     # many disjoint groups — chunks round-robin across them, so G
     # chunks transport concurrently (particle data parallelism across

@@ -183,6 +183,34 @@ def _gather_walk_row(mesh: TetMesh, elem: jnp.ndarray):
     return mesh.face_normals[elem], mesh.face_offsets[elem], mesh.face_adj[elem]
 
 
+def _advance_geometry(mesh, s, elem, dest, d0, tol, one):
+    """The per-step crossing geometry shared by ``walk`` and the
+    ``walk_xpoints`` debug replay — ONE definition so the replay can
+    never diverge from the transport it reconstructs.
+
+    Both ray projections are against walk-constant vectors
+    (x0 = dest − d0, so off − n·x0 = off − n·dest + n·d0). The crossing
+    predicate tests the REMAINING segment (n·d_rem > tol), matching the
+    reference's per-step test exactly; the max(s) clamp keeps a
+    committed point that sits epsilon-outside a face from stepping
+    backwards. ``reached`` covers a destination inside the current tet
+    and the no-forward-crossing corner (zero-length segment)."""
+    fn, fo, adj = _gather_walk_row(mesh, elem)
+    both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
+    a = both[..., 0]  # n·d0
+    b = fo - both[..., 1] + a  # off − n·x0
+    crossing = a * (one - s)[:, None] > tol
+    s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+    s_f = jnp.maximum(s_f, s[:, None])
+    s_exit = jnp.min(s_f, axis=1)
+    f_exit = jnp.argmin(s_f, axis=1)
+    reached = s_exit >= one
+    s_new = jnp.where(reached, one, s_exit)
+    next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
+    hit_boundary = (~reached) & (next_elem == -1)
+    return s_new, reached, next_elem, hit_boundary
+
+
 def walk(
     mesh: TetMesh,
     x: jnp.ndarray,
@@ -256,27 +284,9 @@ def walk(
         pair (element indexed, contribution) — the caller decides how
         to scatter (per iteration, or fused across an unrolled group)."""
         active = ~done
-        fn, fo, adj = _gather_walk_row(mesh, elem)
-        # Both ray projections are against walk-constant vectors
-        # (x0 = dest − d0, so off − n·x0 = off − n·dest + n·d0).
-        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
-        a = both[..., 0]  # n·d0
-        b = fo - both[..., 1] + a  # off − n·x0
-        # Crossing predicate on the REMAINING segment (n·d_rem > tol),
-        # matching the reference's per-step test exactly.
-        crossing = a * (one - s)[:, None] > tol
-        s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
-        # The committed point may sit epsilon-outside a face; don't
-        # step backwards.
-        s_f = jnp.maximum(s_f, s[:, None])
-        s_exit = jnp.min(s_f, axis=1)
-        f_exit = jnp.argmin(s_f, axis=1)
-        # Destination inside the current tet (or no forward crossing at
-        # all, e.g. zero-length segment) → done at dest.
-        reached = s_exit >= one
-        s_new = jnp.where(reached, one, s_exit)
-        next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
-        hit_boundary = (~reached) & (next_elem == -1)
+        s_new, reached, next_elem, hit_boundary = _advance_geometry(
+            mesh, s, elem, dest, d0, tol, one
+        )
 
         if tally:
             contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
@@ -455,3 +465,59 @@ def walk(
         x=x_fin[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
         flux=flux, iters=it,
     )
+
+
+def walk_xpoints(
+    mesh: TetMesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    dest: jnp.ndarray,
+    in_flight: jnp.ndarray,
+    *,
+    tol: float,
+    max_iters: int,
+) -> jnp.ndarray:
+    """Replay a transport and return each particle's LAST
+    face-intersection point — the reference's white-box debug surface
+    (``getIntersectionPoints()``, PumiTallyImpl.h:177-178: the
+    ``inter_points`` buffer holds the location of the last intersected
+    face, initialized to the particle's starting position, and is
+    updated at every crossing including the boundary exit).
+
+    A particle that reaches its destination inside its starting element
+    (or does not fly) keeps its starting position as its xpoint. No
+    tally, no compaction — this is an inspection path, not a hot path;
+    the production walk's s-parametrization deliberately discards the
+    per-crossing positions this reconstructs.
+    """
+    fdtype = x.dtype
+    one = jnp.asarray(1.0, fdtype)
+    is_flying = in_flight[:, None] == 1
+    dest = jnp.where(is_flying, dest, x)  # stopped -> hold
+    d0 = dest - x
+    s0 = jnp.zeros((x.shape[0],), fdtype)
+    done0 = in_flight != in_flight
+
+    def cond(state):
+        it, _s, _elem, done, _sc = state
+        return (it < max_iters) & jnp.any(~done)
+
+    def body(state):
+        it, s, elem, done, s_cross = state
+        active = ~done
+        s_new, reached, next_elem, hit_boundary = _advance_geometry(
+            mesh, s, elem, dest, d0, tol, one
+        )
+        # A face was intersected this step (interior crossing OR the
+        # boundary exit) -> record its location's ray coordinate.
+        s_cross = jnp.where(active & ~reached, s_new, s_cross)
+        moving = active & ~reached & ~hit_boundary
+        elem = jnp.where(moving, next_elem, elem)
+        s = jnp.where(active, s_new, s)
+        done = done | reached | hit_boundary
+        return it + 1, s, elem, done, s_cross
+
+    _it, _s, _elem, _done, s_cross = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), s0, elem, done0, s0)
+    )
+    return x + s_cross[:, None] * d0

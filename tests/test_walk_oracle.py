@@ -199,3 +199,74 @@ def test_conservation_invariant_under_rigid_transform():
         np.testing.assert_allclose(fl.sum(), expect, rtol=1e-9)
     # per-element flux identical up to FP rounding of the rotation
     np.testing.assert_allclose(fluxes[0], fluxes[1], rtol=2e-7, atol=1e-10)
+
+
+def test_intersection_points_debug_surface():
+    """Reference getIntersectionPoints() parity (PumiTallyImpl.h:177-178,
+    test:464-467): the last face-intersection point per particle, using
+    the same 6-tet geometry as the flux oracle. On the oracle ray
+    (0.1,0.4,0.5)->(1.2,...) the walk crosses faces at x=0.4 and x=0.5
+    and exits the boundary at x=1.0 — the LAST intersection is the
+    boundary point. A shorter ray to x=0.45 (inside elem 3) last
+    crosses at x=0.4; a no-crossing move keeps the start point; a
+    non-flying particle keeps its position."""
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    t = PumiTally(mesh, NUM, TallyConfig(record_xpoints=True))
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    t.CopyInitialPosition(_flat(init), 3 * NUM)
+    # Before any move: xpoints == starting positions (the reference's
+    # UpdatePreviousXPoints(ptcls) initialization).
+    np.testing.assert_allclose(t.intersection_points(), init, atol=TOL)
+
+    # Oracle move 1: exits the box at x=1.0 -> boundary intersection.
+    dests = np.tile([1.2, 0.4, 0.5], (NUM, 1))
+    t.MoveToNextLocation(_flat(init), _flat(dests),
+                         np.ones(NUM, np.int8), np.ones(NUM))
+    np.testing.assert_allclose(
+        t.intersection_points(), np.tile([1.0, 0.4, 0.5], (NUM, 1)),
+        atol=TOL,
+    )
+
+    # Fresh engine: ray stopping inside elem 3 -> last crossing x=0.4.
+    t2 = PumiTally(mesh, NUM, TallyConfig(record_xpoints=True))
+    t2.CopyInitialPosition(_flat(init), 3 * NUM)
+    half = np.tile([0.45, 0.4, 0.5], (NUM, 1))
+    t2.MoveToNextLocation(_flat(init), _flat(half),
+                          np.ones(NUM, np.int8), np.ones(NUM))
+    np.testing.assert_allclose(
+        t2.intersection_points(), np.tile([0.4, 0.4, 0.5], (NUM, 1)),
+        atol=TOL,
+    )
+    # Continue-mode micro-move inside the current tet: no face crossed,
+    # xpoints fall back to the move's start points.
+    tiny = half + np.tile([0.001, 0.0, 0.0], (NUM, 1))
+    t2.MoveToNextLocation(None, _flat(tiny))
+    np.testing.assert_allclose(t2.intersection_points(), half, atol=TOL)
+    # Non-flying particles hold position and record no crossing.
+    fly = np.ones(NUM, np.int8)
+    fly[0] = 0
+    far = np.tile([0.9, 0.4, 0.5], (NUM, 1))
+    t2.MoveToNextLocation(_flat(tiny), _flat(far), fly, np.ones(NUM))
+    xp = t2.intersection_points()
+    np.testing.assert_allclose(xp[0], tiny[0], atol=TOL)
+    np.testing.assert_allclose(xp[1:], np.tile([0.5, 0.4, 0.5], (NUM - 1, 1)),
+                               atol=TOL)
+
+    # Off by default: the facade must refuse rather than silently
+    # return stale data.
+    t3 = PumiTally(mesh, NUM)
+    t3.CopyInitialPosition(_flat(init), 3 * NUM)
+    with pytest.raises(RuntimeError, match="record_xpoints"):
+        t3.intersection_points()
+    # Subclasses route moves through their own engines and never
+    # populate the stash — they must refuse too, not return stale data.
+    from pumiumtally_tpu import PartitionedPumiTally, StreamingTally
+
+    t4 = PartitionedPumiTally(mesh, NUM, TallyConfig(record_xpoints=True))
+    t4.CopyInitialPosition(_flat(init), 3 * NUM)
+    with pytest.raises(NotImplementedError, match="PartitionedPumiTally"):
+        t4.intersection_points()
+    t5 = StreamingTally(mesh, NUM, 4, TallyConfig(record_xpoints=True))
+    t5.CopyInitialPosition(_flat(init), 3 * NUM)
+    with pytest.raises(NotImplementedError, match="StreamingTally"):
+        t5.intersection_points()

@@ -75,6 +75,11 @@ def main(n: int) -> int:
         # Sub-split: blocks_per_chip > 1, grid (blocks, tiles).
         ("partitioned vmem sub-split phase",
          {"vmem_walk_max_elems": 256}),
+        # Gather sub-split (r5 headline bet): lax.map over per-block
+        # walk_local inside shard_map — pure XLA, but must be proven
+        # against the real TPU pipeline before the bench window.
+        ("partitioned gather sub-split phase",
+         {"vmem_walk_max_elems": 256, "block_kernel": "gather"}),
     ):
         try:
             eng = PartitionedEngine(
