@@ -12,8 +12,9 @@ while true; do
   # Single-client interlock (utils/chiplock.py): ONE lock acquisition
   # (bounded wait — never block for another holder's whole window)
   # covering probe AND suite, so the window cannot be stolen between
-  # them. Inner rc: 0 = suite ran, 3 = probe failed, 4 = lock busy.
-  flock -w 30 "$LOCK" bash -c '
+  # them. rc: 0 = suite ran, 3 = probe failed, 4 = lock busy (-E 4),
+  # anything else = broken probe command (logged distinctly).
+  flock -w 30 -E 4 "$LOCK" bash -c '
     if timeout 150 python -c "import jax, jax.numpy as jnp; print(float(jnp.sum(jnp.ones(8))))" >>'"$OUT"'/probe.log 2>&1; then
       echo "probe OK $(date) — firing suite" >> '"$OUT"'/probe.log
       PUMIUMTALLY_CHIP_LOCK_HELD=1 bash /root/repo/tools/r5_onchip_suite.sh
@@ -26,8 +27,10 @@ while true; do
     exit 0
   elif [ "$rc" -eq 3 ]; then
     echo "probe $N failed $(date)" >> "$OUT/probe.log"
+  elif [ "$rc" -eq 4 ]; then
+    echo "probe $N skipped (chip lock busy) $(date)" >> "$OUT/probe.log"
   else
-    echo "probe $N skipped (chip lock busy, rc=$rc) $(date)" >> "$OUT/probe.log"
+    echo "probe $N BROKEN (rc=$rc — probe command itself failed) $(date)" >> "$OUT/probe.log"
   fi
   sleep 600
 done
