@@ -682,13 +682,20 @@ def _measure_and_report() -> None:
                 "continue_moves_per_sec (monolithic), still reported"
             ),
             "tuning": (
-                "box workloads used autotuned_knobs (since r3); "
-                "pincell_moves_per_sec and the CPU baseline stay on "
-                "defaults (longitudinal); pincell_tuned (since r5) "
-                "autotunes on the pincell mesh itself"
-                if tuned_knobs()
-                else "autotune off/failed/default-equal: ALL workloads "
-                     "ran default knobs this round"
+                (
+                    "box workloads used autotuned_knobs (since r3); "
+                    "pincell_moves_per_sec and the CPU baseline stay "
+                    "on defaults (longitudinal)"
+                    if tuned_knobs()
+                    else "box autotune off/failed/default-equal: box "
+                         "workloads ran default knobs"
+                )
+                + (
+                    "; pincell_tuned (since r5) autotuned on the "
+                    "pincell mesh itself (knobs recorded in the row)"
+                    if pincell_tuned is not None
+                    else "; pincell_tuned row absent this run"
+                )
             ),
         },
         "link_mb_per_sec": link_mb_s,

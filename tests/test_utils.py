@@ -157,6 +157,43 @@ def test_checkpoint_restores_into_subsplit_engine(tmp_path):
     np.testing.assert_array_equal(t2.elem_ids, t.elem_ids)
 
 
+def test_checkpoint_restores_into_gather_blocked_engine(tmp_path):
+    """Same block-granular restore contract for the GATHER sub-split
+    (walk_block_kernel='gather', single-device default mesh): restore
+    from a monolithic checkpoint, then both engines must stay in
+    lockstep through a further move."""
+    from pumiumtally_tpu import PartitionedPumiTally
+
+    n = 600
+    mesh_args = (1, 1, 1, 4, 4, 4)
+    rng = np.random.default_rng(10)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), _LO, _HI)
+    t = PumiTally(build_box(*mesh_args), n)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    ckpt = str(tmp_path / "g.npz")
+    save_tally_state(t, ckpt)
+
+    t2 = PartitionedPumiTally(
+        build_box(*mesh_args), n,
+        TallyConfig(capacity_factor=4.0, walk_vmem_max_elems=40,
+                    walk_block_kernel="gather"),
+    )
+    assert t2.engine.blocks_per_chip > 1 and not t2.engine.use_vmem_walk
+    load_tally_state(t2, ckpt)
+    np.testing.assert_allclose(
+        np.asarray(t2.flux), np.load(ckpt)["flux"], atol=1e-14
+    )
+    dst2 = np.clip(dst - 0.15, _LO, _HI)
+    t.MoveToNextLocation(None, dst2.reshape(-1).copy())
+    t2.MoveToNextLocation(None, dst2.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(t2.flux), np.asarray(t.flux), rtol=1e-11, atol=1e-12
+    )
+    np.testing.assert_array_equal(t2.elem_ids, t.elem_ids)
+
+
 def test_checkpoint_mismatch_raises(tmp_path):
     t = _driven_tally()
     ckpt = str(tmp_path / "state.npz")

@@ -1,0 +1,122 @@
+"""Gather-blocked engine A/B on the bench box and the 1M-tet lattice.
+
+The r5 headline bet (walk_block_kernel="gather", docs/PERF_NOTES.md):
+per-block tables stay on-chip, reproducing the measured small-table
+gather regime (2.2-2.4M moves/s at L<=3k vs ~1.1M monolithic on the
+48k-tet box). This experiment measures, on whatever backend is
+attached:
+
+  - monolithic continue-mode rate (the r4 headline protocol);
+  - gather-blocked continue-mode rate at a few block-size bounds;
+  - the same pair on the ~1M-tet assembly lattice (BASELINE config 2),
+    where the monolithic walk table (~86 MB) dwarfs VMEM and blocking
+    is the only way any table locality exists at all.
+
+Usage: python tools/exp_r5_blocked.py [n_particles] [moves]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    TallyConfig,
+    build_box,
+)
+
+MEAN_STEP = 0.25
+
+
+def drive(t, pts, moves) -> float:
+    """bench.timed_moves-shaped scaffold (warmup move, scalar-fetch
+    sync, conservation over ALL moves) — NOT bench.timed_moves itself:
+    that one sys.exit(1)s on a conservation miss, while this experiment
+    must contain a single row's failure and keep sweeping the scarce
+    chip window (AssertionError is caught per row in run_mesh)."""
+    n = pts[0].shape[0]
+    t.CopyInitialPosition(pts[0].reshape(-1).copy())
+    t.MoveToNextLocation(None, pts[1].reshape(-1).copy())  # warmup/compile
+    float(jnp.sum(t.flux))
+    t0 = time.perf_counter()
+    for m in range(2, moves + 2):
+        t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+    total = float(np.float64(jnp.sum(t.flux)))
+    dt = time.perf_counter() - t0
+    expect = sum(
+        float(np.linalg.norm(pts[m] - pts[m - 1], axis=1).sum())
+        for m in range(1, moves + 2)
+    )
+    rel = abs(total - expect) / expect
+    assert rel < 1e-6, f"conservation off: {rel:.2e}"
+    return n * moves / dt
+
+
+def run_mesh(label, mesh, n, moves, bounds, capf=2.0) -> None:
+    from pumiumtally_tpu.utils.autotune import _workload
+
+    # The shared bbox-scaled bench-shaped trajectory (one generator for
+    # bench/autotune/experiments); f64 on the host so the conservation
+    # expectation is exact in the accumulation dtype.
+    pts = [np.asarray(p, np.float64)
+           for p in _workload(mesh, n, moves, MEAN_STEP, 0)]
+    try:
+        t = PumiTally(mesh, n, TallyConfig(check_found_all=False,
+                                           fenced_timing=False))
+        r = drive(t, pts, moves)
+        print(f"{label} monolithic: {r / 1e6:.2f}M moves/s", flush=True)
+        del t
+    except Exception as e:  # noqa: BLE001 — baseline must not cost the sweep
+        print(f"{label} monolithic FAILED: "
+              f"{type(e).__name__}: {str(e)[:500]}", flush=True)
+    for bound in bounds:
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(capacity_factor=capf, walk_vmem_max_elems=bound,
+                        walk_block_kernel="gather",
+                        check_found_all=False, fenced_timing=False),
+        )
+        try:
+            r = drive(t, pts, moves)
+            print(f"{label} gather-blocked L<={bound} "
+                  f"({t.engine.blocks_per_chip} blocks, "
+                  f"L={t.engine.part.L}, "
+                  f"rounds={t.engine.last_walk_rounds}): "
+                  f"{r / 1e6:.2f}M moves/s", flush=True)
+        except Exception as e:  # noqa: BLE001 — report and continue sweep
+            print(f"{label} gather-blocked L<={bound} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:500]}", flush=True)
+        del t
+
+
+def main(n: int, moves: int) -> None:
+    print(f"backend={jax.default_backend()} n={n} moves={moves}", flush=True)
+    mesh48 = build_box(1, 1, 1, 20, 20, 20, dtype=jnp.float32)
+    run_mesh("box48k", mesh48, n, moves, bounds=(3072, 6144))
+    del mesh48
+
+    from pumiumtally_tpu.mesh.pincell import build_lattice
+
+    t0 = time.perf_counter()
+    mesh1m, _, _ = build_lattice(10, 10, n_theta=24, n_rings_fuel=4,
+                                 n_rings_pad=4, nz=10, dtype=jnp.float32)
+    print(f"lattice {mesh1m.nelems} tets built in "
+          f"{time.perf_counter() - t0:.1f}s", flush=True)
+    # capf 4.0: ~350 spatial blocks at n/350 mean occupancy need real
+    # headroom against Poisson + migration-arrival fluctuations (the
+    # 2.0 default overflowed at small n).
+    run_mesh("lattice1M", mesh1m, n, moves, bounds=(3072,), capf=4.0)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 500_000,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 4)

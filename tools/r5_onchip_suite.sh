@@ -20,6 +20,7 @@ run() { # name timeout cmd...
   cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
 }
 run bench_clean 2700 python bench.py
+run blocked    2400 python tools/exp_r5_blocked.py 500000 4
 run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
 run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
 echo "suite finished $(date)" >> "$OUT/status"
