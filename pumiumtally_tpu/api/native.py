@@ -17,6 +17,15 @@ reference selects its Kokkos backend at build time:
                                   bound under which the local walk runs
                                   as the VMEM one-hot MXU Pallas kernel
                                   (TallyConfig.walk_vmem_max_elems)
+    PUMIUMTALLY_BLOCK_KERNEL      partitioned engines: vmem (default) |
+                                  gather — which kernel runs the
+                                  sub-split per-block local walk
+                                  (TallyConfig.walk_block_kernel)
+    PUMIUMTALLY_ALLOW_CPU_FALLBACK  1 to ACCEPT running on CPU when the
+                                  env requests an accelerator whose
+                                  PJRT plugin is not registered in this
+                                  (embedded) interpreter; default:
+                                  refuse with an error
     PUMIUMTALLY_TOLERANCE         walk tolerance override
     PUMIUMTALLY_OUTPUT            default VTK output path
     PUMIUMTALLY_LOCALIZATION      walk (default) | locate — see
@@ -180,6 +189,14 @@ def native_create(mesh_filename: str, num_particles: int):
                 f"partitioned engines, not PUMIUMTALLY_ENGINE={engine!r}"
             )
         kwargs["walk_vmem_max_elems"] = int(vmem)
+    bk = os.environ.get("PUMIUMTALLY_BLOCK_KERNEL")
+    if bk:
+        if engine not in ("partitioned", "streaming_partitioned"):
+            raise ValueError(
+                "PUMIUMTALLY_BLOCK_KERNEL applies only to the "
+                f"partitioned engines, not PUMIUMTALLY_ENGINE={engine!r}"
+            )
+        kwargs["walk_block_kernel"] = bk.strip().lower()
     fenced = env_flag("PUMIUMTALLY_FENCED_TIMING")
     check = env_flag("PUMIUMTALLY_CHECK_FOUND_ALL")
     if fenced is not None:

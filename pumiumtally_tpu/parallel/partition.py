@@ -967,11 +967,35 @@ class PartitionedEngine:
                         (t_b, x_b, le_b, d_b, f_b, w_b, dn_b,
                          ex_b, fx_b) = args
                         a_b = None
-                    return walk_local(
-                        t_b, x_b, le_b, d_b, f_b, w_b, dn_b, ex_b, fx_b,
-                        tally=tally, tol=tol, max_iters=max_iters,
-                        adj_int=a_b, cond_every=cond_every,
-                        min_window=min_window,
+
+                    def run(op):
+                        x_, le_, d_, f_, w_, dn_, ex_, fx_ = op
+                        return walk_local(
+                            t_b, x_, le_, d_, f_, w_, dn_, ex_, fx_,
+                            tally=tally, tol=tol, max_iters=max_iters,
+                            adj_int=a_b, cond_every=cond_every,
+                            min_window=min_window,
+                        )
+
+                    def skip(op):
+                        # Bitwise-identical to walk_local on an
+                        # all-done batch: state unchanged (x_fin
+                        # reduces to the committed x for done
+                        # particles), fresh all- -1 pending, flux
+                        # untouched, zero iterations.
+                        x_, le_, d_, f_, w_, dn_, ex_, fx_ = op
+                        return (x_, le_, dn_, ex_,
+                                jnp.full_like(le_, -1), fx_,
+                                jnp.asarray(0, jnp.int32))
+
+                    # Migration rounds beyond the first touch only the
+                    # frontier blocks; an idle block (every slot done)
+                    # must not pay the walk's cascade/argsort schedule
+                    # — with hundreds of blocks (1M-tet lattice) that
+                    # cost dominates late rounds.
+                    return lax.cond(
+                        jnp.any(~dn_b), run, skip,
+                        (x_b, le_b, d_b, f_b, w_b, dn_b, ex_b, fx_b),
                     )
 
                 per_block = (

@@ -226,6 +226,29 @@ def test_c_abi_echo_protocol_dedup(native_lib, tmp_path):
         lib.pumiumtally_destroy(h)
 
 
+def test_native_env_selects_block_kernel(tmp_path, monkeypatch):
+    """PUMIUMTALLY_BLOCK_KERNEL routes through to
+    TallyConfig.walk_block_kernel for the partitioned engines, and is
+    rejected for non-partitioned engines like the other
+    partitioned-only knobs."""
+    from pumiumtally_tpu.api.native import native_create
+
+    msh = str(tmp_path / "box.msh")
+    _write_box_msh(msh)
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "partitioned")
+    monkeypatch.setenv("PUMIUMTALLY_DEVICES", "2")
+    monkeypatch.setenv("PUMIUMTALLY_VMEM_MAX_ELEMS", "2")
+    monkeypatch.setenv("PUMIUMTALLY_BLOCK_KERNEL", "gather")
+    monkeypatch.setenv("PUMIUMTALLY_CAPACITY_FACTOR", "8.0")
+    t = native_create(msh, 16)
+    assert t.engine.blocks_per_chip > 1 and not t.engine.use_vmem_walk
+    assert t.config.walk_block_kernel == "gather"
+    monkeypatch.setenv("PUMIUMTALLY_ENGINE", "mono")
+    monkeypatch.delenv("PUMIUMTALLY_VMEM_MAX_ELEMS")
+    with pytest.raises(ValueError, match="BLOCK_KERNEL"):
+        native_create(msh, 16)
+
+
 def _embedded_boot_env_and_code(tmp_path):
     msh = str(tmp_path / "box.msh")
     _write_box_msh(msh)

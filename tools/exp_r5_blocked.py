@@ -79,13 +79,15 @@ def run_mesh(label, mesh, n, moves, bounds, capf=2.0) -> None:
         print(f"{label} monolithic FAILED: "
               f"{type(e).__name__}: {str(e)[:500]}", flush=True)
     for bound in bounds:
-        t = PartitionedPumiTally(
-            mesh, n,
-            TallyConfig(capacity_factor=capf, walk_vmem_max_elems=bound,
-                        walk_block_kernel="gather",
-                        check_found_all=False, fenced_timing=False),
-        )
+        t = None
         try:
+            t = PartitionedPumiTally(
+                mesh, n,
+                TallyConfig(capacity_factor=capf,
+                            walk_vmem_max_elems=bound,
+                            walk_block_kernel="gather",
+                            check_found_all=False, fenced_timing=False),
+            )
             r = drive(t, pts, moves)
             print(f"{label} gather-blocked L<={bound} "
                   f"({t.engine.blocks_per_chip} blocks, "
