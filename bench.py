@@ -626,27 +626,32 @@ def _measure_and_report() -> None:
 
     vs_baseline = None
     cpu_rate = None
-    try:
-        env = dict(os.environ)
-        env["PUMIUMTALLY_BENCH_CPU"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        # Baseline stays UNTUNED so vs_baseline's denominator keeps the
-        # semantics of earlier rounds (default-knob CPU engine).
-        env["PUMIUMTALLY_BENCH_AUTOTUNE"] = "0"
-        # Don't let the child's interpreter-startup hook try to claim
-        # the TPU tunnel the parent may be holding (it would block).
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, timeout=3600,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        cpu_rate = json.loads(out.stdout.strip().splitlines()[-1])[
-            "cpu_two_phase_rate"
-        ]
-        vs_baseline = two["moves_per_sec"] / cpu_rate
-    except Exception as e:  # noqa: BLE001 — baseline is best-effort
-        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+    # PUMIUMTALLY_BENCH_CPU_BASELINE=0 (quick-window mode) skips the
+    # CPU-subprocess baseline — the longest extra — so a short tunnel
+    # window still yields a fresh on-chip headline; vs_baseline null.
+    if os.environ.get("PUMIUMTALLY_BENCH_CPU_BASELINE", "1") != "0":
+        try:
+            env = dict(os.environ)
+            env["PUMIUMTALLY_BENCH_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            # Baseline stays UNTUNED so vs_baseline's denominator keeps
+            # the semantics of earlier rounds (default-knob CPU engine).
+            env["PUMIUMTALLY_BENCH_AUTOTUNE"] = "0"
+            # Don't let the child's interpreter-startup hook try to
+            # claim the TPU tunnel the parent may be holding (it would
+            # block).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=3600,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            cpu_rate = json.loads(out.stdout.strip().splitlines()[-1])[
+                "cpu_two_phase_rate"
+            ]
+            vs_baseline = two["moves_per_sec"] / cpu_rate
+        except Exception as e:  # noqa: BLE001 — baseline is best-effort
+            print(f"# cpu baseline failed: {e}", file=sys.stderr)
 
     # Headline = the best CONTINUE-protocol engine on the canonical
     # workload (same mesh, same particles, same protocol — engines are

@@ -1,10 +1,11 @@
 #!/bin/bash
 # Round-5 on-chip suite: fired by tools/r5_probe_loop.sh the moment the
-# TPU tunnel answers. ORDER MATTERS (r4 lesson): the clean bench comes
-# first because it is known-good and gives the round a fresh headline;
-# the production-VMEM compile+measure goes LAST because its remote
-# compile request is the prime wedge suspect (r4's helper hung rather
-# than erroring).
+# TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK headline bench
+# runs first (a short window must still yield a fresh cached
+# measurement), then the full known-good bench, then the new-engine
+# experiments; the production-VMEM compile+measure goes LAST because
+# its remote compile request is the prime wedge suspect (r4's helper
+# hung rather than erroring).
 set -u
 OUT=/tmp/r5_onchip
 mkdir -p "$OUT"
@@ -19,6 +20,11 @@ run() { # name timeout cmd...
   cp "$OUT/$name.log" /root/repo/tools/r5_onchip/$name.log 2>/dev/null
   cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
 }
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success) for the
+# round record. The full bench then overwrites it with the complete
+# row set.
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
 run bench_clean 2700 python bench.py
 run blocked    2400 python tools/exp_r5_blocked.py 500000 4
 run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
