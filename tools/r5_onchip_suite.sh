@@ -11,6 +11,22 @@ OUT=/tmp/r5_onchip
 mkdir -p "$OUT"
 cd /root/repo
 echo "suite started $(date)" > "$OUT/status"
+STAGES=""
+write_digest() {
+  # Regenerated after EVERY stage so a window that closes mid-suite
+  # still leaves a digest covering what ran.
+  local DG=/root/repo/tools/r5_onchip/digest.md
+  {
+    echo "# r5 on-chip suite digest"
+    cat "$OUT/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$OUT/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG" 2>/dev/null
+}
 run() { # name timeout cmd...
   local name=$1 tmo=$2; shift 2
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
@@ -19,6 +35,8 @@ run() { # name timeout cmd...
   mkdir -p /root/repo/tools/r5_onchip
   cp "$OUT/$name.log" /root/repo/tools/r5_onchip/$name.log 2>/dev/null
   cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
+  STAGES="$STAGES $name"
+  write_digest
 }
 # Quick headline FIRST (~6 min): if the window closes mid-suite, a
 # fresh on-chip measurement is already cached (record_success) for the
@@ -31,3 +49,4 @@ run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 -
 run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
 echo "suite finished $(date)" >> "$OUT/status"
 cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
+write_digest
