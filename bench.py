@@ -294,13 +294,11 @@ def run_pincell(n: int, moves: int, tuned: bool = False) -> dict:
     measured backend first — box-mesh knobs don't transfer (the
     optimum is mesh-dependent, docs/PERF_NOTES.md round 4)."""
     from pumiumtally_tpu import PumiTally, TallyConfig
-    from pumiumtally_tpu.mesh.pincell import build_pincell
+    from pumiumtally_tpu.mesh.pincell import FLAGSHIP_PINCELL, build_pincell
 
-    pitch, height = 1.26, 1.0
-    mesh, _ = build_pincell(
-        pitch=pitch, height=height, n_theta=32, n_rings_fuel=5,
-        n_rings_pad=5, nz=12,
-    )
+    pitch = FLAGSHIP_PINCELL["pitch"]
+    height = FLAGSHIP_PINCELL["height"]
+    mesh, _ = build_pincell(**FLAGSHIP_PINCELL)
     knobs = {}
     if tuned:
         from pumiumtally_tpu.utils.autotune import autotune_walk

@@ -267,6 +267,15 @@ def build_lattice(
     return TetMesh.from_arrays(coords, tets, dtype=dtype), region, cell_id
 
 
+# The flagship benchmark geometry (BASELINE configs[0]: OpenMC pincell
+# class, ~22k anisotropic tets): ONE definition consumed by bench.py
+# and the experiment scripts, so every A/B measures the same mesh.
+FLAGSHIP_PINCELL = dict(
+    pitch=1.26, height=1.0, n_theta=32, n_rings_fuel=5, n_rings_pad=5,
+    nz=12,
+)
+
+
 def build_pincell(
     pitch: float = 1.26,
     fuel_radius: float = 0.4095,

@@ -106,6 +106,16 @@ def main(n: int, moves: int) -> None:
     run_mesh("box48k", mesh48, n, moves, bounds=(3072, 6144))
     del mesh48
 
+    # The flagship pincell geometry (~22k anisotropic tets, the same
+    # FLAGSHIP_PINCELL mesh bench.py measures): if the gather sub-split
+    # wins here too, the BASELINE configs[0] workload gets the same
+    # lift as the box.
+    from pumiumtally_tpu.mesh.pincell import FLAGSHIP_PINCELL, build_pincell
+
+    pmesh, _ = build_pincell(**FLAGSHIP_PINCELL)
+    run_mesh("pincell22k", pmesh, n, moves, bounds=(3072,))
+    del pmesh
+
     from pumiumtally_tpu.mesh.pincell import build_lattice
 
     t0 = time.perf_counter()

@@ -6,36 +6,39 @@
 # experiments; the production-VMEM compile+measure goes LAST because
 # its remote compile request is the prime wedge suspect (r4's helper
 # hung rather than erroring).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir (a window
+# that closes mid-stage leaves the partial log in place), the digest is
+# regenerated before AND after every stage, and the digest write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
 set -u
-OUT=/tmp/r5_onchip
-mkdir -p "$OUT"
+RD=/root/repo/tools/r5_onchip
+mkdir -p "$RD"
 cd /root/repo
-echo "suite started $(date)" > "$OUT/status"
+echo "suite started $(date)" > "$RD/status"
 STAGES=""
 write_digest() {
-  # Regenerated after EVERY stage so a window that closes mid-suite
-  # still leaves a digest covering what ran.
-  local DG=/root/repo/tools/r5_onchip/digest.md
+  local DG="$RD/digest.md"
   {
     echo "# r5 on-chip suite digest"
-    cat "$OUT/status"
+    cat "$RD/status"
     echo
     for f in $STAGES; do
       echo "## $f"
-      grep -E '"metric"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$OUT/$f.log" 2>/dev/null | tail -20
+      grep -E '"metric"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
       echo
     done
-  } > "$DG" 2>/dev/null
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
 }
 run() { # name timeout cmd...
   local name=$1 tmo=$2; shift 2
-  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
-  local rc=$?
-  echo "$name done $(date) rc=$rc" >> "$OUT/status"
-  mkdir -p /root/repo/tools/r5_onchip
-  cp "$OUT/$name.log" /root/repo/tools/r5_onchip/$name.log 2>/dev/null
-  cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
   STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
   write_digest
 }
 # Quick headline FIRST (~6 min): if the window closes mid-suite, a
@@ -44,9 +47,8 @@ run() { # name timeout cmd...
 # row set.
 run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
 run bench_clean 2700 python bench.py
-run blocked    2400 python tools/exp_r5_blocked.py 500000 4
+run blocked    3300 python tools/exp_r5_blocked.py 500000 4
 run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
 run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
-echo "suite finished $(date)" >> "$OUT/status"
-cp "$OUT/status" /root/repo/tools/r5_onchip/status 2>/dev/null
+echo "suite finished $(date)" >> "$RD/status"
 write_digest
