@@ -155,11 +155,16 @@ class TallyConfig:
     # cost model); larger partitions silently keep the gather walk.
     # Not bitwise vs the gather walk (documented rounding-level
     # divergence); conservation gates apply unchanged.
-    # Hardware feasibility (measured via chipless AOT compile,
-    # tools/aot_vmem_compile.py): on v5e's 16 MB VMEM with the 1024
-    # particle tile, bounds up to 2048 compile; ~4096 exceeds the
-    # scoped-VMEM stack (the [w_tile, Lp] one-hot dominates at
-    # 4·w_tile·Lp bytes). Keep the bound <= 2048 on current chips.
+    # Compile feasibility (measured via chipless AOT,
+    # tools/aot_vmem_compile.py, corrected in r5): at the production
+    # 1024-lane particle tile, block lengths through ~8192 compile —
+    # the binding constraint is Mosaic's scoped-VMEM STACK limit, a
+    # compiler constant driven by the particle tile (w_tile=2048 is
+    # rejected at ~20.8 MB vs the 16.00M limit on v5e AND v5p alike),
+    # not the block length or physical VMEM. Engines clamp bounds
+    # above the measured ceiling (ops/vmem_walk.py
+    # effective_vmem_bound); the PERF sweet spot is still small blocks
+    # per the module's cost model.
     walk_vmem_max_elems: Optional[int] = None
     # Which kernel runs the per-block local walk when
     # walk_vmem_max_elems sub-splits a chip's partition into

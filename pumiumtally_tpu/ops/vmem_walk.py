@@ -78,51 +78,57 @@ TABLE_PAD_COLS = 32
 # row count Lp, and the iters output — is a TILE_1D multiple.
 TILE_1D = 1024
 W_TILE_DEFAULT = 1024
-# Measured VMEM feasibility (chipless AOT sweep,
-# tools/aot_vmem_compile.py, v5e 16 MB/core): at the TILE_1D particle
-# tile the scoped-VMEM stack holds the [w_tile, Lp] one-hot through
-# Lp=2048; Lp=4096 exceeds the limit by ~9 MB. Engines clamp the
-# user's walk_vmem_max_elems to this on compiled-TPU backends
-# (interpret mode has no such ceiling). The ceiling scales linearly
-# with per-core VMEM (the [w_tile, Lp] one-hot dominates), so chips
-# with more VMEM get a proportionally larger bound — see
-# _chip_vmem_ceiling (ADVICE r4: a v4/v5p with 32+ MB must not be
-# silently over-clamped into finer sub-splits).
-VMEM_FEASIBLE_MAX_ELEMS = 2048
-_VMEM_MEASURED_BYTES = 16 * 2**20  # the v5e core the sweep ran on
+# Measured VMEM feasibility (chipless AOT sweeps,
+# tools/aot_vmem_compile.py, v5e 16 MB/core). The r5 re-measurement
+# corrected a round-4 conflation: the scoped-VMEM OOM is driven by the
+# PARTICLE TILE, not the block length — at w_tile=2048 Mosaic's stack
+# wants 20.8-21.9 MB regardless of Lp (1536 and 3993 both rejected,
+# "scoped allocation ... exceeded scoped vmem limit"), while at the
+# production default w_tile=1024 every swept block length through
+# Lp=8232 compiles (r4 had recorded "Lp<=2048" from the w=2048 rows).
+# Engines clamp the user's walk_vmem_max_elems to the value measured
+# at W_TILE_DEFAULT on compiled-TPU backends (interpret mode has no
+# ceiling); the perf sweet spot remains SMALL blocks regardless (the
+# one-hot matmul costs ~2*L*128 FLOPs per crossing — module cost
+# model), so the clamp is a compile-safety rail, not a tuning hint.
+# The limit is a COMPILER constant, not physical VMEM — the same
+# w=2048 kernel is rejected with the identical "limit 16.00M" on a
+# v5p target with 2x the VMEM — so the ceiling applies to every chip
+# generation; _chip_vmem_ceiling provides only an env override.
+VMEM_FEASIBLE_MAX_ELEMS = 8192
 
 
 def _chip_vmem_ceiling() -> int:
-    """VMEM_FEASIBLE_MAX_ELEMS scaled by the attached chip's per-core
-    VMEM. PUMIUMTALLY_VMEM_CEILING_ELEMS overrides outright (a new
-    chip generation can be measured and pinned without a code change).
-    Unknown chips keep the measured v5e value — clamping too fine is
-    migration overhead; not clamping is a compile failure."""
+    """The block-size ceiling actually in force.
+
+    PUMIUMTALLY_VMEM_CEILING_ELEMS overrides outright (a new chip
+    generation or compiler flag change can be measured and pinned
+    without a code change). Otherwise the measured default applies to
+    EVERY chip generation: the r5 cross-topology AOT sweep
+    (tools/aot_multichip_compile.py) showed the binding constraint is
+    Mosaic's scoped-VMEM *stack* limit — a compiler-level constant
+    (same "limit 16.00M" rejection on a v5p:1x1x1 target, whose
+    physical VMEM is 2x v5e's) — so scaling the ceiling by physical
+    per-core VMEM, as the first ADVICE-r4 fix did, was the wrong model.
+    Operators raising the compiler's scoped limit
+    (--xla_tpu_scoped_vmem_limit_kib) can raise this via the env."""
     import os
 
     env = os.environ.get("PUMIUMTALLY_VMEM_CEILING_ELEMS")
     if env:
         return int(env)
-    try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:  # noqa: BLE001 — no backend: keep measured value
-        return VMEM_FEASIBLE_MAX_ELEMS
-    # Per-core VMEM by generation (public chip specs; conservative).
-    vmem = _VMEM_MEASURED_BYTES
-    if "v4" in kind or "v5p" in kind:
-        vmem = 32 * 2**20
-    scale = vmem // _VMEM_MEASURED_BYTES
-    return VMEM_FEASIBLE_MAX_ELEMS * max(1, int(scale))
+    return VMEM_FEASIBLE_MAX_ELEMS
 
 
 def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
     """The walk_vmem_max_elems value an engine may actually use:
-    clamped to the (chip-scaled) scoped-VMEM ceiling on compiled-TPU
-    backends (a larger bound would die in Mosaic's allocator at first
-    compile), untouched in interpret mode. EVERY path that derives a
-    partition from the knob must clamp through here — clamping after
-    a partition is built leaves blocks the kernel cannot run (the
-    sub-split constructor then rejects the configuration)."""
+    clamped to the scoped-VMEM ceiling (measured default or env
+    override — _chip_vmem_ceiling) on compiled-TPU backends (a larger
+    bound would die in Mosaic's allocator at first compile), untouched
+    in interpret mode. EVERY path that derives a partition from the
+    knob must clamp through here — clamping after a partition is built
+    leaves blocks the kernel cannot run (the sub-split constructor
+    then rejects the configuration)."""
     if bound is None:
         return None
     bound = int(bound)

@@ -16,6 +16,7 @@ Prints one OK/FAILED line per program; exit 0 iff all compile.
 from __future__ import annotations
 
 import os
+import re
 import sys
 import time
 
@@ -36,11 +37,11 @@ from jax.experimental import topologies  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 
-def tpu_mesh(n_chips: int = 4, axis: str = "dp"):
+def tpu_mesh(topology: str = "v5e:2x2x1", axis: str = "dp"):
     topo = topologies.get_topology_desc(
-        platform="tpu", topology_name="v5e:2x2x1"
+        platform="tpu", topology_name=topology
     )
-    return topologies.make_mesh(topo, (n_chips,), (axis,))
+    return topologies.make_mesh(topo, (len(topo.devices),), (axis,))
 
 
 def _compile_phase(eng, tmesh) -> float:
@@ -60,28 +61,42 @@ def _compile_phase(eng, tmesh) -> float:
     return time.perf_counter() - t0
 
 
+PROGRAMS = (
+    # (topology, label, engine kwargs) — v5e:2x2x1 is the canonical
+    # 4-chip certification set; v5e:4x4 extends it across slice size.
+    # Chip-generation coverage lives in the scoped-VMEM envelope
+    # cross-check after this loop (v5e vs v5p single-chip targets),
+    # which established that the scoped limit is a compiler constant —
+    # see ops/vmem_walk.py:_chip_vmem_ceiling.
+    ("v5e:2x2x1", "partitioned gather phase", {}),
+    # Pallas kernel inside shard_map on the multi-TPU target: one
+    # VMEM block per chip (3072/4 = 768 <= 1024).
+    ("v5e:2x2x1", "partitioned vmem phase", {"vmem_walk_max_elems": 1024}),
+    # Sub-split: blocks_per_chip > 1, grid (blocks, tiles).
+    ("v5e:2x2x1", "partitioned vmem sub-split phase",
+     {"vmem_walk_max_elems": 256}),
+    # Gather sub-split (r5 headline bet): lax.map over per-block
+    # walk_local inside shard_map — pure XLA, but must be proven
+    # against the real TPU pipeline before the bench window.
+    ("v5e:2x2x1", "partitioned gather sub-split phase",
+     {"vmem_walk_max_elems": 256, "block_kernel": "gather"}),
+    ("v5e:4x4", "16-chip gather sub-split phase",
+     {"vmem_walk_max_elems": 96, "block_kernel": "gather"}),
+)
+
+
 def main(n: int) -> int:
     from pumiumtally_tpu import build_box
     from pumiumtally_tpu.parallel.partition import PartitionedEngine
 
-    tmesh = tpu_mesh()
     mesh = build_box(1, 1, 1, 8, 8, 8, dtype=jnp.float32)  # 3072 tets
     rc = 0
-    for label, kwargs in (
-        ("partitioned gather phase", {}),
-        # Pallas kernel inside shard_map on the multi-TPU target: one
-        # VMEM block per chip (3072/4 = 768 <= 1024).
-        ("partitioned vmem phase", {"vmem_walk_max_elems": 1024}),
-        # Sub-split: blocks_per_chip > 1, grid (blocks, tiles).
-        ("partitioned vmem sub-split phase",
-         {"vmem_walk_max_elems": 256}),
-        # Gather sub-split (r5 headline bet): lax.map over per-block
-        # walk_local inside shard_map — pure XLA, but must be proven
-        # against the real TPU pipeline before the bench window.
-        ("partitioned gather sub-split phase",
-         {"vmem_walk_max_elems": 256, "block_kernel": "gather"}),
-    ):
+    meshes = {}
+    for topology, label, kwargs in PROGRAMS:
         try:
+            if topology not in meshes:
+                meshes[topology] = tpu_mesh(topology)
+            tmesh = meshes[topology]
             eng = PartitionedEngine(
                 mesh, tmesh, n, capacity_factor=2.0, tol=1e-6,
                 max_iters=256, max_rounds=8, check_found_all=False,
@@ -89,11 +104,62 @@ def main(n: int) -> int:
             )
             dt = _compile_phase(eng, tmesh)
             blocks = eng.blocks_per_chip
-            print(f"OK {label}: {dt:.1f}s "
+            print(f"OK {label} [{topology}]: {dt:.1f}s "
                   f"(L={eng.part.L}, blocks/chip={blocks}, "
                   f"vmem={eng.use_vmem_walk})")
         except Exception as e:  # noqa: BLE001 — the harness's question
-            print(f"FAILED {label}: {type(e).__name__}: {str(e)[:2000]}")
+            print(f"FAILED {label} [{topology}]: "
+                  f"{type(e).__name__}: {str(e)[:2000]}")
+            rc = 1
+
+    # VMEM-envelope cross-check (ADVICE r4 + r5 re-measurement): the
+    # scoped-VMEM OOM is PARTICLE-TILE-driven — w_tile=2048 demands
+    # ~20.8 MB of Mosaic stack regardless of block length
+    # ("exceeded scoped vmem limit", tools/aot_vmem_compile.py
+    # 4096 2048 2048 8). Compiling the SAME bare kernel against a v5e
+    # AND a v5p single-chip target showed BOTH reject it: the binding
+    # limit is the compiler's scoped-stack constant, not physical
+    # per-core VMEM (v5p has 2x). This pins the corrected model behind
+    # ops/vmem_walk.py:_chip_vmem_ceiling with the real allocator.
+    from functools import partial as _partial
+
+    from tools.exp_r4_vmem_compile import chip_workload
+
+    from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
+
+    _, kargs = chip_workload(divs=8, ndev=2, n=4096)  # L=1536
+    f = _partial(vmem_walk_local, tally=True, tol=1e-6, max_iters=2048,
+                 w_tile=2048, interpret=False)
+    for topology, expect_ok in (("v5p:1x1x1", False), ("v5e:1x1x1", False)):
+        label = f"w_tile=2048 vmem kernel on {topology}"
+        try:
+            # Single-chip topology: a replicated multi-chip sharding
+            # would make XLA try to auto-partition the pallas call.
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name=topology,
+                chips_per_host_bounds=[1, 1, 1],
+            )
+            sh = NamedSharding(
+                topologies.make_mesh(topo, (1,), ("x",)), P()
+            )
+            shaped = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+                      for a in kargs]
+            t0 = time.perf_counter()
+            jax.jit(f).lower(*shaped).compile()
+            ok = True
+            detail = f"compiled in {time.perf_counter() - t0:.1f}s"
+        except Exception as e:  # noqa: BLE001 — outcome under test
+            msg = str(e)
+            m = re.search(r"size [0-9.]+[MK] .{0,40}limit[^.]*", msg)
+            ok = False
+            detail = (f"{type(e).__name__}: "
+                      f"{m.group(0) if m else msg[:200]}")
+        if ok == expect_ok:
+            verdict = "compiles" if ok else "correctly rejected"
+            print(f"OK {label}: {verdict} ({detail})")
+        else:
+            print(f"FAILED {label}: expected "
+                  f"{'success' if expect_ok else 'rejection'}, got {detail}")
             rc = 1
     return rc
 
