@@ -125,8 +125,11 @@ def main(n: int, moves: int) -> None:
           f"{time.perf_counter() - t0:.1f}s", flush=True)
     # capf 4.0: ~350 spatial blocks at n/350 mean occupancy need real
     # headroom against Poisson + migration-arrival fluctuations (the
-    # 2.0 default overflowed at small n).
-    run_mesh("lattice1M", mesh1m, n, moves, bounds=(3072,), capf=4.0)
+    # 2.0 default overflowed at small n). The 12288 bound probes the
+    # fewer-blocks/fewer-rounds corner (L<=3072 needed ~45 migration
+    # rounds on the lattice — block size must scale with step length).
+    run_mesh("lattice1M", mesh1m, n, moves, bounds=(3072, 12288),
+             capf=4.0)
 
 
 if __name__ == "__main__":
