@@ -1,0 +1,112 @@
+"""XLA cost-model A/B: monolithic vs gather-blocked walk programs.
+
+Compiles both engines' tallied-move programs for a real v5e:1x1x1
+target (chipless, local libtpu) on the SAME workload shape round 4
+used for the vmem cost row (3072-tet box, 4096 particles, 256-iteration
+budget) and prints `cost_analysis()` bytes/FLOPs. While-loop trip
+counts make the absolute numbers upper bounds; the RELATIVE comparison
+at identical budgets is the signal (r4: gather 689 MB vs vmem 162 MB
+accessed on the 4-chip phase — the bet this round's gather sub-split
+chases from the other side, table residency instead of MXU one-hot).
+
+Usage: python tools/exp_r5_cost_model.py [divs] [n]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+from functools import partial  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+MAX_ITERS = 256
+
+
+def _sharding(topology="v5e:1x1x1"):
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology,
+        chips_per_host_bounds=[1, 1, 1],
+    )
+    return NamedSharding(topologies.make_mesh(topo, (1,), ("dp",)), P())
+
+
+def _report(label, compiled):
+    ca = compiled.cost_analysis()
+    if not ca:
+        print(f"{label}: no cost analysis available")
+        return
+    print(f"{label}: {ca.get('bytes accessed', 0) / 1e6:.0f} MB accessed, "
+          f"{ca.get('flops', 0) / 1e6:.0f} MFLOP", flush=True)
+
+
+def main(divs: int, n: int) -> None:
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.api.tally import move_step_continue
+    from pumiumtally_tpu.parallel.partition import PartitionedEngine
+
+    sh = _sharding()
+    mesh = build_box(1, 1, 1, divs, divs, divs, dtype=jnp.float32)
+    E = mesh.nelems
+    print(f"workload: {E} tets, {n} particles, {MAX_ITERS}-iter budget",
+          flush=True)
+
+    # Monolithic continue-mode move (the r1-r4 headline program).
+    spec = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+        jnp.shape(a), jnp.result_type(a), sharding=sh
+    )
+    f = partial(move_step_continue, tol=1e-6, max_iters=MAX_ITERS)
+    state = dict(
+        x=jnp.zeros((n, 3), jnp.float32),
+        elem=jnp.zeros((n,), jnp.int32),
+        dests=jnp.zeros((n, 3), jnp.float32),
+        flying=jnp.ones((n,), jnp.int8),
+        weights=jnp.ones((n,), jnp.float32),
+        flux=jnp.zeros((E,), jnp.float32),
+    )
+    lowered = jax.jit(
+        lambda x, elem, dests, fly, w, flux: f(
+            mesh, x, elem, dests, fly, w, flux
+        )
+    ).lower(*(spec(state[k]) for k in
+              ("x", "elem", "dests", "flying", "weights", "flux")))
+    _report("monolithic continue move", lowered.compile())
+
+    # Gather-blocked phase at the same per-block scale as the headline
+    # config (bound E//8 -> 8 blocks).
+    tmesh = sh.mesh
+    eng = PartitionedEngine(
+        mesh, tmesh, n, capacity_factor=2.0, tol=1e-6,
+        max_iters=MAX_ITERS, max_rounds=8, check_found_all=False,
+        vmem_walk_max_elems=max(1, E // 8), block_kernel="gather",
+    )
+    print(f"blocked engine: {eng.blocks_per_chip} blocks x L={eng.part.L}",
+          flush=True)
+    phase = eng._phase_program(tally=True)
+    espec = lambda a: None if a is None else jax.ShapeDtypeStruct(  # noqa: E731
+        a.shape, a.dtype, sharding=sh
+    )
+    args = (espec(eng.part.table), espec(eng.part.adj_int),
+            {k: espec(v) for k, v in eng.state.items()},
+            espec(eng.flux_padded))
+    _report("gather-blocked phase", phase.lower(*args).compile())
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 4096)
