@@ -324,6 +324,26 @@ def run_pincell(n: int, moves: int, tuned: bool = False) -> dict:
     return res
 
 
+def run_redistribution_ab() -> dict | None:
+    """Component row: argsort-vs-counting-rank redistribution cost at
+    bench scale (tools/exp_partition_ab.py) — one packed cascade stage
+    boundary and one packed migration shuffle, both arms bitwise
+    equivalent by construction. Makes the sort-free redistribution win
+    (or a regression) visible in every round bench; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_partition_ab
+
+    return {
+        r.pop("row"): r
+        for r in (
+            exp_partition_ab.bench_cascade_boundary(N),
+            exp_partition_ab.bench_migrate_round(N),
+        )
+    }
+
+
 def preflight_device(max_wait_s: float | None = None) -> None:
     """Fail fast (rc 1) if the accelerator cannot be claimed.
 
@@ -611,6 +631,12 @@ def _measure_and_report() -> None:
             gblocked = run_gather_blocked(N, MOVES)
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# gather-blocked workload failed: {e}", file=sys.stderr)
+    redistribution = None
+    if os.environ.get("PUMIUMTALLY_BENCH_REDISTRIBUTION", "1") != "0":
+        try:
+            redistribution = run_redistribution_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# redistribution A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -711,6 +737,9 @@ def _measure_and_report() -> None:
             "moves_per_sec": pincell_tuned["moves_per_sec"],
             "knobs": pincell_tuned["knobs"],
         },
+        # argsort-vs-rank redistribution component (speedup > 1 means
+        # the sort-free counting-rank path wins on this backend).
+        "redistribution": redistribution,
         "gather_blocked": None if gblocked is None else {
             "moves_per_sec": gblocked["moves_per_sec"],
             "blocks_per_chip": gblocked["blocks_per_chip"],

@@ -19,6 +19,7 @@ owned slices with no cross-chip reduction at all.
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Optional, Union
 
 import jax
@@ -51,6 +52,24 @@ class PartitionedPumiTally(PumiTally):
             # replaces the monolithic-table gather.
             from pumiumtally_tpu.parallel import make_device_mesh
 
+            if (
+                jax.device_count() > 1
+                and jax.devices()[0].platform != "cpu"
+            ):
+                # A multi-chip host defaulting to one device is almost
+                # always a forgotten TallyConfig.device_mesh — say so
+                # instead of silently leaving (n-1) chips idle. CPU
+                # "devices" are exempt: multiples of those are virtual
+                # (xla_force_host_platform_device_count test rigs), not
+                # idle hardware.
+                warnings.warn(
+                    f"PartitionedPumiTally: no device_mesh configured; "
+                    f"running on 1 of the {jax.device_count()} available "
+                    f"{jax.devices()[0].platform} devices. Pass "
+                    "TallyConfig(device_mesh=make_device_mesh(n)) to "
+                    "use them.",
+                    stacklevel=2,  # point at the constructor call site
+                )
             self.device_mesh = make_device_mesh(1)
         self.engine = PartitionedEngine(
             mesh,
@@ -65,6 +84,7 @@ class PartitionedPumiTally(PumiTally):
             min_window=self.config.resolved_min_window(),
             vmem_walk_max_elems=self.config.walk_vmem_max_elems,
             block_kernel=self.config.walk_block_kernel,
+            partition_method=self.config.resolved_partition_method(),
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

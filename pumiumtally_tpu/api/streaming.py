@@ -505,6 +505,7 @@ class StreamingPartitionedTally(StreamingTally):
                 min_window=self.config.resolved_min_window(),
                 vmem_walk_max_elems=vmem_bound,
                 block_kernel=self.config.walk_block_kernel,
+                partition_method=self.config.resolved_partition_method(),
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

@@ -122,10 +122,12 @@ def check_finite(a: np.ndarray, what: str, offset: int = 0) -> None:
     The monolithic facade checks the whole batch after the
     working-dtype cast (so f64 values that overflow f32 to inf are
     caught) before anything is dispatched. The streaming facade checks
-    the raw f64 batch up front (NaN/Inf inputs refuse before any chunk
-    dispatches) AND each chunk's cast (the f32-overflow corner); in
-    that corner earlier chunks of the refused move may already be
-    applied — a loud mid-move raise, never silent poisoning."""
+    the raw f64 batch at entry AND pre-validates the working-dtype
+    casts before ANY chunk dispatches via ``_prevalidate_narrow``
+    (api/streaming.py): chunk-at-a-time casts, discarded after the
+    check, so the f32-overflow corner also refuses up front without a
+    full-batch copy; the per-chunk staging check then only backstops
+    it."""
     if not np.isfinite(a).all():
         flat = np.asarray(a).reshape(-1)
         bad = np.flatnonzero(~np.isfinite(flat))
@@ -801,6 +803,17 @@ class PumiTally:
                 f"intersection_points() is implemented for the "
                 f"monolithic/sharded PumiTally facade only, not "
                 f"{type(self).__name__}"
+            )
+        if self.device_mesh is not None:
+            # The stash holds device arrays sharded over the particle
+            # axis, but the replay below would run walk_xpoints
+            # monolithically — an untested mixing of layouts (ADVICE
+            # r5). Refuse loudly until a sharded replay exists.
+            raise NotImplementedError(
+                "intersection_points() replay does not support a "
+                "device_mesh yet: the sharded replay path is untested. "
+                "Drop device_mesh (or record_xpoints) to use this "
+                "debug surface"
             )
         stash = getattr(self, "_xpoint_stash", None)
         if stash is None:

@@ -135,17 +135,29 @@ class TallyConfig:
     # the kernel default in place, keeping jit cache keys identical to
     # an untuned config). cond_every: unrolled iterations per while
     # step; perm_mode: cascade stage-boundary permutation strategy
-    # ("arrays"/"packed"/"indirect"; "auto" resolves via
-    # PUMIUMTALLY_WALK_PERM); window_factor: cascade shrink ratio;
-    # min_window: smallest compaction window. The partitioned engines'
-    # ownership-restricted walk runs its own in-round cascade (indirect
-    # form, parallel/partition.py walk_local) and consumes cond_every
-    # and min_window; perm_mode/window_factor apply to the
-    # monolithic/sharded/streaming walks only.
+    # ("arrays"/"packed"/"indirect" are the sort-free binary-partition
+    # forms; "sorted" restores the element-locality argsort; "auto"
+    # resolves via PUMIUMTALLY_WALK_PERM); window_factor: cascade
+    # shrink ratio; min_window: smallest compaction window. The
+    # partitioned engines' ownership-restricted walk runs its own
+    # in-round cascade (indirect form, parallel/partition.py
+    # walk_local) and consumes cond_every and min_window;
+    # perm_mode/window_factor apply to the monolithic/sharded/streaming
+    # walks only.
     walk_cond_every: Optional[int] = None
     walk_perm_mode: Optional[str] = None
     walk_window_factor: Optional[int] = None
     walk_min_window: Optional[int] = None
+    # How every redistribution site (cascade stage boundaries, the
+    # partitioned walk's in-round compaction, particle migration)
+    # computes its stable partition permutation: "rank" (counting ranks
+    # over the small key alphabet — sort-free, the default) or
+    # "argsort" (the seed's full stable sort). Both produce the
+    # IDENTICAL permutation, hence bitwise-identical physics
+    # (ops/bucketize.py, pinned by tests/test_partition_rank.py); the
+    # knob exists for measurement — tools/exp_partition_ab.py A/Bs the
+    # two on any backend. Applies to every engine.
+    walk_partition_method: Optional[str] = None
     # Partitioned engines only: when set and a chip's owned element
     # count L is <= this bound (and local adjacency fits the float
     # table), the per-chip local walk runs as the VMEM-resident one-hot
@@ -211,11 +223,18 @@ class TallyConfig:
                 f"device_groups must be >= 1, got {self.device_groups!r}"
             )
         if self.walk_perm_mode is not None and self.walk_perm_mode not in (
-            "auto", "arrays", "packed", "indirect"
+            "auto", "arrays", "packed", "indirect", "sorted"
         ):
             raise ValueError(
-                "walk_perm_mode must be auto/arrays/packed/indirect, "
-                f"got {self.walk_perm_mode!r}"
+                "walk_perm_mode must be auto/arrays/packed/indirect/"
+                f"sorted, got {self.walk_perm_mode!r}"
+            )
+        if self.walk_partition_method is not None and (
+            self.walk_partition_method not in ("rank", "argsort")
+        ):
+            raise ValueError(
+                "walk_partition_method must be 'rank' or 'argsort', "
+                f"got {self.walk_partition_method!r}"
             )
         if self.walk_window_factor is not None and int(
             self.walk_window_factor
@@ -267,6 +286,16 @@ class TallyConfig:
             else int(self.walk_cond_every)
         )
 
+    def resolved_partition_method(self) -> str:
+        """Partition-permutation method with the default applied
+        (consumed by the partitioned engines; the monolithic walks get
+        it through walk_kwargs)."""
+        return (
+            "rank"
+            if self.walk_partition_method is None
+            else self.walk_partition_method
+        )
+
     def walk_kwargs(self) -> tuple:
         """The non-default walk-kernel knobs as a hashable tuple of
         (name, value) pairs — passed as a STATIC argument through the
@@ -299,6 +328,12 @@ class TallyConfig:
             out.append(("window_factor", int(self.walk_window_factor)))
         if self.walk_min_window is not None:
             out.append(("min_window", int(self.walk_min_window)))
+        # Default-equal ("rank") is dropped for cache-key parity, like
+        # the other knobs.
+        if self.resolved_partition_method() != "rank":
+            out.append(
+                ("partition_method", self.resolved_partition_method())
+            )
         return tuple(out)
 
     def resolved_dtype(self) -> Any:

@@ -1,0 +1,192 @@
+"""Sort-free bucket partition: counting ranks over small-alphabet keys.
+
+Every redistribution point in the engine — the compaction cascade's
+stage boundaries (ops/walk.py), ``walk_local``'s in-round compaction
+and slot-order restore (parallel/partition.py), and particle migration
+(``_migrate_impl``) — needs the same primitive: given int keys drawn
+from a SMALL alphabet over N slots, move every slot to its stable
+within-bucket position (bucket 0 first, then bucket 1, …; original
+slot order preserved inside each bucket). The seed implementation
+bought this from a full-capacity stable ``argsort`` — measured 4.0 ms
+per 500k keys on v5e (docs/PERF_NOTES.md r2 profile) — even though the
+keys are done/paused flags (k = 2–3) or chip/block owners
+(k = nparts + 1), for which counting ranks suffice:
+
+    rank[i]  = #{j < i : key[j] == key[i]}       (per-bucket cumsum)
+    start[b] = #{j : key[j] < b}                 (exclusive count scan)
+    dest[i]  = start[key[i]] + rank[i]
+
+``dest`` is a permutation of ``iota(N)``; scattering rows to it (or
+gathering through the inverse permutation ``perm``) reproduces the
+stable sort EXACTLY — same integer permutation, hence bitwise-identical
+downstream results, pinned by tests/test_partition_rank.py. The rank
+cumsum is a [k,N] one-hot scan, evaluated in bucket slabs of
+``_RANK_SLAB`` so memory stays bounded when k is the block count of a
+finely sub-split mesh (hundreds of blocks on a ~1M-tet lattice).
+
+``method="argsort"`` computes the identical outputs through the old
+stable-argsort machinery — kept as the parity reference and the A/B
+arm (tools/exp_partition_ab.py), selectable end-to-end via
+``TallyConfig.walk_partition_method``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# Bucket-slab width for the rank cumsum: bounds the one-hot
+# intermediate at [_RANK_SLAB, N] however large the alphabet is
+# (migration keys scale with the block count). 64 keeps the slab f32
+# lane-aligned and the intermediate under ~0.3 MB per 1k slots.
+_RANK_SLAB = 64
+
+PARTITION_METHODS = ("rank", "argsort")
+
+
+def _check_method(method: str) -> None:
+    if method not in PARTITION_METHODS:
+        raise ValueError(
+            f"partition method must be one of {PARTITION_METHODS}, "
+            f"got {method!r}"
+        )
+
+
+def _iota_like(key: jnp.ndarray) -> jnp.ndarray:
+    # Derived from the input (not jnp.arange) so it carries the same
+    # varying/replication type as the data under shard_map — the same
+    # idiom as the cascade's slot-index carry (ops/walk.py).
+    return jnp.cumsum(jnp.ones_like(key)) - 1
+
+
+def bucket_counts(key: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """[num_buckets] occupancy of each bucket (a scatter-add, no sort)."""
+    return jnp.bincount(key, length=int(num_buckets))
+
+
+def counting_ranks(
+    key: jnp.ndarray, num_buckets: int, *, method: str = "rank"
+) -> jnp.ndarray:
+    """Stable within-bucket rank of every slot, as int32.
+
+    ``rank[i]`` counts the earlier slots sharing ``key[i]``'s bucket —
+    exactly the rank a stable sort would assign inside the bucket.
+    Keys must lie in ``[0, num_buckets)``.
+    """
+    _check_method(method)
+    key = key.astype(jnp.int32)
+    num_buckets = int(num_buckets)
+    if method == "argsort":
+        # Reference arm: the seed's post-sort rank machinery
+        # (pos − starts[key]) un-permuted back to slot order.
+        perm = jnp.argsort(key, stable=True)
+        counts = bucket_counts(key, num_buckets)
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+        )
+        pos = _iota_like(key)
+        rank_sorted = pos - starts[key[perm]]
+        return (
+            jnp.zeros_like(key).at[perm].set(rank_sorted.astype(jnp.int32))
+        )
+
+    if num_buckets <= 2:
+        # The cascade's hot case (done partition): one [N] cumsum.
+        # ones_before = #{j <= i : key[j] == 1}; zeros get their slot
+        # index minus the ones that preceded them.
+        ones_before = jnp.cumsum(key)
+        return jnp.where(
+            key == 1, ones_before - 1, _iota_like(key) - ones_before
+        ).astype(jnp.int32)
+
+    slab = min(_RANK_SLAB, num_buckets)
+
+    def slab_ranks(base):
+        # One-hot membership of this slab's buckets: [slab, N] → an
+        # inclusive cumsum along N is each slot's 1-based rank within
+        # its bucket, valid where the slot's key falls in the slab.
+        ids = base + lax.iota(jnp.int32, slab)
+        onehot = (key[None, :] == ids[:, None]).astype(jnp.int32)
+        csum = jnp.cumsum(onehot, axis=1)
+        col = jnp.clip(key - base, 0, slab - 1)
+        r = jnp.take_along_axis(csum, col[None, :], axis=0)[0] - 1
+        in_slab = (key >= base) & (key < base + slab)
+        return jnp.where(in_slab, r, 0)
+
+    nslabs = -(-num_buckets // slab)
+    if nslabs == 1:
+        return slab_ranks(jnp.asarray(0, jnp.int32))
+    # Large alphabets (finely sub-split meshes): accumulate slab by
+    # slab so the one-hot intermediate never exceeds [_RANK_SLAB, N].
+    return lax.fori_loop(
+        0,
+        nslabs,
+        lambda s, acc: acc + slab_ranks(s * slab),
+        jnp.zeros_like(key),
+    )
+
+
+def bucket_destinations(
+    key: jnp.ndarray, num_buckets: int, *, method: str = "rank"
+):
+    """(dest, counts, starts): each slot's stable partitioned position.
+
+    ``dest`` is the permutation a stable sort by ``key`` would apply:
+    row i of the partitioned layout is original slot j with
+    ``dest[j] == i``. Scatter rows to ``dest`` (``out.at[dest].set(rows)``)
+    for the partitioned order in ONE row operation — no argsort, no
+    permutation gather.
+    """
+    _check_method(method)
+    key = key.astype(jnp.int32)
+    counts = bucket_counts(key, int(num_buckets))
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    if method == "argsort":
+        # Seed-faithful A/B arm: ONE stable argsort, dest = its
+        # inverse. Charging this arm the rank-reconstruction machinery
+        # instead would overstate the argsort path's cost and flatter
+        # the rank arm in every recorded speedup.
+        perm = jnp.argsort(key, stable=True)
+        dest = (
+            jnp.zeros_like(key).at[perm].set(_iota_like(key))
+        ).astype(jnp.int32)
+        return dest, counts, starts
+    rank = counting_ranks(key, num_buckets, method=method)
+    dest = starts[key].astype(jnp.int32) + rank
+    return dest, counts, starts
+
+
+def partition_perm(
+    key: jnp.ndarray, num_buckets: int, *, method: str = "rank"
+):
+    """(perm, counts, starts) with ``perm == argsort(key, stable=True)``
+    — bit-for-bit — computed from counting ranks via one small int
+    scatter. For consumers that prefer gathering rows through the
+    permutation (the cascade's packed stage boundary) over scattering
+    them to ``dest``. ``method="argsort"`` IS the seed's direct stable
+    argsort (no rank machinery), so end-to-end A/Bs charge that arm
+    its true cost."""
+    _check_method(method)
+    if method == "argsort":
+        key = key.astype(jnp.int32)
+        counts = bucket_counts(key, int(num_buckets))
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+        )
+        return jnp.argsort(key, stable=True), counts, starts
+    dest, counts, starts = bucket_destinations(
+        key, num_buckets, method=method
+    )
+    perm = jnp.zeros_like(dest).at[dest].set(_iota_like(dest))
+    return perm, counts, starts
+
+
+def unpermute(values: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Restore accumulated-permutation order: row i holds original slot
+    ``idx[i]``; scatter rows home directly. Replaces the seed's
+    ``values[argsort(idx)]`` (an argsort plus a gather) with one
+    scatter — bitwise-identical, since both apply the same inverse
+    permutation."""
+    return jnp.zeros_like(values).at[idx].set(values)

@@ -340,11 +340,12 @@ def vmem_walk_local(
         effw_c = effw_ref[:]
         one_k = jnp.asarray(1.0, x0.dtype)
         iota = lax.broadcasted_iota(jnp.int32, (w_tile, Lp), 1)
-        if vma:
+        if vma and hasattr(lax, "pvary"):
             # Under shard_map's varying-axis checking, primitive
             # outputs computed from no input (the iota) stay
             # "unvarying" and refuse to combine with the varying ref
-            # data — promote explicitly.
+            # data — promote explicitly. (No-op guard: a pre-vma jax
+            # has neither the checker nor the primitive.)
             iota = lax.pvary(iota, tuple(vma))
 
         # flux and iters live in per-BLOCK output blocks revisited by
@@ -431,19 +432,24 @@ def vmem_walk_local(
         tile(), tile(), tile(), tile(), tile(),
         pl.BlockSpec((TILE_1D,), lambda b, t: (b,)),
     ]
+    # vma is a vma-era concept: only spell the kwarg when the caller
+    # actually passed axes (ShapeDtypeStruct on jax 0.4.x predates it).
+    def sds(shape, dtype):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
     out_shape = [
-        jax.ShapeDtypeStruct((S,), fdtype, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((S,), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((blocks * TILE_1D,), jnp.int32, vma=vma),
+        sds((S,), fdtype),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((blocks * TILE_1D,), jnp.int32),
     ]
     if tally:
         out_specs.append(pl.BlockSpec((Lp,), lambda b, t: (b,)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((blocks * Lp,), flux.dtype, vma=vma)
-        )
+        out_shape.append(sds((blocks * Lp,), flux.dtype))
     s_o, lelem_o, done_o, exited_o, pending_o, iters, *fparts = (
         pl.pallas_call(
             kernel,

@@ -36,10 +36,12 @@ end. Per iteration, for every not-done particle:
 Lock-step waste is bounded by **active-particle compaction**: the walk
 runs as a cascade of stages with halving windows. Each stage iterates
 only over the first W particles; when the number of still-active
-particles drops to the next window size, survivors are sorted to the
-front (stable argsort on (done, element) — a deterministic, XLA-friendly
-stand-in for the reference's stream compaction inside PUMIPic's rebuild;
-the element grouping rides along for free)
+particles drops to the next window size, survivors move to the front
+via a stable SORT-FREE binary partition on the done flag (counting
+ranks, ops/bucketize.py — a deterministic, XLA-friendly stand-in for
+the reference's stream compaction inside PUMIPic's rebuild; the
+"sorted" perm mode restores the argsort-on-(done, element) variant
+whose element grouping buys gather locality at argsort cost)
 and the window halves. Without this, every iteration pays for the full
 batch while the slowest particle finishes (reference's search loop has
 the same property, SURVEY.md §3.3); with it, total work approaches
@@ -59,6 +61,11 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
+from pumiumtally_tpu.ops.bucketize import (
+    PARTITION_METHODS,
+    partition_perm,
+    unpermute,
+)
 from pumiumtally_tpu.mesh.tetmesh import (
     TetMesh,
     WALK_TABLE_ADJ,
@@ -77,11 +84,11 @@ COND_EVERY_DEFAULT = 4
 WINDOW_FACTOR_DEFAULT = 2
 
 # How the compaction cascade applies the survivor permutation at each
-# stage boundary. All three produce BITWISE-identical results (same
-# values, same scatter order); they differ only in how many random-row
-# gathers the permutation costs — measured the largest cascade
-# component on v5e (docs/PERF_NOTES.md, ~51 ms/stage at 500k for the
-# per-array form):
+# stage boundary. "arrays"/"packed"/"indirect" produce BITWISE-identical
+# results (same values, same scatter order); they differ only in how
+# many random-row gathers the permutation costs — measured the largest
+# cascade component on v5e (docs/PERF_NOTES.md, ~51 ms/stage at 500k
+# for the per-array form):
 #   "arrays"   — permute each carried array separately (8 row gathers).
 #   "packed"   — pack the carry into one float [W,8] + one int [W,3]
 #                row matrix and permute those (2 row gathers; same
@@ -92,7 +99,18 @@ WINDOW_FACTOR_DEFAULT = 2
 #                slot index, and the boundary permutes only
 #                s + one int [W,3] (2 small gathers, but adds a [W,8]
 #                gather per walk iteration).
-_PERM_MODES = ("arrays", "packed", "indirect")
+# All three compute the survivor permutation SORT-FREE: a stable binary
+# partition on the done flag via counting ranks (ops/bucketize.py) —
+# the full-capacity argsort the seed paid per stage (4.0 ms / 500k
+# keys, docs/PERF_NOTES.md) is gone from the hot path.
+#   "sorted"   — the pre-rank behavior: stable argsort on
+#                (done, element), applied packed. Survivors are ALSO
+#                grouped by element, which r2 measured worth ~1.03x in
+#                gather/scatter locality — kept selectable so the chip
+#                window can re-A/B locality-vs-argsort-cost. Results
+#                differ from the other modes only by FP scatter order
+#                (a different, equally valid permutation).
+_PERM_MODES = ("arrays", "packed", "indirect", "sorted")
 
 # The mode "auto" resolves to when PUMIUMTALLY_WALK_PERM is unset.
 PERM_MODE_DEFAULT = "packed"
@@ -228,6 +246,7 @@ def walk(
     cond_every: int = COND_EVERY_DEFAULT,
     window_factor: int = WINDOW_FACTOR_DEFAULT,
     perm_mode: str = "auto",
+    partition_method: str = "rank",
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -256,11 +275,20 @@ def walk(
     commits ``s = 1``).
 
     ``perm_mode`` picks how the cascade applies the stage-boundary
-    permutation (see ``_PERM_MODES``) — all modes are bitwise
-    equivalent; "auto" resolves via ``PUMIUMTALLY_WALK_PERM`` (default
-    "packed"). ``window_factor`` is the cascade's window shrink ratio
-    (2 → halving; larger → fewer, coarser stages — fewer boundary
+    permutation (see ``_PERM_MODES``) — "arrays"/"packed"/"indirect"
+    are bitwise equivalent (sort-free binary done-partition); "sorted"
+    restores the element-locality argsort (FP-equal only); "auto"
+    resolves via ``PUMIUMTALLY_WALK_PERM`` (default "packed").
+    ``window_factor`` is the cascade's window shrink ratio (2 →
+    halving; larger → fewer, coarser stages — fewer boundary
     permutations at the cost of more lock-step waste).
+
+    ``partition_method`` selects how the sort-free modes compute the
+    survivor permutation: "rank" (counting ranks, the default) or
+    "argsort" (the seed's stable sort over the same binary key) — both
+    produce the IDENTICAL permutation, so results are bitwise equal;
+    the knob exists for parity tests and on-chip A/B
+    (tools/exp_partition_ab.py).
     """
     fdtype = x.dtype
     n_total = x.shape[0]
@@ -317,6 +345,11 @@ def walk(
             (done & ~exited)[:, None], dest, dest + (s - one)[:, None] * d0
         )
 
+    if partition_method not in PARTITION_METHODS:
+        raise ValueError(
+            f"partition_method must be one of {PARTITION_METHODS}, "
+            f"got {partition_method!r}"
+        )
     min_window = max(1, min_window)
     if not compact or n_total <= min_window:
         def cond(state):
@@ -408,13 +441,22 @@ def walk(
         # jax 0.8.x — duplicated/missing rows). Concatenate forces a
         # fresh result buffer and costs the same copy.
         if nxt:
-            # Stable sort on (done, current element): survivors move to
-            # the front AND are grouped by element — deterministic, and
-            # the sort is the price of the compaction itself. Only rows
-            # [:w] can be active, so sorting the window alone suffices
-            # and the sort shrinks with the cascade.
-            key = jnp.where(dh, imax, eh)
-            perm = jnp.argsort(key, stable=True)
+            # Survivors move to the front, stably. Default modes: a
+            # SORT-FREE binary partition on the done flag — counting
+            # ranks reproduce the stable-argsort permutation of that
+            # flag exactly (ops/bucketize.py), so no argsort runs in
+            # the hot path. "sorted" keeps the seed's stable argsort on
+            # (done, current element): survivors are also grouped by
+            # element, buying gather/scatter locality at argsort cost.
+            # Only rows [:w] can be active, so partitioning the window
+            # alone suffices and the cost shrinks with the cascade.
+            if mode == "sorted":
+                key = jnp.where(dh, imax, eh)
+                perm = jnp.argsort(key, stable=True)
+            else:
+                perm, _, _ = partition_perm(
+                    dh.astype(jnp.int32), 2, method=partition_method
+                )
             if mode == "arrays":
                 # Round-2 form: one row gather per carried array.
                 upd = lambda a, h: cat(h[perm], a, w)  # noqa: E731
@@ -434,7 +476,7 @@ def walk(
                 done = cat(ipack[:, 2].astype(bool), done, w)
                 if mode == "indirect":
                     s = cat(sh[perm], s, w)
-                else:  # "packed"
+                else:  # "packed" / "sorted"
                     fpack = jnp.concatenate(
                         [sh[:, None], dest[:w], d0[:w], eff_w[:w, None]],
                         axis=1,
@@ -448,12 +490,16 @@ def walk(
             elem = cat(eh, elem, w)
             done = cat(dh, done, w)
 
-    # Undo the accumulated permutation: row i holds original slot idx[i].
-    inv = jnp.argsort(idx, stable=True)
+    # Undo the accumulated permutation: row i holds original slot
+    # idx[i], so a direct scatter by idx restores slot order — the
+    # ``argsort(idx)`` + gather the seed paid here collapses to one
+    # scatter (bitwise identical: the same inverse permutation).
     if mode == "indirect":
         # dest/d0 were never permuted — restore the particle carries to
         # original order and materialize positions there directly.
-        s, elem, done = s[inv], elem[inv], done[inv]
+        s = unpermute(s, idx)
+        elem = unpermute(elem, idx)
+        done = unpermute(done, idx)
         exited = done & (s < one)
         return WalkResult(
             x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
@@ -462,7 +508,8 @@ def walk(
     exited = done & (s < one)
     x_fin = final_x(s, done, exited, dest, d0)
     return WalkResult(
-        x=x_fin[inv], elem=elem[inv], done=done[inv], exited=exited[inv],
+        x=unpermute(x_fin, idx), elem=unpermute(elem, idx),
+        done=unpermute(done, idx), exited=unpermute(exited, idx),
         flux=flux, iters=it,
     )
 

@@ -21,10 +21,13 @@ Here:
   as ops/walk.py, but a particle whose exit face is remote PAUSES at
   the partition face (its partial track length is already tallied) and
   records the target glid in ``pending``.
-- **Migration** (`migrate`): a global stable-sort-by-target scatter that
-  moves paused particles to their owning chip's slot range — under jit
-  over a sharded mesh this lowers to the all-to-all/collective-permute
-  the reference gets from MPI. Slots are over-provisioned by
+- **Migration** (`migrate`): a SORT-FREE rank/scatter that moves paused
+  particles to their owning chip's slot range — each slot's destination
+  is its stable within-target counting rank (ops/bucketize.py), so the
+  whole shuffle is one packed scatter (the seed paid a full-capacity
+  stable argsort plus a permutation gather per round). Under jit over a
+  sharded mesh this lowers to the all-to-all/collective-permute the
+  reference gets from MPI. Slots are over-provisioned by
   ``capacity_factor``; overflow raises rather than silently dropping.
 - **Flux** is owned: each chip accumulates only elements it owns, so no
   cross-chip reduction is needed at all (the ICI traffic is particle
@@ -62,13 +65,19 @@ from pumiumtally_tpu.mesh.tetmesh import (
     WALK_TABLE_NORMALS,
     WALK_TABLE_OFFSETS,
 )
+from pumiumtally_tpu.ops.bucketize import (
+    PARTITION_METHODS,
+    counting_ranks,
+    partition_perm,
+    unpermute,
+)
 from pumiumtally_tpu.ops.geometry import locate_chunk_by_planes
 from pumiumtally_tpu.ops.walk import (
     _MIN_WINDOW,
     COND_EVERY_DEFAULT,
     fused_tally_body,
 )
-from pumiumtally_tpu.parallel.sharded import _axis_name
+from pumiumtally_tpu.parallel.sharded import _axis_name, shard_map_check_kwargs
 
 try:  # jax >= 0.8
     from jax import shard_map
@@ -244,6 +253,7 @@ def walk_local(
     cond_every: int = COND_EVERY_DEFAULT,
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
+    partition_method: str = "rank",
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
@@ -274,10 +284,20 @@ def walk_local(
     carried original-slot index — and each stage boundary permutes only
     s plus one packed int row (lelem, pending, idx, done/exited bits).
     Inert slots here include PAUSED ones (they wait for migration), so
-    the cascade retires both early finishers and early pausers. Outputs
-    are restored to original slot order (migration depends on the slot
-    → chip layout).
+    the cascade retires both early finishers and early pausers: the
+    stage boundary is a stable SORT-FREE ternary partition
+    (active / paused / done, counting ranks — ops/bucketize.py) and the
+    final restore to original slot order (migration depends on the
+    slot → chip layout) is a direct scatter through the carried slot
+    index, not an argsort. ``partition_method`` ("rank"/"argsort")
+    switches the rank computation for parity tests and A/B — both
+    yield the identical permutation, hence bitwise-identical results.
     """
+    if partition_method not in PARTITION_METHODS:
+        raise ValueError(
+            f"partition_method must be one of {PARTITION_METHODS}, "
+            f"got {partition_method!r}"
+        )
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
     flying_b = flying.astype(bool)
@@ -367,7 +387,6 @@ def walk_local(
         [x0, d0, eff_w[:, None], jnp.zeros_like(eff_w)[:, None]], axis=1
     )  # [S,8]
     idx = jnp.cumsum(jnp.ones_like(lelem)) - 1  # varying under shard_map
-    imax = jnp.iinfo(jnp.int32).max
     cat = lambda h, a, w: jnp.concatenate([h, a[w:]], axis=0)  # noqa: E731
 
     s, done, exited, pending, it = s0, done, exited, pending0, it0
@@ -397,9 +416,14 @@ def walk_local(
         # Window write-backs use concatenate, not at[].set — see the
         # miscompile note in ops/walk.py's cascade.
         if nxt_w:
-            inert = dh | (ph >= 0)  # done OR paused: both wait out the round
-            key = jnp.where(inert, imax, eh)
-            perm = jnp.argsort(key, stable=True)
+            # Stable ternary partition, SORT-FREE: active slots to the
+            # front, then paused (waiting for migration), then done —
+            # counting ranks reproduce the stable-argsort permutation
+            # of this key exactly, so no argsort runs per stage.
+            key = jnp.where(dh, 2, jnp.where(ph >= 0, 1, 0))
+            perm, _, _ = partition_perm(
+                key, 3, method=partition_method
+            )
             ip = jnp.stack(
                 [eh, ph, idx[:w], dh.astype(jnp.int32)
                  + 2 * exh.astype(jnp.int32)],
@@ -419,11 +443,12 @@ def walk_local(
             pending = cat(ph, pending, w)
 
     # Restore original slot order (migration depends on the slot→chip
-    # layout); x materializes directly in original order since x0/d0
-    # were never permuted.
-    inv = jnp.argsort(idx, stable=True)
-    s, lelem = s[inv], lelem[inv]
-    done, exited, pending = done[inv], exited[inv], pending[inv]
+    # layout): row i holds original slot idx[i], so one scatter through
+    # idx IS the inverse permutation — no argsort(idx). x materializes
+    # directly in original order since x0/d0 were never permuted.
+    s, lelem = unpermute(s, idx), unpermute(lelem, idx)
+    done, exited = unpermute(done, idx), unpermute(exited, idx)
+    pending = unpermute(pending, idx)
     x_fin = jnp.where((done & ~exited)[:, None], dest, x0 + s[:, None] * d0)
     return x_fin, lelem, done, exited, pending, flux, it
 
@@ -485,39 +510,45 @@ def _unpack_state(fpack, ipack, layout) -> dict:
     return out
 
 
-def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict):
+def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict,
+                  partition_method: str = "rank"):
     """Trace-level body of ``migrate`` (see below) — also inlined into
     the jitted phase round loop so walk+migrate rounds compile as ONE
-    program with no per-round host sync."""
+    program with no per-round host sync.
+
+    SORT-FREE: each slot's destination is computed IN PLACE from its
+    stable within-target rank (counting ranks, ops/bucketize.py) —
+    ``dest = target·cap + rank`` — and the packed state matrices
+    scatter straight to those destinations. The seed paid a
+    full-capacity stable argsort PLUS a permutation gather per packed
+    matrix here (sort → gather → scatter); this is one scatter with the
+    bitwise-identical result (same (index, row) pairs — pinned by
+    tests/test_partition_rank.py). ``partition_method="argsort"`` keeps
+    the old rank computation for parity/A-B."""
     cap = state["pid"].shape[0]
     slot_chip = (jnp.cumsum(jnp.ones_like(state["pid"])) - 1) // cap_per_chip
     pending = state["pending"]
     alive = state["alive"]
     target = jnp.where(pending >= 0, pending // part_L, slot_chip)
-    # Dead slots sort after every real group so they never consume a
+    # Dead slots rank after every real group so they never consume a
     # real slot; their state is reset to defaults on the way out.
     key = jnp.where(alive, target, ndev)
-    perm = jnp.argsort(key, stable=True)
-    key_s = key[perm]
-    counts = jnp.bincount(key, length=ndev + 1)
-    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
-                              jnp.cumsum(counts)[:-1]])
-    pos = jnp.cumsum(jnp.ones_like(key_s)) - 1
-    rank = pos - starts[key_s]
-    overflow = jnp.any((key_s < ndev) & (rank >= cap_per_chip))
+    rank = counting_ranks(key, ndev + 1, method=partition_method)
+    overflow = jnp.any((key < ndev) & (rank >= cap_per_chip))
     dest_slot = jnp.where(
-        key_s < ndev, key_s * cap_per_chip + rank, cap
+        key < ndev, key * cap_per_chip + rank, cap
     )  # dead -> out of bounds, dropped by the scatter
 
     # Move the WHOLE state as two packed matrices (one float, one int)
-    # instead of ~10 per-array gather+scatter pairs.
+    # instead of ~10 per-array gather+scatter pairs — scattered
+    # DIRECTLY to destination slots, no argsort, no permutation gather.
     fpack, ipack, fdef, idef, layout = _pack_state(
         state, _default_state(cap, state)
     )
     if fpack is not None:
-        fpack = fdef.at[dest_slot].set(fpack[perm], mode="drop")
+        fpack = fdef.at[dest_slot].set(fpack, mode="drop")
     if ipack is not None:
-        ipack = idef.at[dest_slot].set(ipack[perm], mode="drop")
+        ipack = idef.at[dest_slot].set(ipack, mode="drop")
     new_state = _unpack_state(fpack, ipack, layout)
     # Migrated particles resume inside their new chip's local mesh.
     arrived = new_state["pending"] >= 0
@@ -528,8 +559,12 @@ def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     return new_state, overflow
 
 
-@partial(jax.jit, static_argnames=("part_L", "ndev", "cap_per_chip"))
-def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
+@partial(
+    jax.jit,
+    static_argnames=("part_L", "ndev", "cap_per_chip", "partition_method"),
+)
+def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict,
+            partition_method: str = "rank"):
     """Ship paused particles (pending >= 0) to the chip owning their
     target element; everything else stays in its chip's slot range.
 
@@ -538,13 +573,14 @@ def migrate(part_L: int, ndev: int, cap_per_chip: int, state: dict):
     Returns (new_state, overflowed) — overflow means some chip received
     more particles than its slot capacity.
 
-    Jitted as ONE program: the sort/scatter over device-sharded arrays
+    Jitted as ONE program: the rank/scatter over device-sharded arrays
     lowers to a single XLA module (one set of collectives), which both
     performs better and avoids flooding the runtime with per-op
     rendezvous (observed to trip XLA:CPU's 40s collective timeout when
     issued eagerly op-by-op on 8 virtual devices).
     """
-    return _migrate_impl(part_L, ndev, cap_per_chip, state)
+    return _migrate_impl(part_L, ndev, cap_per_chip, state,
+                         partition_method)
 
 
 def _default_state(cap: int, like: dict) -> dict:
@@ -620,6 +656,7 @@ class PartitionedEngine:
         min_window: int = _MIN_WINDOW,
         vmem_walk_max_elems: Optional[int] = None,
         block_kernel: str = "vmem",
+        partition_method: str = "rank",
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -659,7 +696,13 @@ class PartitionedEngine:
                 f"block_kernel must be 'vmem' or 'gather', got "
                 f"{block_kernel!r}"
             )
+        if partition_method not in PARTITION_METHODS:
+            raise ValueError(
+                f"partition_method must be one of {PARTITION_METHODS}, "
+                f"got {partition_method!r}"
+            )
         self.block_kernel = block_kernel
+        self.partition_method = partition_method
         if block_kernel == "vmem":
             from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
 
@@ -730,6 +773,8 @@ class PartitionedEngine:
         self._n_lost_cache = 0
         self._last_rounds_dev = None
         self._last_rounds_cache = 0
+        self._last_disp_dev = None
+        self._last_disp_cache = 0
         self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
             "x": jnp.zeros((self.cap, 3), dtype),
@@ -783,6 +828,7 @@ class PartitionedEngine:
             mesh=self.device_mesh,
             in_specs=(pp, pp, P()),
             out_specs=P(),
+            **shard_map_check_kwargs(),
         )
         def locate(table, valid, pts):
             le = lax.map(
@@ -858,6 +904,7 @@ class PartitionedEngine:
         self.state, overflow = migrate(
             part_L=self.part.L, ndev=self.nparts,
             cap_per_chip=self.cap_per_block, state=st,
+            partition_method=self.partition_method,
         )
         # Mark the phase finished for all particles.
         self.state["done"] = jnp.ones((self.cap,), bool)
@@ -894,6 +941,26 @@ class PartitionedEngine:
         return self._last_rounds_cache
 
     @property
+    def last_block_dispatches(self) -> int:
+        """Per-block walk dispatches of the most recent phase, summed
+        over its rounds and all chips.
+
+        For the gather sub-split this counts OCCUPIED blocks only —
+        the empty-block-skip diagnostic: compare against
+        ``last_walk_rounds * nparts``, the work a full per-round sweep
+        would dispatch (at 45 migration rounds on the lattice smoke
+        run, most blocks are empty most rounds). The vmem kernel
+        reports rounds × nparts (it sweeps every block); unblocked
+        engines report rounds × ndev. Reading it fetches one device
+        scalar (a sync), cached after the first read."""
+        if self._last_disp_cache is None:
+            self._last_disp_cache = (
+                0 if self._last_disp_dev is None
+                else int(self._last_disp_dev)
+            )
+        return self._last_disp_cache
+
+    @property
     def _n_lost(self) -> int:
         if self._n_lost_cache is None:
             self._n_lost_cache = (
@@ -914,7 +981,8 @@ class PartitionedEngine:
         # last, smaller chunk's capacity).
         key = ("phase", tally, self.cap_per_chip, self.max_rounds,
                self.max_iters, self.tol, self.cond_every, self.min_window,
-               self.use_vmem_walk, self.blocks_per_chip, id(self.part))
+               self.use_vmem_walk, self.blocks_per_chip,
+               self.partition_method, id(self.part))
         if key in self._jit_cache:
             return self._jit_cache[key]
         pp = P(self.axis)
@@ -927,6 +995,7 @@ class PartitionedEngine:
         cond_every = self.cond_every
         min_window = self.min_window
         has_adj = self.part.adj_int is not None
+        pmethod = self.partition_method
 
         use_vmem = self.use_vmem_walk
 
@@ -944,110 +1013,122 @@ class PartitionedEngine:
                     tally=tally, tol=tol, max_iters=max_iters,
                     blocks=blocks,
                 )
+                # The Pallas kernel sweeps every block unconditionally.
+                n_disp = jnp.sum(jnp.zeros_like(lelem)) + blocks
             elif blocks > 1:
-                # Gather sub-split: run walk_local block-by-block with
-                # lax.map (sequential, NOT vmap — a batched gather over
-                # the stacked table would be the monolithic gather
-                # again). Each map step's [L,20] block table is a
-                # loop-invariant few hundred KB, so it stays resident
-                # on-chip for that block's whole while_loop — the
-                # measured small-table regime (2.2-2.4M moves/s at
-                # L<=3k, docs/PERF_NOTES.md round 4). Layout contract
+                # Gather sub-split: run walk_local block-by-block,
+                # sequentially (NOT vmap — a batched gather over the
+                # stacked table would be the monolithic gather again).
+                # Each step's [L,20] block table is a loop-invariant
+                # few hundred KB, so it stays resident on-chip for
+                # that block's whole while_loop — the measured
+                # small-table regime (2.2-2.4M moves/s at L<=3k,
+                # docs/PERF_NOTES.md round 4). Layout contract
                 # identical to the vmem sub-split: slots grouped by
                 # block, lelem block-local, flux [blocks*L].
+                #
+                # The sequential loop visits OCCUPIED blocks only: a
+                # lax.while_loop over the compacted list of block ids
+                # holding any not-done slot (stable counting-rank
+                # partition of the occupancy flags, ops/bucketize.py).
+                # Migration rounds beyond the first touch only the
+                # frontier blocks — at 45 rounds on the 1M-tet lattice
+                # smoke run most blocks are empty most rounds — and an
+                # empty block now dispatches NOTHING, not even a
+                # skipped lax.map step. A skipped block's state is
+                # exactly walk_local on an all-done batch: unchanged
+                # carries, fresh all- -1 pending, flux untouched.
                 ncap = x.shape[0]
                 cb = ncap // blocks
-                tb = table.reshape(blocks, part_L, table.shape[-1])
+                twidth = table.shape[-1]
+                occ = jnp.any(~done.reshape(blocks, cb), axis=1)
+                n_occ = jnp.sum(occ.astype(jnp.int32))
+                order, _, _ = partition_perm(
+                    (~occ).astype(jnp.int32), 2, method=pmethod
+                )
+                pending = jnp.full_like(lelem, -1)
 
-                def one_block(args):
-                    if has_adj:
-                        (t_b, a_b, x_b, le_b, d_b, f_b, w_b, dn_b,
-                         ex_b, fx_b) = args
-                    else:
-                        (t_b, x_b, le_b, d_b, f_b, w_b, dn_b,
-                         ex_b, fx_b) = args
-                        a_b = None
+                def blk_cond(c):
+                    return c[0] < n_occ
 
-                    def run(op):
-                        x_, le_, d_, f_, w_, dn_, ex_, fx_ = op
-                        return walk_local(
-                            t_b, x_, le_, d_, f_, w_, dn_, ex_, fx_,
-                            tally=tally, tol=tol, max_iters=max_iters,
-                            adj_int=a_b, cond_every=cond_every,
-                            min_window=min_window,
-                        )
-
-                    def skip(op):
-                        # Bitwise-identical to walk_local on an
-                        # all-done batch: state unchanged (x_fin
-                        # reduces to the committed x for done
-                        # particles), fresh all- -1 pending, flux
-                        # untouched, zero iterations.
-                        x_, le_, d_, f_, w_, dn_, ex_, fx_ = op
-                        return (x_, le_, dn_, ex_,
-                                jnp.full_like(le_, -1), fx_,
-                                jnp.asarray(0, jnp.int32))
-
-                    # Migration rounds beyond the first touch only the
-                    # frontier blocks; an idle block (every slot done)
-                    # must not pay the walk's cascade/argsort schedule
-                    # — with hundreds of blocks (1M-tet lattice) that
-                    # cost dominates late rounds.
-                    return lax.cond(
-                        jnp.any(~dn_b), run, skip,
-                        (x_b, le_b, d_b, f_b, w_b, dn_b, ex_b, fx_b),
+                def blk_body(c):
+                    t, x, lelem, done, exited, pending, flux = c
+                    b = order[t]
+                    po = b * cb  # first particle slot of block b
+                    eo = b * part_L  # first element row of block b
+                    z = jnp.zeros((), b.dtype)  # col index, same dtype
+                    a_b = (
+                        lax.dynamic_slice(adj, (eo, z), (part_L, 4))
+                        if has_adj else None
+                    )
+                    xb, leb, dnb, exb, pb, fxb, _ = walk_local(
+                        lax.dynamic_slice(
+                            table, (eo, z), (part_L, twidth)
+                        ),
+                        lax.dynamic_slice(x, (po, z), (cb, 3)),
+                        lax.dynamic_slice(lelem, (po,), (cb,)),
+                        lax.dynamic_slice(dest, (po, z), (cb, 3)),
+                        lax.dynamic_slice(fly, (po,), (cb,)),
+                        lax.dynamic_slice(w, (po,), (cb,)),
+                        lax.dynamic_slice(done, (po,), (cb,)),
+                        lax.dynamic_slice(exited, (po,), (cb,)),
+                        lax.dynamic_slice(flux, (eo,), (part_L,)),
+                        tally=tally, tol=tol, max_iters=max_iters,
+                        adj_int=a_b, cond_every=cond_every,
+                        min_window=min_window, partition_method=pmethod,
+                    )
+                    return (
+                        t + 1,
+                        lax.dynamic_update_slice(x, xb, (po, z)),
+                        lax.dynamic_update_slice(lelem, leb, (po,)),
+                        lax.dynamic_update_slice(done, dnb, (po,)),
+                        lax.dynamic_update_slice(exited, exb, (po,)),
+                        lax.dynamic_update_slice(pending, pb, (po,)),
+                        lax.dynamic_update_slice(flux, fxb, (eo,)),
                     )
 
-                per_block = (
-                    (tb,) + ((adj.reshape(blocks, part_L, -1),)
-                             if has_adj else ())
-                    + (
-                        x.reshape(blocks, cb, 3),
-                        lelem.reshape(blocks, cb),
-                        dest.reshape(blocks, cb, 3),
-                        fly.reshape(blocks, cb),
-                        w.reshape(blocks, cb),
-                        done.reshape(blocks, cb),
-                        exited.reshape(blocks, cb),
-                        flux.reshape(blocks, part_L),
-                    )
+                _, x, lelem, done, exited, pending, flux = lax.while_loop(
+                    blk_cond, blk_body,
+                    (jnp.sum(jnp.zeros_like(lelem)), x, lelem, done,
+                     exited, pending, flux),
                 )
-                xb, leb, dnb, exb, pb, fxb, _it = lax.map(
-                    one_block, per_block
-                )
-                x = xb.reshape(ncap, 3)
-                lelem = leb.reshape(ncap)
-                done = dnb.reshape(ncap)
-                exited = exb.reshape(ncap)
-                pending = pb.reshape(ncap)
-                flux = fxb.reshape(blocks * part_L)
+                n_disp = n_occ
             else:
                 x, lelem, done, exited, pending, flux, _ = walk_local(
                     table, x, lelem, dest, fly, w, done, exited, flux,
                     tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
                     cond_every=cond_every, min_window=min_window,
+                    partition_method=pmethod,
                 )
+                # One whole-partition walk per chip per round.
+                n_disp = jnp.sum(jnp.zeros_like(lelem)) + 1
             # Global round status computed in-program (one psum each) so
             # the while_loop can branch on them without leaving the
-            # device.
+            # device. n_disp: per-block walk dispatches this round, all
+            # chips — the empty-block-skip diagnostic for the gather
+            # sub-split (occupied blocks only).
             n_pending = lax.psum(jnp.sum(pending >= 0), ax)
             n_not_done = lax.psum(jnp.sum(~done), ax)
-            return x, lelem, done, exited, pending, flux, n_pending, n_not_done
+            n_disp = lax.psum(n_disp, ax)
+            return (x, lelem, done, exited, pending, flux, n_pending,
+                    n_not_done, n_disp)
 
         n_in = 10 if has_adj else 9
-        # check_vma is disabled ONLY for the vmem-kernel variant: this
-        # jax version's pallas interpret path re-traces the kernel with
-        # physical types that drop the varying-axis tags, so the vma
-        # checker rejects any pallas_call under shard_map (its own
-        # error message recommends exactly this workaround). The gather
-        # variant keeps full vma checking; result parity between the
-        # two engines is pinned by tests/test_vmem_walk.py.
+        # Output-type checking (check_vma on current jax, check_rep on
+        # jax 0.4.x — shard_map_check_kwargs resolves the spelling) is
+        # disabled ONLY for the vmem-kernel variant: the pallas
+        # interpret path re-traces the kernel with physical types that
+        # drop the varying-axis tags, so the vma checker rejects any
+        # pallas_call under shard_map (its own error message recommends
+        # exactly this workaround). The gather variant keeps full
+        # checking; result parity between the two engines is pinned by
+        # tests/test_vmem_walk.py.
         round_sm = shard_map(
             round_kernel,
             mesh=self.device_mesh,
             in_specs=(pp,) * n_in,
-            out_specs=(pp,) * 6 + (P(), P()),
-            check_vma=not use_vmem,
+            out_specs=(pp,) * 6 + (P(), P(), P()),
+            **shard_map_check_kwargs(not use_vmem),
         )
 
         @jax.jit
@@ -1069,44 +1150,47 @@ class PartitionedEngine:
                     st["x"], st["lelem"], st["dest"], st["fly"], st["w"],
                     st["done"], st["exited"], fx,
                 )
-                x, lelem, done, exited, pending, fx, n_p, n_nd = round_sm(
-                    *args
-                )
+                (x, lelem, done, exited, pending, fx, n_p, n_nd,
+                 n_disp) = round_sm(*args)
                 return (
                     dict(st, x=x, lelem=lelem, done=done, exited=exited,
                          pending=pending),
-                    fx, n_p, n_nd,
+                    fx, n_p, n_nd, n_disp,
                 )
 
-            st, fx, n_p, n_nd = call_round(st, flux)
+            st, fx, n_p, n_nd, disp = call_round(st, flux)
 
             def cond(c):
-                it, _st, _fx, n_p, _n_nd, ovf = c
+                it, _st, _fx, n_p, _n_nd, _disp, ovf = c
                 return (n_p > 0) & (it < max_rounds) & ~ovf
 
             def body(c):
-                it, st, fx, n_p, n_nd, ovf = c
-                st2, ovf2 = _migrate_impl(part_L, nparts, cap_b, st)
+                it, st, fx, n_p, n_nd, disp, ovf = c
+                st2, ovf2 = _migrate_impl(part_L, nparts, cap_b, st,
+                                          pmethod)
                 # An overflowing migrate scatters colliding slots: do
                 # NOT walk (and tally) from that corrupted state — the
                 # loop cond exits on ovf and the host raises.
-                st3, fx3, n_p3, n_nd3 = lax.cond(
+                st3, fx3, n_p3, n_nd3, d3 = lax.cond(
                     ovf2,
-                    lambda op: (op[0], op[1], n_p, n_nd),
+                    lambda op: (op[0], op[1], n_p, n_nd,
+                                jnp.zeros_like(disp)),
                     lambda op: call_round(*op),
                     (st2, fx),
                 )
-                return it + 1, st3, fx3, n_p3, n_nd3, ovf | ovf2
+                return it + 1, st3, fx3, n_p3, n_nd3, disp + d3, ovf | ovf2
 
-            it, st, fx, n_p, n_nd, ovf = lax.while_loop(
+            it, st, fx, n_p, n_nd, disp, ovf = lax.while_loop(
                 cond, body,
-                (jnp.asarray(1, jnp.int32), st, fx, n_p, n_nd,
+                (jnp.asarray(1, jnp.int32), st, fx, n_p, n_nd, disp,
                  jnp.asarray(False)),
             )
             found_all = (n_nd == 0) & (n_p == 0)
-            # `it` counts walk rounds (== migrations + 1): a cheap
-            # diagnostic for capacity_factor / partition-quality tuning.
-            return st, fx, found_all, ovf, it
+            # `it` counts walk rounds (== migrations + 1); `disp` the
+            # per-block walk dispatches summed over rounds — cheap
+            # diagnostics for capacity_factor / partition quality and
+            # the gather sub-split's empty-block skip.
+            return st, fx, found_all, ovf, it, disp
 
         self._jit_cache[key] = phase
         return phase
@@ -1125,14 +1209,17 @@ class PartitionedEngine:
         on overflow the state is corrupt, which is acceptable because
         the raise abandons the run."""
         phase = self._phase_program(tally)
-        st, fx, found_all, ovf, rounds = phase(
+        st, fx, found_all, ovf, rounds, disp = phase(
             self.part.table, self.part.adj_int, self.state, self.flux_padded
         )
-        # Lazy device scalar; fetched only if someone reads the
-        # last_walk_rounds diagnostic (a fetch is a sync; the host int
-        # is cached after the first read, like _n_lost).
+        # Lazy device scalars; fetched only if someone reads the
+        # last_walk_rounds / last_block_dispatches diagnostics (a fetch
+        # is a sync; the host int is cached after the first read, like
+        # _n_lost).
         self._last_rounds_dev = rounds
         self._last_rounds_cache = None
+        self._last_disp_dev = disp
+        self._last_disp_cache = None
         if defer_sync:
             self.state = st
             self.flux_padded = fx
@@ -1211,6 +1298,7 @@ class PartitionedEngine:
         self.state, overflow = migrate(
             part_L=self.part.L, ndev=self.nparts,
             cap_per_chip=self.cap_per_block, state=st,
+            partition_method=self.partition_method,
         )
         self._check_overflow(overflow)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)

@@ -63,6 +63,16 @@ DEFAULT_CANDIDATES: Tuple[dict, ...] = (
     # (1.09M moves/s) while packed's best static corner was cond_every
     # 8 — probe their combination too (tools/r4_onchip/digest.md).
     {"walk_perm_mode": "indirect", "walk_cond_every": 8},
+    # Redistribution axis (this PR): the default stage boundary is now
+    # the sort-free counting-rank done-partition. "sorted" restores the
+    # element-locality argsort (r2 measured the locality worth ~1.03x —
+    # worth re-probing against the saved argsort cost per chip), and
+    # the argsort partition_method keeps the binary partition but
+    # computes it with the old sort (isolates rank-vs-sort compute from
+    # the locality effect).
+    {"walk_perm_mode": "sorted", "walk_cond_every": 4},
+    {"walk_perm_mode": "packed", "walk_cond_every": 4,
+     "walk_partition_method": "argsort"},
 )
 
 
@@ -171,6 +181,8 @@ def _drop_defaults(knobs: dict) -> dict:
         out.pop("walk_window_factor")
     if out.get("walk_min_window") == _MIN_WINDOW:
         out.pop("walk_min_window")
+    if out.get("walk_partition_method") == "rank":
+        out.pop("walk_partition_method")
     if "walk_perm_mode" in out and out["walk_perm_mode"] == _resolve_perm_mode(
         "auto"
     ):

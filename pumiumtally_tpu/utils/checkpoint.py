@@ -218,6 +218,7 @@ def _restore_partitioned_engine(eng, x, elem, flux, dtype) -> None:
     eng.state, overflow = migrate(
         part_L=eng.part.L, ndev=eng.nparts,
         cap_per_chip=eng.cap_per_block, state=st,
+        partition_method=eng.partition_method,
     )
     eng._check_overflow(overflow)
     eng.state["done"] = jnp.ones((eng.cap,), bool)

@@ -13,11 +13,18 @@ around its device window:
 - shell tools use ``flock <LOCK_PATH> cmd`` — same file, same
   semantics.
 
-Reentrancy: a holder exports ``PUMIUMTALLY_CHIP_LOCK_HELD=1`` so its
-own subprocesses (bench's vmem child, e.g.) don't deadlock against the
-parent's lock. The lock protects a *window*, not correctness — a
-non-cooperating process can still dial the tunnel; the interlock makes
-the in-repo tools honest with each other.
+Reentrancy: in-process nesting is tracked by a module-level flag
+(``_held_in_process``) — flock(2) is per-open-file, so a second
+acquire in the same process would self-deadlock without it. A holder
+ALSO exports ``PUMIUMTALLY_CHIP_LOCK_HELD=1``, which exists purely for
+CHILD-PROCESS inheritance (bench's vmem child, ``flock`` shell tools):
+children see the env var and skip re-acquiring the parent's window.
+The env var is not consulted as this process's own state beyond that —
+a stale value inherited from a crashed parent shell is honored as "a
+parent holds the window", which is exactly its meaning. The lock
+protects a *window*, not correctness — a non-cooperating process can
+still dial the tunnel; the interlock makes the in-repo tools honest
+with each other.
 """
 
 from __future__ import annotations
@@ -30,6 +37,11 @@ LOCK_PATH = os.environ.get(
     "PUMIUMTALLY_CHIP_LOCK", "/tmp/pumiumtally_chip.lock"
 )
 _HELD_ENV = "PUMIUMTALLY_CHIP_LOCK_HELD"
+# THIS process already holds the lock (nested chip_lock contexts).
+# Module state, not the env var: os.environ is process-global mutable
+# state that anything (a test harness, a driver) may scrub mid-window,
+# and the env var's documented meaning is child-inheritance only.
+_held_in_process = False
 
 
 @contextmanager
@@ -37,11 +49,16 @@ def chip_lock(timeout_s: float | None = None, *, blocking: bool = True):
     """Acquire the accelerator window lock.
 
     Yields True when the lock is held (or inherited from a parent
-    holder), False when ``blocking=False``/timeout expired and the lock
-    is busy — the caller decides whether to skip or proceed unlocked.
+    holder/outer context), False when ``blocking=False``/timeout
+    expired and the lock is busy — the caller decides whether to skip
+    or proceed unlocked.
     """
+    global _held_in_process
+    if _held_in_process:
+        yield True  # an outer context in this process owns the window
+        return
     if os.environ.get(_HELD_ENV) == "1":
-        yield True  # parent already owns the window
+        yield True  # a parent process owns the window (inherited env)
         return
     try:
         import fcntl
@@ -64,11 +81,13 @@ def chip_lock(timeout_s: float | None = None, *, blocking: bool = True):
                     break
                 time.sleep(1.0)
         if acquired:
-            os.environ[_HELD_ENV] = "1"
+            _held_in_process = True
+            os.environ[_HELD_ENV] = "1"  # for child processes only
         try:
             yield acquired
         finally:
             if acquired:
+                _held_in_process = False
                 os.environ.pop(_HELD_ENV, None)
                 fcntl.flock(fd, fcntl.LOCK_UN)
     finally:

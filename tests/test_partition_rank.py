@@ -1,0 +1,277 @@
+"""Sort-free redistribution: bitwise parity at all three rewired sites
+plus the gather sub-split's empty-block skip.
+
+The counting-rank partition (ops/bucketize.py) replaces the stable
+argsort at (a) the compaction cascade's stage boundaries (ops/walk.py),
+(b) walk_local's in-round compaction and slot restore, and (c) particle
+migration's destination computation (parallel/partition.py). Both
+methods compute the IDENTICAL permutation, so every observable —
+flux included — must be BITWISE equal between
+``partition_method="rank"`` and ``"argsort"`` (the same parity pattern
+as the perm-mode tests). The "sorted" perm mode (element-locality
+argsort, the pre-rank default) is a different-but-valid permutation:
+FP-equal only.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.ops.walk import walk
+from pumiumtally_tpu.parallel import make_device_mesh
+from pumiumtally_tpu.parallel.partition import migrate, walk_local
+
+
+def _walk_setup(seed=0, n=2048, div=6):
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(np.tile(np.mean(
+        np.asarray(mesh.coords)[np.asarray(mesh.tet2vert)[0]], axis=0),
+        (n, 1)))
+    elem = jnp.zeros((n,), jnp.int32)
+    src = jnp.asarray(rng.uniform(0.05, 0.95, (n, 3)))
+    r = walk(mesh, x, elem, src, jnp.ones((n,), jnp.int8),
+             jnp.zeros((n,)), jnp.zeros((mesh.nelems,)),
+             tally=False, tol=1e-12, max_iters=4096, compact=False)
+    assert bool(jnp.all(r.done))
+    dest = jnp.asarray(np.asarray(src) + rng.normal(scale=0.2, size=(n, 3)))
+    fly = jnp.asarray((rng.uniform(size=n) > 0.1).astype(np.int8))
+    dest = jnp.where(fly[:, None] == 1, dest, r.x)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    return mesh, r.x, r.elem, dest, fly, w
+
+
+# -- site (a): the compaction cascade -----------------------------------
+
+@pytest.mark.parametrize("mode", ["packed", "indirect", "arrays"])
+def test_cascade_rank_vs_argsort_bitwise(mode):
+    mesh, x, elem, dest, fly, w = _walk_setup()
+    flux0 = jnp.zeros((mesh.nelems,))
+    out = {
+        meth: walk(mesh, x, elem, dest, fly, w, flux0,
+                   tally=True, tol=1e-12, max_iters=4096,
+                   compact=True, min_window=256, perm_mode=mode,
+                   partition_method=meth)
+        for meth in ("rank", "argsort")
+    }
+    a, b = out["rank"], out["argsort"]
+    assert bool(jnp.all(a.done))
+    for f in ("x", "elem", "done", "exited", "flux"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        )
+
+
+def test_sorted_mode_is_fp_equal_only():
+    """perm_mode="sorted" (the pre-rank element-locality argsort) is a
+    different, equally valid permutation: identical per-particle state,
+    flux equal to scatter-order round-off."""
+    mesh, x, elem, dest, fly, w = _walk_setup(seed=3)
+    flux0 = jnp.zeros((mesh.nelems,))
+    a = walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-12,
+             max_iters=4096, compact=True, min_window=256,
+             perm_mode="packed")
+    s = walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-12,
+             max_iters=4096, compact=True, min_window=256,
+             perm_mode="sorted")
+    np.testing.assert_array_equal(np.asarray(a.x), np.asarray(s.x))
+    np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(s.elem))
+    np.testing.assert_allclose(
+        np.asarray(a.flux), np.asarray(s.flux), rtol=1e-12, atol=1e-12
+    )
+    with pytest.raises(ValueError, match="partition_method"):
+        walk(mesh, x, elem, dest, fly, w, flux0, tally=True, tol=1e-12,
+             max_iters=4096, partition_method="radix")
+
+
+# -- site (b): walk_local's cascade + restore ---------------------------
+
+def test_walk_local_rank_vs_argsort_bitwise():
+    """Direct walk_local with the cascade engaged (min_window below the
+    slot count) and remote pauses in play: every output including the
+    owned flux must be bitwise identical across methods."""
+    from pumiumtally_tpu.parallel.partition import build_partition
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    part = build_partition(mesh, 2)
+    rng = np.random.default_rng(7)
+    n = 1024
+    # Localize on the FULL mesh, then keep chip 0's particles.
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    glid = np.asarray(part.glid_of_orig)[ref.elem_ids]
+    on0 = glid < part.L
+    x = jnp.asarray(src[on0])
+    lelem = jnp.asarray(glid[on0], jnp.int32)
+    m = int(on0.sum())
+    assert m > 300  # the RCB split leaves a real population on chip 0
+    dest = jnp.asarray(  # some cross the partition face -> pauses
+        np.clip(src[on0] + rng.normal(scale=0.3, size=(m, 3)), -0.1, 1.1)
+    )
+    fly = jnp.ones((m,), jnp.int8)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, m))
+    done0 = jnp.zeros((m,), bool)
+    ex0 = jnp.zeros((m,), bool)
+    out = {
+        meth: walk_local(
+            part.table[: part.L], x, lelem, dest, fly, w, done0, ex0,
+            jnp.zeros((part.L,)), tally=True, tol=1e-12, max_iters=4096,
+            cond_every=2, min_window=64, partition_method=meth,
+        )
+        for meth in ("rank", "argsort")
+    }
+    paused = np.asarray(out["rank"][4]) >= 0
+    assert paused.any()  # remote pauses actually exercised
+    for a, b in zip(out["rank"], out["argsort"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- site (c): migration ------------------------------------------------
+
+def test_migrate_rank_vs_argsort_bitwise():
+    """Synthetic migration shuffle with live, paused, and dead slots:
+    the direct-scatter rank path must reproduce the sorted path's state
+    bit-for-bit, overflow flag included."""
+    nparts, cap_b, part_L = 7, 13, 50
+    cap = nparts * cap_b
+    rng = np.random.default_rng(11)
+    pend = np.full(cap, -1, np.int32)
+    movers = rng.uniform(size=cap) < 0.3
+    pend[movers] = rng.integers(0, nparts * part_L, movers.sum())
+    alive = rng.uniform(size=cap) < 0.9
+    state = {
+        "x": jnp.asarray(rng.random((cap, 3))),
+        "dest": jnp.asarray(rng.random((cap, 3))),
+        "w": jnp.asarray(rng.random(cap)),
+        "lelem": jnp.asarray(rng.integers(0, part_L, cap), jnp.int32),
+        "pending": jnp.asarray(pend),
+        "pid": jnp.asarray(
+            np.where(alive, np.arange(cap), -1), jnp.int32),
+        "alive": jnp.asarray(alive),
+        "done": jnp.asarray(rng.uniform(size=cap) < 0.5),
+        "exited": jnp.asarray(rng.uniform(size=cap) < 0.1),
+        "fly": jnp.asarray(rng.integers(0, 2, cap), jnp.int8),
+    }
+    outs = {}
+    for meth in ("rank", "argsort"):
+        st, ovf = migrate(part_L=part_L, ndev=nparts, cap_per_chip=cap_b,
+                          state=dict(state), partition_method=meth)
+        outs[meth] = (st, bool(ovf))
+    assert outs["rank"][1] == outs["argsort"][1]
+    for k in state:
+        np.testing.assert_array_equal(
+            np.asarray(outs["rank"][0][k]),
+            np.asarray(outs["argsort"][0][k]),
+            err_msg=k,
+        )
+
+
+# -- engine-level: all three sites composed -----------------------------
+
+def test_partitioned_engine_rank_vs_argsort_bitwise():
+    """8-chip partitioned engine, cascade engaged inside walk_local
+    (walk_min_window below the per-chip slot count), migrations across
+    chips: flux and positions bitwise identical across methods."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 2000
+    rng = np.random.default_rng(5)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), -0.1, 1.1)
+    out = {}
+    for meth in ("rank", "argsort"):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=make_device_mesh(8),
+                        capacity_factor=6.0,
+                        walk_partition_method=meth,
+                        walk_min_window=64),
+        )
+        assert t.engine.partition_method == meth
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(),
+                             dst.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out[meth] = (np.asarray(t.flux), t.positions)
+    np.testing.assert_array_equal(out["rank"][0], out["argsort"][0])
+    np.testing.assert_array_equal(out["rank"][1], out["argsort"][1])
+
+
+# -- empty-block skip (gather sub-split) --------------------------------
+
+def test_gather_blocked_skips_empty_blocks_and_conserves():
+    """Particles clustered in one corner of a finely blocked mesh: the
+    per-round block loop must dispatch only occupied blocks (strictly
+    fewer than rounds x blocks), with flux conserved and identical to
+    the monolithic engine."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)  # 1296 tets
+    n = 800
+    rng = np.random.default_rng(21)
+    # Cluster: sources and destinations inside one corner octant.
+    src = rng.uniform(0.05, 0.30, (n, 3))
+    dst = rng.uniform(0.05, 0.30, (n, 3))
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(walk_vmem_max_elems=100, walk_block_kernel="gather",
+                    capacity_factor=20.0),
+    )
+    blocks = t.engine.nparts
+    assert blocks >= 8  # finely blocked, or the skip can't show
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    rounds = t.engine.last_walk_rounds
+    disp = t.engine.last_block_dispatches
+    assert rounds >= 1
+    # The skip property: no per-block work for unoccupied blocks. A
+    # corner-clustered batch occupies only a few blocks, so dispatches
+    # must be well under the full-sweep count...
+    assert disp < rounds * blocks, (disp, rounds, blocks)
+    # ...but every round walks at least one occupied block.
+    assert disp >= rounds
+    # Conservation: the clustered move still tallies every segment.
+    got = float(np.asarray(t.flux, np.float64).sum())
+    want = float(np.linalg.norm(dst - src, axis=1).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    # And parity with the monolithic engine (the existing pattern).
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(None, dst.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(t.flux, np.float64), np.asarray(ref.flux, np.float64),
+        rtol=1e-10, atol=1e-13,
+    )
+
+
+def test_gather_blocked_spread_workload_still_matches():
+    """Counter-case to the clustered test: a domain-spanning workload
+    (most blocks occupied) through the while_loop block dispatcher
+    still matches the monolithic engine — the skip rewrite changed
+    scheduling, not physics."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 2000
+    rng = np.random.default_rng(23)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), -0.1, 1.1)
+    ref = PumiTally(mesh, n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    ref.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                           np.ones(n, np.int8), np.ones(n))
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(walk_vmem_max_elems=200, walk_block_kernel="gather",
+                    capacity_factor=4.0),
+    )
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    assert t.engine.last_block_dispatches >= 1
+    np.testing.assert_allclose(
+        np.asarray(t.flux, np.float64), np.asarray(ref.flux, np.float64),
+        rtol=1e-10, atol=1e-13,
+    )

@@ -75,6 +75,7 @@ def test_cascade_matches_plain_walk_under_shard_map():
         from jax.experimental.shard_map import shard_map
 
     from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.parallel.sharded import shard_map_check_kwargs
 
     mesh, x, elem, dest, fly, w = _setup()
     dev_mesh = make_device_mesh(8)
@@ -86,6 +87,7 @@ def test_cascade_matches_plain_walk_under_shard_map():
         mesh=dev_mesh,
         in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
         out_specs=(P("dp"), P("dp"), P()),
+        **shard_map_check_kwargs(),
     )
     def sharded_cascade(mesh_, x_, elem_, dest_, fly_, w_):
         from jax import lax

@@ -1,0 +1,254 @@
+"""A/B: argsort-based vs counting-rank redistribution, at bench scale.
+
+The redistribution tax the engine pays per compaction-cascade stage,
+per walk_local round, and per migration round used to be a
+full-capacity stable argsort (plus, for migration, a permutation
+gather); ops/bucketize.py replaces it with counting-rank partitions
+that produce the bitwise-identical permutation. This tool measures
+both arms on the CURRENT backend at the headline workload's shapes:
+
+1. ``cascade_boundary``  — binary done-key partition of N=500k slots +
+   the packed [N,8]f/[N,3]i stage-boundary row gathers (the "packed"
+   perm mode's real per-stage cost).
+2. ``migrate_round``     — (nparts+1)-bucket keys over the partitioned
+   engine's slot capacity + the packed state scatter, both in the old
+   sort→gather→scatter form and the new rank→scatter form (the real
+   ``_migrate_impl`` cost, nparts=16 like the blocked bench).
+3. ``walk_continue``     — end-to-end: one tallied continue-mode
+   ``walk()`` over the bench box mesh with
+   ``partition_method="rank"`` vs ``"argsort"`` (identical physics,
+   pinned bitwise before timing).
+
+Each row prints one JSON line {"row", "argsort_ms"/"rank_ms" or
+rates, "speedup"}. Run on CPU now (JAX_PLATFORMS=cpu — the recorded
+numbers in docs/PERF_NOTES.md) and re-run unchanged in the next chip
+window; honors the chip-window interlock when it runs on hardware.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/exp_partition_ab.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N = int(os.environ.get("PUMIUMTALLY_AB_N", 500_000))
+NPARTS = int(os.environ.get("PUMIUMTALLY_AB_NPARTS", 16))
+REPS = int(os.environ.get("PUMIUMTALLY_AB_REPS", 5))
+
+
+def _timed(fn, *args, reps: int = REPS) -> float:
+    """Median wall seconds of a jitted fn; forces a value fetch (the
+    only real sync on the lazy remote backends — PERF_NOTES r1 §5)."""
+    import jax.numpy as jnp
+
+    out = fn(*args)
+    float(jnp.sum(out[0] if isinstance(out, tuple) else out))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        float(jnp.sum(out[0] if isinstance(out, tuple) else out))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def bench_cascade_boundary(n: int = N) -> dict:
+    """One packed stage boundary: perm of a binary done key + the
+    [n,8]f/[n,3]i row gathers ("packed" perm mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.ops.bucketize import partition_perm
+
+    rng = np.random.default_rng(3)
+    done = jnp.asarray(rng.uniform(size=n) < 0.5)
+    fpack = jnp.asarray(rng.random((n, 8), np.float32))
+    ipack = jnp.asarray(rng.integers(0, n, (n, 3)), jnp.int32)
+
+    def boundary(method):
+        @jax.jit
+        def f(done, fpack, ipack):
+            perm, _, _ = partition_perm(
+                done.astype(jnp.int32), 2, method=method
+            )
+            return fpack[perm], ipack[perm]
+
+        return f
+
+    t_sort = _timed(boundary("argsort"), done, fpack, ipack)
+    t_rank = _timed(boundary("rank"), done, fpack, ipack)
+    return {
+        "row": "cascade_boundary", "n": n,
+        "argsort_ms": t_sort * 1e3, "rank_ms": t_rank * 1e3,
+        "speedup": t_sort / t_rank,
+    }
+
+
+def bench_migrate_round(n: int = N, nparts: int = NPARTS) -> dict:
+    """One migration shuffle of the packed state matrices: old
+    sort→perm-gather→scatter vs new rank→direct-scatter."""
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.ops.bucketize import counting_ranks
+
+    cap_b = int(n // nparts * 1.5)
+    cap = nparts * cap_b
+    rng = np.random.default_rng(4)
+    key = jnp.asarray(rng.integers(0, nparts + 1, cap), jnp.int32)
+    fpack = jnp.asarray(rng.random((cap, 11), np.float32))
+    ipack = jnp.asarray(rng.integers(0, n, (cap, 8)), jnp.int32)
+    fdef = jnp.zeros_like(fpack)
+    idef = jnp.zeros_like(ipack)
+
+    @jax.jit
+    def old_arm(key, fpack, ipack):
+        # The seed's _migrate_impl: argsort, post-sort ranks, then a
+        # permutation GATHER feeding the destination scatter.
+        perm = jnp.argsort(key, stable=True)
+        key_s = key[perm]
+        counts = jnp.bincount(key, length=nparts + 1)
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+        )
+        pos = jnp.cumsum(jnp.ones_like(key_s)) - 1
+        rank = pos - starts[key_s]
+        dest = jnp.where(key_s < nparts, key_s * cap_b + rank, cap)
+        return (fdef.at[dest].set(fpack[perm], mode="drop"),
+                idef.at[dest].set(ipack[perm], mode="drop"))
+
+    def new_arm(method):
+        @jax.jit
+        def f(key, fpack, ipack):
+            rank = counting_ranks(key, nparts + 1, method=method)
+            dest = jnp.where(key < nparts, key * cap_b + rank, cap)
+            return (fdef.at[dest].set(fpack, mode="drop"),
+                    idef.at[dest].set(ipack, mode="drop"))
+
+        return f
+
+    # Parity before timing: identical packed matrices out.
+    a = old_arm(key, fpack, ipack)
+    b = new_arm("rank")(key, fpack, ipack)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            "migrate arms diverged"
+    t_old = _timed(old_arm, key, fpack, ipack)
+    t_new = _timed(new_arm("rank"), key, fpack, ipack)
+    return {
+        "row": "migrate_round", "cap": cap, "nparts": nparts,
+        "argsort_ms": t_old * 1e3, "rank_ms": t_new * 1e3,
+        "speedup": t_old / t_new,
+    }
+
+
+def bench_walk_continue(n: int, div: int = 20, moves: int = 2) -> dict:
+    """End-to-end tallied walk, rank vs argsort partitioning (identical
+    physics — asserted bitwise before timing)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.api.tally import _localize_step
+    from pumiumtally_tpu.ops.walk import walk
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    cfg = TallyConfig()
+    tol = cfg.resolved_tolerance(mesh.coords.dtype)
+    max_iters = cfg.resolved_max_iters(mesh.nelems)
+    rng = np.random.default_rng(5)
+    pts = [jnp.asarray(rng.uniform(0.05, 0.95, (n, 3)),
+                       mesh.coords.dtype)]
+    for _ in range(moves):
+        step = rng.normal(scale=0.25 / np.sqrt(3.0), size=(n, 3))
+        pts.append(jnp.asarray(
+            np.clip(np.asarray(pts[-1], np.float64) + step, 0.02, 0.98),
+            mesh.coords.dtype,
+        ))
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    x0, e0, ok, _ = _localize_step(
+        mesh, jnp.broadcast_to(c0, (n, 3)), jnp.zeros((n,), jnp.int32),
+        pts[0], tol=tol, max_iters=max_iters,
+    )
+    assert bool(jnp.all(ok))
+    fly = jnp.ones((n,), jnp.int8)
+    w = jnp.ones((n,), mesh.coords.dtype)
+
+    fns = {
+        meth: jax.jit(partial(
+            walk, tally=True, tol=tol, max_iters=max_iters,
+            partition_method=meth,
+        ))
+        for meth in ("rank", "argsort")
+    }
+
+    def run(g):
+        flux = jnp.zeros((mesh.nelems,), mesh.coords.dtype)
+        x, e = x0, e0
+        t0 = time.perf_counter()
+        for m in range(1, moves + 1):
+            r = g(mesh, x, e, pts[m], fly, w, flux)
+            x, e, flux = r.x, r.elem, r.flux
+        float(jnp.sum(flux))
+        return flux, n * moves / (time.perf_counter() - t0)
+
+    # Warm both arms, then INTERLEAVE timed trials and take each arm's
+    # best: back-to-back whole-arm runs otherwise fold CPU
+    # frequency/cache ramp into whichever arm runs first (observed as a
+    # spurious 7% swing at this scale).
+    fluxes, rates = {}, {"rank": [], "argsort": []}
+    for meth, g in fns.items():
+        fluxes[meth], _ = run(g)
+    for _ in range(3):
+        for meth, g in fns.items():
+            rates[meth].append(run(g)[1])
+    assert np.array_equal(
+        np.asarray(fluxes["rank"]), np.asarray(fluxes["argsort"])
+    ), "walk arms diverged (must be bitwise-identical)"
+    rate_r, rate_s = max(rates["rank"]), max(rates["argsort"])
+    return {
+        "row": "walk_continue", "n": n, "mesh_tets": mesh.nelems,
+        "rank_moves_per_sec": rate_r, "argsort_moves_per_sec": rate_s,
+        "speedup": rate_r / rate_s, "bitwise_identical": True,
+    }
+
+
+def run_all(n: int = N, nparts: int = NPARTS,
+            walk_n: int | None = None) -> list:
+    rows = [
+        bench_cascade_boundary(n),
+        bench_migrate_round(n, nparts),
+        bench_walk_continue(walk_n if walk_n is not None else n),
+    ]
+    return rows
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    n = 50_000 if quick else N
+    import jax
+
+    from pumiumtally_tpu.utils.chiplock import chip_lock
+
+    on_cpu = jax.default_backend() == "cpu"
+    with chip_lock(timeout_s=None, blocking=not on_cpu) as held:
+        if not on_cpu and not held:
+            print("# chip lock busy; measuring anyway", file=sys.stderr)
+        print(f"# backend: {jax.default_backend()}", file=sys.stderr)
+        for row in run_all(n, NPARTS, walk_n=n if quick else 200_000):
+            print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
