@@ -92,22 +92,39 @@ def timed_moves(t, pts, moves: int, drive) -> dict:
     """Shared timing scaffold: warmup move 1 (compiles; the scalar
     fetch is the real sync — block_until_ready is lazy on this
     backend), then time moves 2..moves+1 and hard-check conservation
-    over ALL moves (flux accumulates from the warmup on)."""
+    over ALL moves (flux accumulates from the warmup on).
+
+    Each row also records its COMPILE counts (retrace tripwire,
+    docs/STATIC_ANALYSIS.md): ``compiles.total`` is backend compiles
+    over the whole workload (warmup included — that is where the one
+    expected compile per entry point lands), ``compiles.timed`` the
+    compiles inside the measured window (a healthy engine shows 0 —
+    every timed move hits the jit cache), and the remaining keys the
+    per-entry-point breakdown from profiling.register_entry_point."""
     import jax.numpy as jnp
 
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
     n = pts[0].shape[0]
-    drive(1)
-    float(jnp.sum(t.flux))
-    t0 = time.perf_counter()
-    for m in range(2, moves + 2):
-        drive(m)
-    total_flux = float(np.float64(jnp.sum(t.flux)))  # forces the pipeline
-    dt = time.perf_counter() - t0
+    with retrace_guard(raise_on_exceed=False) as guard:
+        drive(1)
+        float(jnp.sum(t.flux))
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            t0 = time.perf_counter()
+            for m in range(2, moves + 2):
+                drive(m)
+            total_flux = float(np.float64(jnp.sum(t.flux)))  # forces the pipeline
+            dt = time.perf_counter() - t0
     rel = check_conservation(total_flux, pts, 1, moves + 1)
     return {
         "moves_per_sec": n * moves / dt,
         "histories_per_sec": n / dt,
         "conservation_rel_err": rel,
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
     }
 
 
@@ -759,6 +776,24 @@ def _measure_and_report() -> None:
             cont["conservation_rel_err"], pincell["conservation_rel_err"],
             *([] if gblocked is None else [gblocked["conservation_rel_err"]]),
         ),
+        # Retrace tripwire column (docs/STATIC_ANALYSIS.md): per-row
+        # compile counts from timed_moves — "total" over the whole
+        # workload (warmup included), "timed" inside the measured
+        # window (healthy == 0: every timed move hits the jit cache),
+        # plus the per-entry-point breakdown. A nonzero "timed" means
+        # the measured rate paid recompiles it should not have.
+        "compiles": {
+            "two_phase": two["compiles"],
+            "two_phase_forced": forced["compiles"],
+            "continue": cont["compiles"],
+            "pincell": pincell["compiles"],
+            **({} if pincell_tuned is None
+               else {"pincell_tuned": pincell_tuned["compiles"]}),
+            **({} if gblocked is None
+               else {"gather_blocked": gblocked["compiles"]}),
+            **({} if blocked is None or "compiles" not in blocked
+               else {"vmem_blocked": blocked["compiles"]}),
+        },
         "workload": {
             "mesh_tets": 6 * MESH_DIV**3,
             "particles": N,

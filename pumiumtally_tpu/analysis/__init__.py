@@ -1,0 +1,47 @@
+"""jaxlint — a JAX-aware trace-safety analyzer for the tally engine.
+
+ruff and clang-tidy (.github/workflows/static-analysis.yml) are the
+generic correctness backstop; this package is the JAX-specific one: it
+understands where the TRACE BOUNDARY lies (``jax.jit`` /
+``lax.while_loop`` / ``lax.scan`` / ``shard_map`` / ``pallas_call``
+bodies) and flags the failure modes that actually bite a JAX/TPU
+codebase — hidden host synchronization in the hot loops (JL001),
+Python control flow on traced arrays (JL002), donated-buffer reuse
+(JL003), retrace-bait static arguments (JL004), and module-state
+mutation under trace (JL005). Pure stdlib: no jax import, no code
+execution — safe for CI.
+
+Usage::
+
+    python -m pumiumtally_tpu.analysis pumiumtally_tpu/   # lint a tree
+    python -m pumiumtally_tpu.analysis --explain JL001    # rule docs
+    python tools/jaxlint.py ...                           # same CLI
+
+Suppression (justification REQUIRED — see docs/STATIC_ANALYSIS.md)::
+
+    flux = np.asarray(dev)  # jaxlint: disable=JL001 -- result fetch at
+                            # the tally boundary
+
+The runtime counterpart — the retrace tripwire that catches what static
+analysis cannot (cache-key instability observable only at run time) —
+is ``pumiumtally_tpu.utils.profiling.retrace_guard``.
+"""
+
+from pumiumtally_tpu.analysis.core import (
+    Analyzer,
+    Diagnostic,
+    iter_python_files,
+    lint_paths,
+    lint_source,
+)
+from pumiumtally_tpu.analysis.rules import RULES, Rule
+
+__all__ = [
+    "Analyzer",
+    "Diagnostic",
+    "RULES",
+    "Rule",
+    "iter_python_files",
+    "lint_paths",
+    "lint_source",
+]

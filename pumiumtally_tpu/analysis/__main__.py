@@ -1,0 +1,67 @@
+"""CLI: ``python -m pumiumtally_tpu.analysis [paths...]``.
+
+Exit status: 0 clean, 1 diagnostics found, 2 usage error — the same
+contract as ruff, so CI can run them side by side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from pumiumtally_tpu.analysis.core import lint_paths
+from pumiumtally_tpu.analysis.rules import RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pumiumtally_tpu.analysis",
+        description="jaxlint: JAX-aware trace-safety analyzer "
+        "(rules JL001-JL005; docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=["pumiumtally_tpu"],
+        help="files or directories to lint (default: pumiumtally_tpu)",
+    )
+    ap.add_argument(
+        "--explain", metavar="RULE",
+        help="print the full doc for one rule id and exit",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="list rule ids and summaries and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  {rule.summary}")
+        return 0
+    if args.explain:
+        rule = RULES.get(args.explain.upper())
+        if rule is None:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+        print(f"{rule.id}: {rule.summary}\n\n{rule.doc}")
+        return 0
+
+    # A typo'd path must not read as "clean" (ruff's contract too):
+    # every argument has to resolve to something lintable.
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"jaxlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    diags = lint_paths(args.paths)
+    for d in diags:
+        print(d.render())
+    if diags:
+        print(f"jaxlint: {len(diags)} issue(s) found", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1022 @@
+"""jaxlint core: a JAX-aware trace-safety analyzer (pure stdlib).
+
+What ruff/clang-tidy cannot see — and what actually bites a JAX/TPU
+codebase — is the TRACE BOUNDARY: code that is syntactically ordinary
+Python but executes inside ``jax.jit`` / ``lax.while_loop`` /
+``lax.scan`` / ``shard_map`` / ``pallas_call`` tracing, where host
+synchronization, Python control flow on traced arrays, donated-buffer
+reuse and impure module state are all latent production bugs. This
+module finds those statically, from the AST alone (no jax import, no
+execution), so it can run in CI next to ruff.
+
+Analysis model, in one paragraph: a first pass indexes every module —
+import aliases (``np``/``jnp``/``lax``/…), every function definition,
+and every ``jax.jit`` wrapping (decorator form, ``partial(jax.jit,…)``
+form, and the ``g = jax.jit(f, …)`` assignment form, including
+static/donated argument declarations). A second pass marks TRACE ROOTS:
+functions jit/pmap-decorated, wrapped by ``shard_map``, or passed as
+the callable operand of ``lax.while_loop``/``scan``/``cond``/
+``fori_loop``/``map``/``switch``/``pallas_call``. Each root's body is
+then checked, and calls from it into same-module helpers are followed
+ONE level deep (taint flows through the matched call arguments).
+Traced-value taint starts at the root's non-static parameters and
+propagates through assignments and ``jax.*`` calls; rules JL001/JL002
+consult it so that branching on a static config knob inside a traced
+body stays legal while branching on a particle array does not.
+
+Suppression: ``# jaxlint: disable=JL00x -- <why>`` on the flagged line;
+the justification is mandatory (a bare pragma reports JL000 and
+suppresses nothing). See ``rules.py`` / docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional, Union
+
+from pumiumtally_tpu.analysis.rules import RULES
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# Callables that TRACE their function operand, and which positional
+# argument(s) hold it. Keys are canonical dotted names after alias
+# resolution ("lax" -> "jax.lax", "jnp" -> "jax.numpy", ...).
+_TRACE_CALL_POSITIONS: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.pmap": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),  # arg 1 is a sequence of branches
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.shard_map": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "jax.experimental.pallas.pallas_call": (0,),
+}
+
+# Host-sync calls flagged in traced code regardless of operand taint:
+# these APIs only exist to touch device buffers / the host.
+_SYNC_DOTTED = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "jax.pure_callback",
+    "jax.debug.callback",
+    "jax.experimental.io_callback",
+}
+# Method names with the same property (obj.item() etc.).
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Calls that sync ONLY when handed a traced value (np.asarray of a
+# static tuple at trace time is fine; of a tracer it is an error).
+_TAINT_SYNC_DOTTED = {"numpy.asarray", "numpy.array"}
+_TAINT_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+# Default-expression constructors that make a static argument retrace
+# bait (JL004) — cache-key-unstable or unhashable.
+_ARRAY_MAKER_PREFIXES = ("numpy.", "jax.numpy.")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=(JL\d+(?:\s*,\s*JL\d+)*)\s*(?:--\s*(\S.*))?$"
+)
+
+# Mutating container methods for JL005.
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "sort", "reverse",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """Static/donated argument declarations of one jit wrapping."""
+
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
+
+
+def _const_strings(node: Optional[ast.AST]) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _const_ints(node: Optional[ast.AST]) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _jit_spec_from_keywords(call: ast.Call) -> JitSpec:
+    spec = JitSpec()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            spec.static_argnums = _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            spec.static_argnames = _const_strings(kw.value)
+        elif kw.arg == "donate_argnums":
+            spec.donate_argnums = _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            spec.donate_argnames = _const_strings(kw.value)
+    return spec
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """First pass: aliases, function defs, jit wrappings, module state."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        # name -> canonical dotted module path ("np" -> "numpy").
+        self.aliases: dict[str, str] = {}
+        # simple name -> [FunctionDef, ...] anywhere in the module.
+        self.functions: dict[str, list[ast.AST]] = {}
+        # id(FunctionDef) -> JitSpec for every jit-wrapped function.
+        self.jit_specs: dict[int, JitSpec] = {}
+        # local callable name -> JitSpec for donated-jit call targets
+        # (covers `step = jax.jit(f, donate_argnums=...)`).
+        self.donating_names: dict[str, JitSpec] = {}
+        # Names assigned at module level (JL005 targets).
+        self.module_names: set[str] = set()
+        # Lexical scoping: scope key (None = module, else id(func)) ->
+        # name -> [defs in that scope]; and func id -> enclosing func.
+        self.scope_defs: dict[Optional[int], dict[str, list[ast.AST]]] = {}
+        self.owner_of: dict[int, Optional[ast.AST]] = {}
+        self._tree = tree
+        self._index()
+        self._collect_scopes(None, tree.body)
+
+    # -- lexical scopes ---------------------------------------------------
+    @staticmethod
+    def _iter_scope_nodes(roots: list) -> Iterable[ast.AST]:
+        """All nodes under ``roots`` excluding nested function
+        INTERIORS (the nested def/lambda node itself is yielded)."""
+        stack = list(roots)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _collect_scopes(self, owner: Optional[ast.AST], body: list) -> None:
+        key = None if owner is None else id(owner)
+        defs = self.scope_defs.setdefault(key, {})
+        for node in self._iter_scope_nodes(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                self.owner_of[id(node)] = owner
+                self._collect_scopes(node, node.body)
+            elif isinstance(node, ast.Lambda):
+                self.owner_of[id(node)] = owner
+                self._collect_scopes(node, [node.body])
+
+    def resolve_in_scope(
+        self, name: str, owner: Optional[ast.AST], line: int
+    ) -> Optional[ast.AST]:
+        """Innermost-scope function def visible from (owner, line):
+        the enclosing-function chain first, then module level. Within a
+        scope, the latest def at or before ``line`` wins (lexical
+        shadowing — e.g. the per-window ``cond`` redefinitions in the
+        walk cascade)."""
+        key = None if owner is None else id(owner)
+        while True:
+            cands = self.scope_defs.get(key, {}).get(name, [])
+            if cands:
+                before = [c for c in cands if c.lineno <= line]
+                if before:
+                    return max(before, key=lambda c: c.lineno)
+                return min(cands, key=lambda c: c.lineno)
+            if key is None:
+                return None
+            parent = self.owner_of.get(key)
+            key = None if parent is None else id(parent)
+
+    # -- imports ---------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is None or node.level:
+            return
+        for a in node.names:
+            self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def is_module_func(self, node: ast.AST) -> bool:
+        """Whether a call's func expression is rooted at an imported
+        name (``np.asarray``) rather than a runtime object's method
+        (``arr.item()``)."""
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        return isinstance(root, ast.Name) and root.id in self.aliases
+
+    # -- dotted-name resolution ------------------------------------------
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression, alias-resolved
+        ("jnp.where" -> "jax.numpy.where"), or None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        return ".".join([base] + list(reversed(parts)))
+
+    # -- jit wrapping recognition ----------------------------------------
+    def _jit_spec_of_wrapper(self, expr: ast.AST) -> Optional[JitSpec]:
+        """JitSpec if ``expr`` is a jit-ish wrapper expression:
+        ``jax.jit`` / ``jax.pmap`` / ``partial(jax.jit, ...)``."""
+        d = self.dotted(expr)
+        if d in ("jax.jit", "jax.pmap"):
+            return JitSpec()
+        if isinstance(expr, ast.Call):
+            fd = self.dotted(expr.func)
+            if fd in ("jax.jit", "jax.pmap"):
+                # jax.jit(static_argnames=...) used as a decorator factory
+                return _jit_spec_from_keywords(expr)
+            if fd in ("functools.partial", "partial") and expr.args:
+                inner = self.dotted(expr.args[0])
+                if inner in ("jax.jit", "jax.pmap"):
+                    return _jit_spec_from_keywords(expr)
+        return None
+
+    def _is_shard_map_wrapper(self, expr: ast.AST) -> bool:
+        d = self.dotted(expr)
+        if d and d.split(".")[-1] == "shard_map":
+            return True
+        if isinstance(expr, ast.Call):
+            fd = self.dotted(expr.func)
+            if fd and fd.split(".")[-1] == "shard_map":
+                return True
+            if fd in ("functools.partial", "partial") and expr.args:
+                inner = self.dotted(expr.args[0])
+                if inner and inner.split(".")[-1] == "shard_map":
+                    return True
+        return False
+
+    # -- indexing --------------------------------------------------------
+    def _index(self) -> None:
+        for stmt in self._tree.body:
+            for tgt in self._assign_targets(stmt):
+                self.module_names.add(tgt)
+        for node in ast.walk(self._tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self.visit(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    spec = self._jit_spec_of_wrapper(dec)
+                    if spec is not None:
+                        self.jit_specs[id(node)] = spec
+            elif isinstance(node, ast.Assign):
+                self._index_assign(node)
+
+    @staticmethod
+    def _assign_targets(stmt: ast.stmt) -> list[str]:
+        tgts: list[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            tgts = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            tgts = [stmt.target]
+        out = []
+        for t in tgts:
+            if isinstance(t, ast.Name):
+                out.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+        return out
+
+    def _find_jit_wrapping(
+        self, v: ast.AST, depth: int = 0
+    ) -> Optional[tuple]:
+        """(JitSpec, wrapped-fn expr) if ``v`` is a jit wrapping:
+        ``jax.jit(f, ...)`` / ``partial(jax.jit, ...)(f)`` — possibly
+        nested inside ANOTHER call's arguments, e.g.
+        ``register_entry_point("walk", jax.jit(f))`` (the retrace
+        wrapper must not hide the jit from trace-root discovery)."""
+        if not isinstance(v, ast.Call) or depth > 2:
+            return None
+        fd = self.dotted(v.func)
+        if fd in ("jax.jit", "jax.pmap") and v.args:
+            return _jit_spec_from_keywords(v), v.args[0]
+        if isinstance(v.func, ast.Call):
+            wrapper = self._jit_spec_of_wrapper(v.func)
+            if wrapper is not None and v.args:
+                return wrapper, v.args[0]
+        for arg in v.args:
+            found = self._find_jit_wrapping(arg, depth + 1)
+            if found is not None:
+                return found
+        return None
+
+    def _index_assign(self, node: ast.Assign) -> None:
+        """Recognize ``g = jax.jit(f, ...)`` and
+        ``g = partial(jax.jit, ...)(f)`` (including the jit call nested
+        in a wrapper's arguments) — mark f's def as jitted and record g
+        as a donating call target when buffers are donated."""
+        found = self._find_jit_wrapping(node.value)
+        if found is None:
+            return
+        spec, target_fn = found
+        # jax.jit(partial(f, ...)) — resolve through the partial.
+        if isinstance(target_fn, ast.Call):
+            td = self.dotted(target_fn.func)
+            if td in ("functools.partial", "partial") and target_fn.args:
+                target_fn = target_fn.args[0]
+        if isinstance(target_fn, ast.Name):
+            for fn in self.functions.get(target_fn.id, []):
+                self.jit_specs[id(fn)] = spec
+        # Donation is a property of the CALL-SITE name, whatever got
+        # wrapped (named function, lambda, partial).
+        if spec.donate_argnums or spec.donate_argnames:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.donating_names[t.id] = spec
+
+    def resolve_function(self, name: str) -> Optional[ast.AST]:
+        """The module's unique function def called ``name`` (ambiguous
+        or unknown names resolve to None — the analyzer then simply
+        does not follow the call)."""
+        cands = self.functions.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+
+_STMT_BODY_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+
+def _iter_stmt_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Expression nodes belonging to one statement's own evaluation:
+    its test/iter/targets/value/etc., excluding nested statement lists
+    (the recursion visits those with updated taint) and nested function
+    defs (analyzed via their own calls/trace roots). Lambdas ARE
+    descended into — an inline lambda's body executes in the enclosing
+    traced context when called."""
+    stack: list[ast.AST] = []
+    for field, value in ast.iter_fields(stmt):
+        if field in _STMT_BODY_FIELDS:
+            continue
+        vs = value if isinstance(value, list) else [value]
+        stack.extend(v for v in vs if isinstance(v, ast.AST))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _func_params(fn: FuncNode) -> list[ast.arg]:
+    a = fn.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _static_param_names(fn: FuncNode, spec: Optional[JitSpec]) -> set[str]:
+    if spec is None:
+        return set()
+    params = _func_params(fn)
+    names = set(spec.static_argnames)
+    for i in spec.static_argnums:
+        if 0 <= i < len(params):
+            names.add(params[i].arg)
+    return names
+
+
+# Attribute reads that are STATIC under trace (shape metadata, not
+# array data) — a branch on them is trace-safe.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+# Builtins whose result is concrete even on a traced operand.
+_STATIC_FUNCS = {
+    "len", "isinstance", "issubclass", "hasattr", "getattr", "callable",
+    "type", "range", "enumerate", "zip", "id", "repr",
+}
+
+
+class _Taint:
+    """Forward may-be-traced analysis over one function body.
+
+    The cut-offs matter as much as the sources: ``x is None``,
+    ``x.shape[0]``, ``len(x)`` are all concrete at trace time even when
+    ``x`` is a tracer — flagging them would make the linter unusable on
+    exactly the static-shape bookkeeping a JAX kernel is full of.
+    """
+
+    def __init__(self, index: _ModuleIndex, traced: set[str]) -> None:
+        self.index = index
+        self.traced = set(traced)
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            return False  # identity checks yield concrete bools
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            d = self.index.dotted(node.func)
+            if d in _STATIC_FUNCS:
+                return False
+            if d and (d.startswith("jax.numpy.") or
+                      d.startswith("jax.lax.")):
+                return True  # jnp/lax calls produce traced arrays
+        return any(
+            self.expr_tainted(sub) for sub in ast.iter_child_nodes(node)
+        )
+
+    def absorb(self, stmt: ast.stmt) -> None:
+        """Update taint for one statement (assignments only — the
+        precision a linter needs, not a verifier's)."""
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            tainted = self.expr_tainted(value)
+            if isinstance(stmt, ast.AugAssign):
+                # `x += 1` reads x: a traced x stays traced even when
+                # the RHS operand is concrete.
+                tainted = tainted or self.expr_tainted(stmt.target)
+            for name in _ModuleIndex._assign_targets(stmt):
+                if tainted:
+                    self.traced.add(name)
+                else:
+                    self.traced.discard(name)
+
+
+class Analyzer:
+    """Per-file rule driver. ``run()`` returns the diagnostics."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.diags: list[Diagnostic] = []
+
+    # -- entry -----------------------------------------------------------
+    def run(self) -> list[Diagnostic]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            return [
+                Diagnostic(
+                    self.path, e.lineno or 1, "JL000",
+                    f"could not parse file: {e.msg}",
+                )
+            ]
+        index = _ModuleIndex(tree)
+        roots = self._trace_roots(tree, index)
+        seen: set[tuple[int, str]] = set()
+        for root, spec in roots:
+            self._check_traced_function(root, spec, index, seen)
+        self._check_donation(tree, index)
+        self._check_static_defaults(tree, index)
+        # Nested defs are reachable both through their own walk and the
+        # enclosing function's — keep the first of any exact duplicate.
+        unique: dict[tuple, Diagnostic] = {}
+        for d in self.diags:
+            unique.setdefault((d.path, d.line, d.rule, d.message), d)
+        self.diags = list(unique.values())
+        return self._apply_pragmas(self.diags)
+
+    # -- trace-root discovery --------------------------------------------
+    def _trace_roots(
+        self, tree: ast.Module, index: _ModuleIndex
+    ) -> list[tuple[FuncNode, Optional[JitSpec]]]:
+        roots: dict[int, tuple[FuncNode, Optional[JitSpec]]] = {}
+
+        def add(node: ast.AST, spec: Optional[JitSpec]) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                roots.setdefault(id(node), (node, spec))
+
+        def add_operand(
+            op: ast.AST,
+            spec: Optional[JitSpec],
+            owner: Optional[ast.AST],
+            line: int,
+        ) -> None:
+            if isinstance(op, ast.Lambda):
+                add(op, spec)
+            elif isinstance(op, ast.Name):
+                fn = index.resolve_in_scope(op.id, owner, line)
+                if fn is not None:
+                    add(fn, spec)
+            elif isinstance(op, (ast.Tuple, ast.List)):
+                for e in op.elts:  # lax.switch branch sequences
+                    add_operand(e, spec, owner, line)
+            elif isinstance(op, ast.Call):
+                # partial(f, ...) / partial(shard_map, ...)(f)-style
+                d = index.dotted(op.func)
+                if d in ("functools.partial", "partial") and op.args:
+                    add_operand(op.args[0], spec, owner, line)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spec = index.jit_specs.get(id(node))
+                if spec is not None:
+                    add(node, spec)
+                    continue
+                if any(
+                    index._is_shard_map_wrapper(dec)
+                    for dec in node.decorator_list
+                ):
+                    add(node, None)
+
+        def scan_scope(owner: Optional[ast.AST], body: list) -> None:
+            for node in _ModuleIndex._iter_scope_nodes(body):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    scan_scope(node, node.body)
+                elif isinstance(node, ast.Lambda):
+                    scan_scope(node, [node.body])
+                elif isinstance(node, ast.Call):
+                    d = index.dotted(node.func)
+                    if d is None:
+                        continue
+                    positions = _TRACE_CALL_POSITIONS.get(d)
+                    if positions is None and (
+                        d.split(".")[-1] == "shard_map"
+                    ):
+                        positions = (0,)
+                    if positions is None:
+                        continue
+                    spec = (
+                        _jit_spec_from_keywords(node)
+                        if d in ("jax.jit", "jax.pmap")
+                        else None
+                    )
+                    for i in positions:
+                        if i < len(node.args):
+                            add_operand(
+                                node.args[i], spec, owner, node.lineno
+                            )
+
+        scan_scope(None, tree.body)
+        return list(roots.values())
+
+    # -- traced-body checks (JL001/JL002/JL005) --------------------------
+    def _check_traced_function(
+        self,
+        fn: FuncNode,
+        spec: Optional[JitSpec],
+        index: _ModuleIndex,
+        seen: set[tuple[int, str]],
+        taint_override: Optional[set[str]] = None,
+        depth: int = 0,
+    ) -> None:
+        static = _static_param_names(fn, spec)
+        if taint_override is not None:
+            traced = taint_override
+        else:
+            traced = {
+                p.arg
+                for p in _func_params(fn)
+                if p.arg not in static and p.arg not in ("self", "cls")
+            }
+        taint = _Taint(index, traced)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        globals_declared: set[str] = set()
+        for stmt in body:
+            self._check_stmt(
+                stmt, taint, index, seen, globals_declared, depth, fn
+            )
+
+    def _emit(
+        self,
+        node: ast.AST,
+        rule: str,
+        message: str,
+        seen: set[tuple[int, str]],
+    ) -> None:
+        line = getattr(node, "lineno", 1)
+        key = (line, rule)
+        if key in seen:
+            return  # one report per (line, rule): helpers shared by
+        seen.add(key)  # several trace roots flag once
+        self.diags.append(Diagnostic(self.path, line, rule, message))
+
+    def _check_stmt(
+        self,
+        stmt: ast.stmt,
+        taint: _Taint,
+        index: _ModuleIndex,
+        seen: set[tuple[int, str]],
+        globals_declared: set[str],
+        depth: int,
+        scope: Optional[ast.AST] = None,
+    ) -> None:
+        # JL002: Python control flow on traced values.
+        if isinstance(stmt, (ast.If, ast.While)) and taint.expr_tainted(
+            stmt.test
+        ):
+            kind = "if" if isinstance(stmt, ast.If) else "while"
+            self._emit(
+                stmt, "JL002",
+                f"Python `{kind}` on a traced value inside a traced "
+                "body; use jnp.where / lax.cond / lax.while_loop "
+                f"(rule docs: {RULES['JL002'].summary})",
+                seen,
+            )
+        elif isinstance(stmt, ast.Assert) and taint.expr_tainted(stmt.test):
+            self._emit(
+                stmt, "JL002",
+                "Python `assert` on a traced value inside a traced "
+                "body; use checkify or move the check to the host "
+                "boundary",
+                seen,
+            )
+
+        # JL005: module-state mutation under trace.
+        if isinstance(stmt, ast.Global):
+            globals_declared.update(stmt.names)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if not isinstance(base, ast.Name):
+                    continue
+                is_container_write = base is not t
+                if base.id in globals_declared or (
+                    is_container_write and base.id in index.module_names
+                ):
+                    self._emit(
+                        stmt, "JL005",
+                        f"mutation of module-level state `{base.id}` "
+                        "inside a traced body runs once at trace time, "
+                        "not per call",
+                        seen,
+                    )
+
+        # Expression-level checks (JL001, JL005 mutators, IfExp, and
+        # one-level helper resolution) — over THIS statement's own
+        # expressions only. Nested statements are visited exclusively
+        # by the recursion below, with taint as of their position; a
+        # flat ast.walk here would re-check them with stale
+        # pre-statement taint and pin the wrong verdict in `seen`.
+        for node in _iter_stmt_exprs(stmt):
+            if isinstance(node, ast.IfExp) and taint.expr_tainted(node.test):
+                self._emit(
+                    node, "JL002",
+                    "conditional expression on a traced value inside a "
+                    "traced body; use jnp.where",
+                    seen,
+                )
+            if isinstance(node, ast.Call):
+                self._check_call(node, taint, index, seen, depth, scope)
+
+        taint.absorb(stmt)
+
+        # Recurse into compound statements' bodies.
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, []) or []:
+                if isinstance(sub, ast.stmt):
+                    self._check_stmt(
+                        sub, taint, index, seen, globals_declared, depth,
+                        scope,
+                    )
+        for handler in getattr(stmt, "handlers", []) or []:
+            for sub in handler.body:
+                self._check_stmt(
+                    sub, taint, index, seen, globals_declared, depth,
+                    scope,
+                )
+
+    def _check_call(
+        self,
+        node: ast.Call,
+        taint: _Taint,
+        index: _ModuleIndex,
+        seen: set[tuple[int, str]],
+        depth: int,
+        scope: Optional[ast.AST] = None,
+    ) -> None:
+        d = index.dotted(node.func)
+
+        # JL001: unconditional host syncs.
+        if d in _SYNC_DOTTED:
+            self._emit(
+                node, "JL001",
+                f"`{d}` is a host synchronization point inside a traced "
+                "body; fetch results after the jitted call returns",
+                seen,
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            # a real method call on an object, not a module function
+            and not index.is_module_func(node.func)
+        ):
+            self._emit(
+                node, "JL001",
+                f"`.{node.func.attr}()` forces a device->host transfer "
+                "inside a traced body",
+                seen,
+            )
+            return
+
+        # JL001: taint-gated syncs (np.asarray(tracer), float(tracer)).
+        first_tainted = bool(node.args) and taint.expr_tainted(node.args[0])
+        if d in _TAINT_SYNC_DOTTED and first_tainted:
+            self._emit(
+                node, "JL001",
+                f"`{d}` on a traced value materializes it on the host "
+                "(TracerArrayConversionError at trace time); stay in "
+                "jnp, or fetch at the tally boundary",
+                seen,
+            )
+            return
+        if d in _TAINT_SYNC_BUILTINS and first_tainted:
+            self._emit(
+                node, "JL001",
+                f"`{d}()` on a traced value forces concretization "
+                "inside a traced body",
+                seen,
+            )
+            return
+
+        # JL005: mutating a module-level container under trace.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in index.module_names
+            and node.func.value.id not in taint.traced
+        ):
+            self._emit(
+                node, "JL005",
+                f"`{node.func.value.id}.{node.func.attr}(...)` mutates "
+                "module-level state inside a traced body (runs once at "
+                "trace time)",
+                seen,
+            )
+
+        # One-level helper resolution: a direct call to a same-module
+        # function pulls that body into the traced context (depth 1).
+        if depth >= 1 or not isinstance(node.func, ast.Name):
+            return
+        helper = index.resolve_in_scope(
+            node.func.id, scope, node.lineno
+        ) or index.resolve_function(node.func.id)
+        if helper is None or isinstance(helper, ast.Lambda):
+            return
+        params = _func_params(helper)
+        helper_taint: set[str] = set()
+        for i, arg in enumerate(node.args):
+            if i < len(params) and taint.expr_tainted(arg):
+                helper_taint.add(params[i].arg)
+        for kw in node.keywords:
+            if kw.arg and taint.expr_tainted(kw.value):
+                helper_taint.add(kw.arg)
+        self._check_traced_function(
+            helper, index.jit_specs.get(id(helper)), index, seen,
+            taint_override=helper_taint, depth=depth + 1,
+        )
+
+    # -- JL003: donated-buffer reuse -------------------------------------
+    def _check_donation(self, tree: ast.Module, index: _ModuleIndex) -> None:
+        if not index.donating_names:
+            return
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._check_donation_in(fn, index)
+
+    def _check_donation_in(self, fn: ast.AST, index: _ModuleIndex) -> None:
+        """Statement-ordered may-use-after-donate scan of one function
+        (nested defs excluded — they get their own pass).
+
+        Per statement, in source order: loads of already-donated names
+        flag FIRST (so a donating call's own multi-line argument list
+        never flags itself), then this statement's donations record,
+        then its assignment targets clear — which makes the canonical
+        rebind ``state = step(state, ...)`` clean by evaluation order
+        rather than by line arithmetic.
+        """
+        donated: dict[str, int] = {}  # name -> donating call's line
+        stmts = sorted(
+            (n for n in _ModuleIndex._iter_scope_nodes(fn.body)
+             if isinstance(n, ast.stmt)),
+            key=lambda s: (s.lineno, s.col_offset),
+        )
+        for stmt in stmts:
+            exprs = list(_iter_stmt_exprs(stmt))
+            donations: list[tuple[str, int]] = []
+            for node in exprs:
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    continue
+                spec = index.donating_names.get(node.func.id)
+                if spec is None:
+                    continue
+                for i in spec.donate_argnums:
+                    if i < len(node.args) and isinstance(
+                        node.args[i], ast.Name
+                    ):
+                        donations.append((node.args[i].id, node.lineno))
+                for kw in node.keywords:
+                    if kw.arg in spec.donate_argnames and isinstance(
+                        kw.value, ast.Name
+                    ):
+                        donations.append((kw.value.id, node.lineno))
+            for node in exprs:
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in donated
+                ):
+                    self.diags.append(
+                        Diagnostic(
+                            self.path, node.lineno, "JL003",
+                            f"`{node.id}` was donated to a jitted call "
+                            f"on line {donated[node.id]} "
+                            "(donate_argnums); its device buffer is "
+                            "dead — use the call's result instead",
+                        )
+                    )
+                    del donated[node.id]  # one report per donation
+            for name, line in donations:
+                donated[name] = line
+            for node in exprs:
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Store
+                ):
+                    donated.pop(node.id, None)
+
+    # -- JL004: retrace-bait static defaults -----------------------------
+    def _check_static_defaults(
+        self, tree: ast.Module, index: _ModuleIndex
+    ) -> None:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            spec = index.jit_specs.get(id(fn))
+            if spec is None:
+                continue
+            static = _static_param_names(fn, spec)
+            defaults = list(fn.args.defaults)
+            # positional defaults align to the TAIL of pos params
+            pos_params = list(fn.args.posonlyargs) + list(fn.args.args)
+            pairs = list(
+                zip(pos_params[len(pos_params) - len(defaults):], defaults)
+            )
+            pairs += [
+                (p, d)
+                for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+                if d is not None
+            ]
+            for param, default in pairs:
+                if param.arg not in static:
+                    continue
+                bad = None
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    bad = "an unhashable (list/dict/set) default"
+                elif isinstance(default, ast.Call):
+                    d = index.dotted(default.func)
+                    if d and d.startswith(_ARRAY_MAKER_PREFIXES):
+                        bad = f"an array default (`{d}`)"
+                if bad:
+                    self.diags.append(
+                        Diagnostic(
+                            self.path, default.lineno, "JL004",
+                            f"static argument `{param.arg}` of jitted "
+                            f"`{fn.name}` has {bad}: unhashable or "
+                            "cache-key-unstable -> retrace bait; use a "
+                            "tuple/scalar",
+                        )
+                    )
+
+    # -- pragmas ---------------------------------------------------------
+    def _comment_lines(self) -> list[tuple[int, str]]:
+        """(line, text) of every COMMENT token — pragmas live in real
+        comments only, so pragma examples inside docstrings/string
+        literals (e.g. the rule docs themselves) are never parsed."""
+        import io
+        import tokenize
+
+        try:
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline
+                )
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError,
+                SyntaxError):  # pragma: no cover — parse already passed
+            return list(enumerate(self.source.splitlines(), start=1))
+
+    def _apply_pragmas(
+        self, diags: list[Diagnostic]
+    ) -> list[Diagnostic]:
+        disabled: dict[int, set[str]] = {}
+        out: list[Diagnostic] = []
+        for i, text in self._comment_lines():
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            ids = {s.strip().upper() for s in m.group(1).split(",")}
+            ids.discard("")
+            justification = (m.group(2) or "").strip()
+            if not justification:
+                out.append(
+                    Diagnostic(
+                        self.path, i, "JL000",
+                        "jaxlint pragma without a justification "
+                        "(grammar: `# jaxlint: disable=JL00x -- why`); "
+                        "the pragma is IGNORED",
+                    )
+                )
+                continue
+            unknown = ids - set(RULES)
+            if unknown:
+                out.append(
+                    Diagnostic(
+                        self.path, i, "JL000",
+                        f"pragma names unknown rule(s) "
+                        f"{sorted(unknown)}; known: "
+                        f"{sorted(r for r in RULES if r != 'JL000')}",
+                    )
+                )
+            disabled[i] = ids
+        for d in diags:
+            if d.rule in disabled.get(d.line, ()):  # justified pragma
+                continue
+            out.append(d)
+        return out
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    dn for dn in dirnames
+                    if dn not in ("__pycache__", ".git")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(out)
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Diagnostic]:
+    """Lint one source string (the unit the test corpus drives)."""
+    return Analyzer(path, source).run()
+
+
+def lint_paths(paths: Iterable[str]) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    for f in iter_python_files(paths):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            diags.append(Diagnostic(f, 1, "JL000", f"unreadable: {e}"))
+            continue
+        diags.extend(lint_source(src, f))
+    diags.sort(key=lambda d: (d.path, d.line, d.rule))
+    return diags

@@ -1,0 +1,173 @@
+"""jaxlint rule registry: ids, one-line summaries, and full docs.
+
+Each rule documents the JAX/TPU failure mode it guards, with a bad and
+a good example. The analyzer (``core.py``) emits diagnostics keyed by
+these ids; ``python -m pumiumtally_tpu.analysis --explain JL001`` prints
+the doc. The long-form prose (including the pragma grammar) lives in
+``docs/STATIC_ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    doc: str
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _rule(id: str, summary: str, doc: str) -> None:
+    RULES[id] = Rule(id, summary, doc.strip())
+
+
+_rule(
+    "JL000",
+    "jaxlint pragma without a justification",
+    """
+A `# jaxlint: disable=JL00x` pragma MUST carry a justification string:
+
+    bad:   flux = np.asarray(dev)  # jaxlint: disable=JL001
+    good:  flux = np.asarray(dev)  # jaxlint: disable=JL001 -- result
+           # fetch at the tally boundary; the sync is the API contract
+
+An unjustified pragma does NOT suppress its diagnostics — the original
+finding is reported alongside this one. The justification is the review
+record for why the flagged pattern is intentional.
+""",
+)
+
+_rule(
+    "JL001",
+    "host-synchronizing call reachable from a traced (jit/scan/"
+    "while_loop/shard_map) body",
+    """
+`.item()`, `.tolist()`, `np.asarray`/`np.array` on traced values,
+`jax.device_get`, `block_until_ready`, `float()`/`int()`/`bool()` on
+traced values, and host callbacks (`jax.pure_callback`,
+`io_callback`, `jax.debug.callback`) either fail at trace time
+(TracerArrayConversionError) or silently serialize the device pipeline
+— inside the walk/migrate hot loops a single hidden sync forfeits the
+dispatch pipelining the engine is built around.
+
+    bad:
+        @jax.jit
+        def step(x):
+            return x * float(jnp.max(x))      # traced -> trace error
+
+    good:
+        @jax.jit
+        def step(x):
+            return x * jnp.max(x)             # stays on device
+
+Fetch results on the host AFTER the jitted call returns (the tally
+boundary), never inside the traced body.
+""",
+)
+
+_rule(
+    "JL002",
+    "Python-level control flow (if/while/assert) on a traced value",
+    """
+Python `if`/`while`/`assert` (and `x if c else y`) evaluate their
+condition at trace time; a traced array has no concrete truth value, so
+this raises TracerBoolConversionError — or worse, silently bakes one
+concrete branch into the compiled program when the value is a
+weakly-typed constant.
+
+    bad:
+        @jax.jit
+        def clamp(x):
+            if x.max() > 1.0:                 # traced condition
+                x = x / x.max()
+            return x
+
+    good:
+        @jax.jit
+        def clamp(x):
+            return jnp.where(x.max() > 1.0, x / x.max(), x)
+
+Use `jnp.where` for element selection, `lax.cond` for real branching,
+and `lax.while_loop` for data-dependent iteration.
+""",
+)
+
+_rule(
+    "JL003",
+    "buffer used after being passed in a donated argument position",
+    """
+`donate_argnums`/`donate_argnames` hands the argument's device buffer
+to XLA for reuse; the Python array object is left pointing at freed
+memory, and touching it afterwards raises (or, on some backends,
+silently reads garbage).
+
+    bad:
+        step = jax.jit(update, donate_argnums=(0,))
+        state = step(state_in, inputs)
+        print(state_in.sum())                 # donated buffer!
+
+    good:
+        step = jax.jit(update, donate_argnums=(0,))
+        state = step(state_in, inputs)        # state_in is dead here
+        print(state.sum())
+
+Rebind the name (`state = step(state, ...)`) so the stale reference
+cannot escape.
+""",
+)
+
+_rule(
+    "JL004",
+    "static argument with a list/dict/set/array default (retrace bait)",
+    """
+`jax.jit` keys its compilation cache on the VALUES of static arguments.
+A list/dict/set default is unhashable (TypeError at call time); an
+array default — or any default rebuilt per call site — makes every
+call a cache MISS, silently recompiling the program each move.
+
+    bad:
+        @partial(jax.jit, static_argnames=("knobs",))
+        def walk(x, knobs=[8, 4]):            # unhashable static
+            ...
+
+    good:
+        @partial(jax.jit, static_argnames=("knobs",))
+        def walk(x, knobs=(8, 4)):            # hashable, cache-stable
+            ...
+
+Use tuples/frozensets/scalars for static defaults, and pass arrays as
+traced (non-static) arguments.
+""",
+)
+
+_rule(
+    "JL005",
+    "mutation of module-level state inside a traced body",
+    """
+A traced function body runs ONCE, at trace time — not per call. Writing
+module-level state from it (a `global` assignment, `CACHE[k] = v`,
+`LOG.append(...)`) bakes the trace-time value in and never runs again
+for subsequent calls that hit the compilation cache; it is also a
+hidden retrace dependency when the mutated state feeds later traces.
+
+    bad:
+        _SEEN = []
+        @jax.jit
+        def step(x):
+            _SEEN.append(x.shape)             # runs once, then never
+            return x + 1
+
+    good:
+        @jax.jit
+        def step(x):
+            return x + 1
+        # record shapes at the call site, outside the trace
+
+Keep traced bodies pure; do host-side bookkeeping at the facade layer.
+""",
+)

@@ -60,6 +60,7 @@ from pumiumtally_tpu.config import TallyConfig
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.ops.walk import walk
 from pumiumtally_tpu.io.vtk import write_vtk
+from pumiumtally_tpu.utils.profiling import register_entry_point
 
 
 @dataclass
@@ -276,12 +277,22 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
     return x2, elem2, flux2, ok_a & ok_b
 
 
-_move_step = partial(
-    jax.jit, static_argnames=("tol", "max_iters", "walk_kw")
-)(move_step)
-_move_step_continue = partial(
-    jax.jit, static_argnames=("tol", "max_iters", "walk_kw")
-)(move_step_continue)
+_move_step = register_entry_point(
+    "walk",
+    partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))(
+        move_step
+    ),
+)
+_move_step_continue = register_entry_point(
+    "walk_continue",
+    partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))(
+        move_step_continue
+    ),
+)
+# Rebinds, not bare calls: register_entry_point returns the counting
+# wrapper, and only calls through the wrapper are counted.
+_locate_step = register_entry_point("locate", _locate_step)
+_localize_step = register_entry_point("localize", _localize_step)
 
 
 class PumiTally:

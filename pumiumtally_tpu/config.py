@@ -21,6 +21,50 @@ def default_float_dtype() -> Any:
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
 
+# Per-entry-point retrace budgets: the maximum NEW jit-cache entries
+# (== compiles, one per distinct (shape, static-args) key) any single
+# tier-1 test may create in each engine entry point registered via
+# utils.profiling.register_entry_point. tests/conftest.py wraps every
+# test in a retrace_guard over this table and fails the test on a
+# breach; bench.py records the same counters per measured workload.
+#
+# A budget of B does NOT mean "B compiles per shape" — cache-size
+# counting gives exactly one entry per distinct key, so B bounds how
+# many distinct keys one test may touch. Every entry point below keeps
+# the one-compile-per-(shape, static-args) contract; budgets above 1
+# exist only because single tests legitimately drive several distinct
+# keys:
+#
+# Budgets = the measured tier-1 per-test maximum + 1 headroom
+# (calibrated with PUMIUMTALLY_RETRACE_RECORD over the full suite):
+#
+# - "walk"/"walk_continue" (api/tally.py): measured max 2 — the
+#   equivalence suites legitimately drive two particle/mesh shapes in
+#   one test (e.g. streaming-vs-monolithic compiles the chunk shape
+#   AND the monolithic shape; packed-vs-unpacked walk tables are two
+#   static keys).
+# - "localize" (api/tally.py): measured max 3 — the robustness suite
+#   localizes three distinct batch shapes in one test.
+# - "cascade_phase"/"partition_locate" (parallel/partition.py): one
+#   jitted phase per (engine, config-key), summed under one name;
+#   measured max 4 — blocked-vs-unblocked multichip comparisons build
+#   four engine configs back to back.
+# - "sharded_*" (parallel/sharded.py): measured max 2 (device-count +
+#   chunk-shape sweeps).
+RETRACE_BUDGETS: dict = {
+    "walk": 3,
+    "walk_continue": 3,
+    "locate": 2,
+    "localize": 4,
+    "partition_locate": 3,
+    "cascade_phase": 5,
+    "sharded_walk": 2,
+    "sharded_walk_continue": 2,
+    "sharded_locate": 2,
+    "sharded_localize": 3,
+}
+
+
 @dataclasses.dataclass
 class TallyConfig:
     """Knobs for the tally engine.

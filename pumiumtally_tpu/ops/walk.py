@@ -410,7 +410,7 @@ def walk(
             n_active = jnp.sum(~done)
             return (it < max_iters) & (n_active > _nxt)
 
-        head = lambda a: a[:w]  # noqa: E731 — static-size window slice
+        head = lambda a, _w=w: a[:_w]  # noqa: E731 — static-size window slice
         if mode == "indirect":
             idx_w = head(idx)
 
@@ -459,7 +459,7 @@ def walk(
                 )
             if mode == "arrays":
                 # Round-2 form: one row gather per carried array.
-                upd = lambda a, h: cat(h[perm], a, w)  # noqa: E731
+                upd = lambda a, h, _p=perm, _w=w: cat(h[_p], a, _w)  # noqa: E731
                 s = upd(s, sh)
                 elem = upd(elem, eh)
                 done = upd(done, dh)

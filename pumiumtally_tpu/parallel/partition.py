@@ -78,6 +78,7 @@ from pumiumtally_tpu.ops.walk import (
     fused_tally_body,
 )
 from pumiumtally_tpu.parallel.sharded import _axis_name, shard_map_check_kwargs
+from pumiumtally_tpu.utils.profiling import register_entry_point
 
 try:  # jax >= 0.8
     from jax import shard_map
@@ -392,7 +393,7 @@ def walk_local(
     s, done, exited, pending, it = s0, done, exited, pending0, it0
     for si, w in enumerate(windows):
         nxt_w = windows[si + 1] if si + 1 < len(windows) else 0
-        head = lambda a: a[:w]  # noqa: E731 — static window slice
+        head = lambda a, _w=w: a[:_w]  # noqa: E731 — static window slice
         idx_w = head(idx)
 
         def step(it, s, lelem, done, exited, pending, _idx=idx_w):
@@ -841,6 +842,9 @@ class PartitionedEngine:
             # shared partition faces).
             return lax.pmin(glid, ax)
 
+        # Cache the counting wrapper, not the bare jit: compiles are
+        # counted per call (retrace tripwire, docs/STATIC_ANALYSIS.md).
+        locate = register_entry_point("partition_locate", locate)
         self._jit_cache[key] = locate
         return locate
 
@@ -1192,6 +1196,12 @@ class PartitionedEngine:
             # the gather sub-split's empty-block skip.
             return st, fx, found_all, ovf, it, disp
 
+        # The cascade entry point: walk+migrate rounds compile as ONE
+        # program per (engine, config-key) — tests sweeping several
+        # engine configs accumulate under the one "cascade_phase"
+        # budget in config.RETRACE_BUDGETS. Cache the counting wrapper
+        # so every call is counted (retrace tripwire).
+        phase = register_entry_point("cascade_phase", phase)
         self._jit_cache[key] = phase
         return phase
 

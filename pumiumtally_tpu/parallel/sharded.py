@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
 from pumiumtally_tpu.ops.walk import walk
+from pumiumtally_tpu.utils.profiling import register_entry_point
 
 try:  # jax >= 0.8
     from jax import shard_map
@@ -262,3 +263,18 @@ def sharded_move_step_continue(
         (x, elem, dests, flying, weights), flux, tol, max_iters,
         walk_kw=walk_kw,
     )
+
+
+# Retrace accounting (tests/conftest.py tripwire + bench compile
+# column): the sharded walk has the same one-compile-per-shape contract
+# as the monolithic one. Rebinds, not bare calls — only calls through
+# the returned counting wrapper are counted, and the facades import
+# these names.
+sharded_move_step = register_entry_point("sharded_walk", sharded_move_step)
+sharded_move_step_continue = register_entry_point(
+    "sharded_walk_continue", sharded_move_step_continue
+)
+sharded_localize_step = register_entry_point(
+    "sharded_localize", sharded_localize_step
+)
+sharded_locate = register_entry_point("sharded_locate", sharded_locate)

@@ -1,4 +1,4 @@
-"""Profiling: fenced phase timers + XLA trace capture.
+"""Profiling: fenced phase timers, XLA trace capture, retrace tripwire.
 
 The reference's instrumentation is wall-clock only, and its intended
 ``Kokkos::fence()`` before timestamps never fires due to a macro-name
@@ -6,13 +6,38 @@ mismatch (SURVEY.md §5) — so its device timing is unfenced as shipped.
 Here ``phase_timer`` always fences with ``block_until_ready``, and
 ``trace`` wraps ``jax.profiler`` for real XLA timeline capture
 (view with TensorBoard / xprof).
+
+``retrace_guard`` is the runtime counterpart of the jaxlint static
+analyzer (pumiumtally_tpu/analysis, rule JL004): static analysis can
+flag retrace BAIT (unhashable static defaults), but cache-key
+instability is only observable at run time — an entry point that
+recompiles on every call with identical shapes is indistinguishable
+from a healthy one without counting cache misses. The guard counts two
+things over a ``with`` block:
+
+- per-entry-point compiles, via the counting wrappers
+  ``register_entry_point`` returns: each call reads the wrapped
+  ``PjitFunction._cache_size()`` before/after and credits the growth
+  (one cache entry == one compile == one distinct (shape, static-args)
+  key — so "more than B new entries" is exactly "retraced beyond
+  budget B"). Counting at CALL time, not guard exit, so per-engine
+  entry points garbage-collected mid-block still count in full;
+- total backend compiles, via jax's monitoring event
+  ``/jax/core/compile/backend_compile_duration`` (catches compiles in
+  UNregistered functions too).
+
+tests/conftest.py wraps every tier-1 test in a guard with the budgets
+declared in ``config.RETRACE_BUDGETS``; ``bench.py`` records the
+compile counts of each measured workload alongside its throughput.
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
-from typing import Iterator, Optional
+import weakref
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 
@@ -44,3 +69,198 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
         return
     with jax.profiler.trace(log_dir):
         yield
+
+
+# ---------------------------------------------------------------------------
+# Retrace tripwire
+# ---------------------------------------------------------------------------
+
+class RetraceBudgetExceeded(RuntimeError):
+    """An entry point compiled more than its declared budget allows."""
+
+
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Name -> cumulative compiles observed through that name's counting
+# wrappers (monotonic; guards diff snapshots of this). Call-time
+# accounting rather than cache-size sampling because per-engine entry
+# points (the partitioned phase/locate closures) are garbage-collected
+# with their engine — usually BEFORE a surrounding guard exits (test
+# locals die at function return, fixture teardown runs after), so any
+# exit-time cache-size read would miss their compiles entirely.
+_COMPILE_COUNTS: Dict[str, int] = {}
+# Name -> list of weakrefs to every registrant (introspection only; the
+# counts above are authoritative). Weak so the registry never keeps a
+# dead engine's compiled programs alive.
+_ENTRY_POINTS: Dict[str, list] = {}
+
+_global_compiles = 0
+_listener_installed = False
+
+
+def _on_compile_duration(event: str, duration: float, **kwargs: Any) -> None:
+    global _global_compiles
+    if event == _COMPILE_DURATION_EVENT:
+        _global_compiles += 1
+
+
+def _ensure_compile_listener() -> None:
+    """Install the (process-global, never removed) compile counter."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    from jax._src import monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_compile_duration)
+    _listener_installed = True
+
+
+def compile_count() -> int:
+    """Total backend compiles observed since the listener went in.
+
+    Only deltas are meaningful (compiles before the first
+    ``retrace_guard``/``compile_count`` call are not seen).
+    """
+    _ensure_compile_listener()
+    return _global_compiles
+
+
+class _CountingEntryPoint:
+    """Transparent call-counting proxy around one jitted callable.
+
+    Each ``__call__`` reads the wrapped jit cache size before and after
+    and credits the growth (== compiles this call caused: one cache
+    entry per distinct (shape, static-args) key) to the entry point's
+    global counter — two C-level getter calls per dispatch, noise next
+    to staging a buffer. Everything else (``.lower``, ``._cache_size``,
+    …) delegates to the wrapped function.
+    """
+
+    __slots__ = ("_fn", "_name")
+
+    def __init__(self, name: str, fn: Callable) -> None:
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        before = self._fn._cache_size()
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            grew = self._fn._cache_size() - before
+            if grew > 0:
+                _COMPILE_COUNTS[self._name] = (
+                    _COMPILE_COUNTS.get(self._name, 0) + grew
+                )
+
+    def __getattr__(self, attr: str):
+        return getattr(self._fn, attr)
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<entry point {self._name!r}: {self._fn!r}>"
+
+
+def register_entry_point(name: str, fn: Callable) -> Callable:
+    """Wrap ``fn`` for per-call retrace accounting under ``name``.
+
+    ``fn`` must expose ``_cache_size()`` (any ``jax.jit`` product
+    does). Returns the counting wrapper — call sites MUST adopt the
+    return value (``step = register_entry_point("walk", step)``), or
+    their compiles go uncounted. Several live registrants may share one
+    name (the partitioned engines build a fresh jitted phase per
+    (engine, config-key)); the name's counter sums them.
+    """
+    if isinstance(fn, _CountingEntryPoint):
+        return fn  # idempotent
+    if not hasattr(fn, "_cache_size"):
+        raise TypeError(
+            f"entry point {name!r}: {fn!r} has no _cache_size(); "
+            "register the jax.jit-wrapped callable, not the python fn"
+        )
+    _COMPILE_COUNTS.setdefault(name, 0)
+    refs = _ENTRY_POINTS.setdefault(name, [])
+    refs[:] = [r for r in refs if r() is not None]
+    refs.append(weakref.ref(fn))
+    return _CountingEntryPoint(name, fn)
+
+
+def entry_point_names() -> list:
+    return sorted(_ENTRY_POINTS)
+
+
+@dataclasses.dataclass
+class RetraceReport:
+    """What compiled during one ``retrace_guard`` block.
+
+    ``compiles``: per-entry-point compiles observed by the counting
+    wrappers (one per NEW jit cache entry == one per distinct (shape,
+    static-args) key), counted at call time so entry points whose
+    engine dies inside the block still count in full.
+    ``total_compiles``: backend compiles from any function, registered
+    or not. ``exceeded``: name -> (compiles, budget) for every budget
+    overrun.
+    """
+
+    compiles: Dict[str, int] = dataclasses.field(default_factory=dict)
+    total_compiles: int = 0
+    exceeded: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+
+    def render(self) -> str:
+        per = ", ".join(
+            f"{k}={v}" for k, v in sorted(self.compiles.items())
+        ) or "none"
+        return (
+            f"compiles: total={self.total_compiles}, per entry point: {per}"
+        )
+
+
+@contextlib.contextmanager
+def retrace_guard(
+    budgets: Optional[Dict[str, int]] = None,
+    raise_on_exceed: bool = True,
+) -> Iterator[RetraceReport]:
+    """Count jit compiles over the block; enforce per-entry budgets.
+
+    ``budgets`` maps entry-point names (``register_entry_point``) to
+    the maximum NEW compiles allowed; names without a budget are
+    counted but never fail. The special key ``"total"`` bounds
+    ``total_compiles``. With ``raise_on_exceed`` (default) a breach
+    raises ``RetraceBudgetExceeded`` — but never while another
+    exception is already unwinding. Pass ``raise_on_exceed=False`` to
+    only record breaches in ``report.exceeded`` (the conftest fixture
+    does, to turn them into test failures with context).
+    """
+    _ensure_compile_listener()
+    before = dict(_COMPILE_COUNTS)
+    total_before = _global_compiles
+    report = RetraceReport()
+    ok = False
+    try:
+        yield report
+        ok = True
+    finally:
+        report.total_compiles = _global_compiles - total_before
+        for name, count in _COMPILE_COUNTS.items():
+            delta = count - before.get(name, 0)
+            if delta > 0:
+                report.compiles[name] = delta
+        for name, budget in (budgets or {}).items():
+            got = (
+                report.total_compiles
+                if name == "total"
+                else report.compiles.get(name, 0)
+            )
+            if got > budget:
+                report.exceeded[name] = (got, budget)
+        if ok and report.exceeded and raise_on_exceed:
+            detail = ", ".join(
+                f"{n}: {g} compiles > budget {b}"
+                for n, (g, b) in sorted(report.exceeded.items())
+            )
+            raise RetraceBudgetExceeded(
+                f"retrace budget exceeded ({detail}). A healthy entry "
+                "point compiles once per distinct (shape, static-args) "
+                "key; growth beyond the declared budget means the jit "
+                "cache key is unstable (see jaxlint rule JL004 and "
+                "docs/STATIC_ANALYSIS.md)."
+            )

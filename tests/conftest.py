@@ -35,3 +35,62 @@ if xla_bridge._backends:
     )
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+import json  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _retrace_tripwire(request):
+    """Retrace tripwire: fail any test whose engine entry points compile
+    beyond their declared budget (config.RETRACE_BUDGETS).
+
+    The static half of this invariant is jaxlint rule JL004
+    (docs/STATIC_ANALYSIS.md); this is the runtime half — cache-key
+    instability only shows up as jit-cache growth at run time. Budgets
+    bound DISTINCT (shape, static-args) keys per test, so a healthy
+    entry point stays within budget even on the first test to compile
+    it; a breach means either a cache-key leak (fix the entry point) or
+    a test legitimately sweeping more keys (raise the budget in
+    config.py with a justifying comment).
+
+    Set PUMIUMTALLY_RETRACE_RECORD=<path> to append one JSON line of
+    per-test compile counts (budget calibration) instead of relying on
+    memory of which test compiles what.
+
+    Tests marked ``slow`` get 2x the tier-1 budgets: the stress tier's
+    sweep tests legitimately drive more distinct keys per test (knob
+    combinations across every facade, device-group configurations,
+    forced-migration engine rebuilds) — measured maxima there stay
+    under 2x while a genuine per-call cache-key leak blows through any
+    constant factor.
+    """
+    from pumiumtally_tpu.config import RETRACE_BUDGETS
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    budgets = RETRACE_BUDGETS
+    if request.node.get_closest_marker("slow") is not None:
+        budgets = {k: 2 * v for k, v in budgets.items()}
+    with retrace_guard(budgets, raise_on_exceed=False) as report:
+        yield
+    record = os.environ.get("PUMIUMTALLY_RETRACE_RECORD")
+    if record and (report.compiles or report.total_compiles):
+        with open(record, "a") as f:
+            f.write(json.dumps({
+                "test": request.node.nodeid,
+                "total": report.total_compiles,
+                "compiles": report.compiles,
+            }) + "\n")
+    if report.exceeded:
+        detail = ", ".join(
+            f"{name}: {got} compiles > budget {budget}"
+            for name, (got, budget) in sorted(report.exceeded.items())
+        )
+        pytest.fail(
+            f"retrace budget exceeded ({detail}); full report: "
+            f"{report.render()}. One compile per distinct (shape, "
+            "static-args) key is the contract — see "
+            "config.RETRACE_BUDGETS and docs/STATIC_ANALYSIS.md.",
+            pytrace=False,
+        )

@@ -1,0 +1,510 @@
+"""jaxlint analyzer tests: a fixture corpus of known-bad snippets.
+
+Each corpus entry is one minimal trace-safety violation; the assertions
+pin EXACT rule ids and line numbers so a rule that drifts (fires on the
+wrong line, or stops firing) fails loudly rather than rotting. The
+self-check at the bottom asserts the shipped engine is jaxlint-clean —
+the same gate CI runs (.github/workflows/static-analysis.yml).
+
+Pure host-side tests: the analyzer never imports jax or executes the
+snippets, so this module needs no devices and runs first-class in
+tier 1.
+"""
+
+import os
+import subprocess
+import sys
+
+from pumiumtally_tpu.analysis import RULES, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids(diags):
+    return [(d.rule, d.line) for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# JL001 — host sync inside a traced body
+# ---------------------------------------------------------------------------
+
+def test_jl001_item_in_jit():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()
+"""
+    assert ids(lint_source(src)) == [("JL001", 5)]
+
+
+def test_jl001_device_get_and_asarray():
+    src = """\
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    y = np.asarray(x)
+    return jax.device_get(y)
+"""
+    assert ids(lint_source(src)) == [("JL001", 6), ("JL001", 7)]
+
+
+def test_jl001_float_on_traced():
+    src = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return x * float(jnp.max(x))
+"""
+    assert ids(lint_source(src)) == [("JL001", 6)]
+
+
+def test_jl001_inside_while_loop_body():
+    src = """\
+from jax import lax
+
+def run(state):
+    def body(s):
+        return s + s.item()
+    return lax.while_loop(lambda s: s.sum() > 0, body, state)
+"""
+    assert ids(lint_source(src)) == [("JL001", 5)]
+
+
+def test_jl001_not_flagged_outside_trace():
+    # The same calls at the host boundary are the API working as
+    # intended — zero diagnostics.
+    src = """\
+import numpy as np
+
+def fetch(dev):
+    return np.asarray(dev), dev.item()
+"""
+    assert lint_source(src) == []
+
+
+def test_jl001_asarray_of_static_is_fine():
+    src = """\
+import jax
+import numpy as np
+
+@jax.jit
+def f(x, shape_tuple=(3, 4)):
+    n = np.asarray([1, 2, 3])
+    return x
+"""
+    # np.asarray of a concrete literal at trace time is legal constant
+    # folding, not a sync.
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# JL002 — Python control flow on traced values
+# ---------------------------------------------------------------------------
+
+def test_jl002_if_and_while():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        x = x + 1
+    while x < 10:
+        x = x * 2
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL002", 5), ("JL002", 7)]
+
+
+def test_jl002_assert_and_ifexp():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    assert x.sum() > 0
+    return x if x.max() > 1 else -x
+"""
+    assert ids(lint_source(src)) == [("JL002", 5), ("JL002", 6)]
+
+
+def test_jl002_static_branches_allowed():
+    # Branching on shapes, None-ness, static args, len() — the
+    # bookkeeping every JAX kernel is full of — must NOT flag.
+    src = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode",))
+def f(x, w, mode="fast"):
+    if mode == "fast":
+        x = x + 1
+    if w is None:
+        w = x
+    if x.shape[0] > 4:
+        x = x[:4]
+    if len(x.shape) == 2:
+        x = x.sum(0)
+    return x + w
+"""
+    assert lint_source(src) == []
+
+
+def test_jl002_retaint_inside_loop_uses_fresh_taint():
+    """Expression checks must see taint AS OF the statement's position:
+    a variable reassigned to a concrete value inside a loop must not be
+    judged by its stale pre-loop taint (and the stale verdict must not
+    pin `seen`)."""
+    src = """\
+import jax
+
+@jax.jit
+def f(x, xs):
+    v = x * 2
+    for i in range(3):
+        v = x.shape[0]
+        h = float(v)
+    return x
+"""
+    assert lint_source(src) == []
+
+
+def test_jl001_augassign_keeps_taint():
+    """`x += 1` reads the traced x — it must stay traced (a plain
+    overwrite-with-RHS-taint analysis silently drops it)."""
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    x += 1
+    if x > 0:
+        x = -x
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL002", 6)]
+
+
+# ---------------------------------------------------------------------------
+# JL003 — use after donation
+# ---------------------------------------------------------------------------
+
+def test_jl003_use_after_donate():
+    src = """\
+import jax
+
+def update(s, u):
+    return s + u
+
+step = jax.jit(update, donate_argnums=(0,))
+
+def run(state, u):
+    out = step(state, u)
+    return out + state.sum()
+"""
+    assert ids(lint_source(src)) == [("JL003", 10)]
+
+
+def test_jl003_multiline_call_args_do_not_self_flag():
+    """A donating call written across several lines must not flag its
+    own argument list; a later use still flags."""
+    src = """\
+import jax
+
+def update(s, u):
+    return s + u
+
+step = jax.jit(update, donate_argnums=(0,))
+
+def run(state, u):
+    out = step(
+        state,
+        u,
+    )
+    return out + state.sum()
+"""
+    assert ids(lint_source(src)) == [("JL003", 13)]
+
+
+def test_jl003_rebind_is_clean():
+    src = """\
+import jax
+
+def update(s, u):
+    return s + u
+
+step = jax.jit(update, donate_argnums=(0,))
+
+def run(state, u):
+    state = step(state, u)
+    return state.sum()
+"""
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# JL004 — retrace-bait static defaults
+# ---------------------------------------------------------------------------
+
+def test_jl004_list_default():
+    src = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("knobs",))
+def walk(x, knobs=[8, 4]):
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL004", 5)]
+
+
+def test_jl004_array_default_via_static_argnums():
+    src = """\
+import jax
+import numpy as np
+
+@jax.jit(static_argnums=(1,))
+def f(x, table=np.zeros(4)):
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL004", 5)]
+
+
+def test_jl004_tuple_default_is_clean():
+    src = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("knobs",))
+def walk(x, knobs=(8, 4)):
+    return x
+"""
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# JL005 — module-state mutation under trace
+# ---------------------------------------------------------------------------
+
+def test_jl005_global_and_container():
+    src = """\
+import jax
+
+CACHE = {}
+COUNT = 0
+
+@jax.jit
+def f(x):
+    global COUNT
+    COUNT = COUNT + 1
+    CACHE[0] = x
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL005", 9), ("JL005", 10)]
+
+
+def test_jl005_mutator_method():
+    src = """\
+import jax
+
+LOG = []
+
+@jax.jit
+def f(x):
+    LOG.append(1)
+    return x
+"""
+    assert ids(lint_source(src)) == [("JL005", 7)]
+
+
+# ---------------------------------------------------------------------------
+# One-level helper resolution
+# ---------------------------------------------------------------------------
+
+def test_indirect_sync_one_level():
+    src = """\
+import jax
+
+def fetch(v):
+    return v.item()
+
+@jax.jit
+def f(x):
+    return fetch(x)
+"""
+    # The diagnostic lands on the sync INSIDE the helper (line 4),
+    # reached through the traced call on line 8.
+    assert ids(lint_source(src)) == [("JL001", 4)]
+
+
+def test_indirect_taint_through_helper_args():
+    src = """\
+import jax
+
+def branchy(flag, v):
+    if flag:
+        return v
+    return -v
+
+@jax.jit
+def f(x):
+    return branchy(x > 0, x)
+"""
+    assert ids(lint_source(src)) == [("JL002", 4)]
+
+
+def test_two_levels_not_followed():
+    # Depth limit is ONE: a sync two hops away is out of scope (the
+    # documented precision/recall trade — see docs/STATIC_ANALYSIS.md).
+    src = """\
+import jax
+
+def inner(v):
+    return v.item()
+
+def outer(v):
+    return inner(v)
+
+@jax.jit
+def f(x):
+    return outer(x)
+"""
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# Pragmas
+# ---------------------------------------------------------------------------
+
+def test_pragma_with_justification_suppresses():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()  # jaxlint: disable=JL001 -- boundary fetch by design
+"""
+    assert lint_source(src) == []
+
+
+def test_pragma_without_justification_is_jl000():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()  # jaxlint: disable=JL001
+"""
+    # The bare pragma reports JL000 AND the original finding survives.
+    assert sorted(ids(lint_source(src))) == [("JL000", 5), ("JL001", 5)]
+
+
+def test_pragma_unknown_rule_reported():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()  # jaxlint: disable=JL999 -- no such rule
+"""
+    got = ids(lint_source(src))
+    assert ("JL000", 5) in got and ("JL001", 5) in got
+
+
+def test_pragma_only_disables_named_rule():
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        x = x.item()  # jaxlint: disable=JL002 -- wrong rule named
+    return x
+"""
+    got = ids(lint_source(src))
+    assert got == [("JL002", 5), ("JL001", 6)]
+
+
+# ---------------------------------------------------------------------------
+# Rule registry / CLI contract
+# ---------------------------------------------------------------------------
+
+def test_rule_registry_complete():
+    assert sorted(RULES) == ["JL000", "JL001", "JL002", "JL003", "JL004",
+                             "JL005"]
+    for rule in RULES.values():
+        assert rule.summary and rule.doc
+        assert "bad" in rule.doc and "good" in rule.doc
+
+
+def test_jit_wrapped_in_registration_call_still_analyzed():
+    """register_entry_point (the retrace counting wrapper) must not
+    hide the jit from trace-root discovery — the engine's own
+    `_move_step = register_entry_point("walk", jit(move_step))` form."""
+    src = """\
+import jax
+from functools import partial
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+def move_step(x, tol):
+    return x.item()
+
+_move_step = register_entry_point(
+    "walk",
+    partial(jax.jit, static_argnames=("tol",))(move_step),
+)
+"""
+    assert ids(lint_source(src)) == [("JL001", 6)]
+
+
+def test_cli_missing_path_is_usage_error():
+    """A typo'd target must not read as clean (exit 2, like ruff)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pumiumtally_tpu.analysis",
+         "no_such_dir_xyz/"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+def test_cli_nonzero_on_bad_corpus(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "pumiumtally_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "JL001" in proc.stdout and "bad.py:5" in proc.stdout
+
+
+def test_cli_explain():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pumiumtally_tpu.analysis",
+         "--explain", "JL004"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    assert "retrace" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Self-check: the shipped engine is jaxlint-clean
+# ---------------------------------------------------------------------------
+
+def test_engine_is_jaxlint_clean():
+    """The acceptance gate CI enforces, as a test: every diagnostic in
+    the engine tree is either fixed or carries a justified pragma."""
+    from pumiumtally_tpu.analysis import lint_paths
+
+    diags = lint_paths([os.path.join(REPO, "pumiumtally_tpu")])
+    assert diags == [], "\n".join(d.render() for d in diags)

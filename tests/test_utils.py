@@ -302,3 +302,116 @@ def test_autotune_walk_returns_valid_tuned_config():
         t.MoveToNextLocation(None, d1.reshape(-1).copy())
         out.append(np.asarray(t.flux, np.float64))
     np.testing.assert_allclose(out[0], out[1], rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Retrace tripwire (utils/profiling.py; docs/STATIC_ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+def test_retrace_guard_counts_entry_point_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.utils.profiling import (
+        register_entry_point,
+        retrace_guard,
+    )
+
+    step = register_entry_point(
+        "_test_rg_counts", jax.jit(lambda x: x * 2)
+    )
+    with retrace_guard(raise_on_exceed=False) as report:
+        step(jnp.ones(7))      # compile 1 (new shape)
+        step(jnp.ones(7))      # cache hit
+        step(jnp.ones(13))     # compile 2 (new shape)
+    assert report.compiles["_test_rg_counts"] == 2
+    assert report.total_compiles >= 2
+    assert report.exceeded == {}
+
+
+def test_retrace_guard_budget_breach_raises():
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.utils.profiling import (
+        RetraceBudgetExceeded,
+        register_entry_point,
+        retrace_guard,
+    )
+
+    step = register_entry_point(
+        "_test_rg_budget", jax.jit(lambda x: x + 1)
+    )
+    with pytest.raises(RetraceBudgetExceeded, match="_test_rg_budget"):
+        with retrace_guard({"_test_rg_budget": 1}):
+            step(jnp.ones(3))
+            step(jnp.ones(5))  # second key > budget 1
+    # raise_on_exceed=False records instead (the conftest fixture path)
+    with retrace_guard({"_test_rg_budget": 0},
+                       raise_on_exceed=False) as report:
+        step(jnp.ones(9))
+    assert report.exceeded["_test_rg_budget"] == (1, 0)
+
+
+def test_retrace_guard_counts_survive_engine_gc():
+    """Per-engine entry points die with their engine BEFORE a
+    surrounding guard exits (test locals are freed at function return,
+    fixture teardown runs after) — call-time counting must still see
+    their compiles."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.utils.profiling import (
+        register_entry_point,
+        retrace_guard,
+    )
+
+    def build_and_drive():
+        step = register_entry_point(
+            "_test_rg_gc", jax.jit(lambda x: x - 1)
+        )
+        step(jnp.ones(11))
+        # `step` (and the jit cache behind it) dies on return
+
+    with retrace_guard(raise_on_exceed=False) as report:
+        build_and_drive()
+        gc.collect()
+    assert report.compiles["_test_rg_gc"] == 1
+
+
+def test_register_entry_point_rejects_unjitted():
+    from pumiumtally_tpu.utils.profiling import register_entry_point
+
+    with pytest.raises(TypeError, match="_cache_size"):
+        register_entry_point("_test_rg_plain", lambda x: x)
+
+
+def test_tally_entry_points_registered():
+    """The engine's hot paths are registered for retrace accounting:
+    importing the facades registers the module-level entry points, and
+    driving a FRESH shape through a monolithic move is counted as
+    exactly one walk compile, within the declared budgets."""
+    from pumiumtally_tpu.config import RETRACE_BUDGETS
+    from pumiumtally_tpu.utils.profiling import (
+        entry_point_names,
+        retrace_guard,
+    )
+
+    assert {"walk", "walk_continue", "locate", "localize",
+            "sharded_walk", "sharded_walk_continue"} <= set(
+        entry_point_names()
+    )
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    n = 23  # a particle count no other test uses: walk MUST compile
+    t = PumiTally(mesh, n)
+    rng = np.random.default_rng(5)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    dst = rng.uniform(0.1, 0.9, (n, 3))
+    with retrace_guard(RETRACE_BUDGETS) as report:  # raises on breach
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(),
+                             dst.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+    assert report.compiles.get("walk") == 1

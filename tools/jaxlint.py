@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Thin repo-root wrapper for the jaxlint CLI.
+
+Equivalent to ``python -m pumiumtally_tpu.analysis`` but runnable from
+a checkout WITHOUT jax/numpy installed (the CI jaxlint job runs on a
+bare Python): importing ``pumiumtally_tpu.analysis`` normally first
+executes the package ``__init__``, which imports jax. The stub parent
+module below gives ``pumiumtally_tpu`` a ``__path__`` without running
+its ``__init__``, so only the stdlib-only analysis subpackage loads.
+See docs/STATIC_ANALYSIS.md.
+"""
+
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+if "pumiumtally_tpu" not in sys.modules:
+    _stub = types.ModuleType("pumiumtally_tpu")
+    _stub.__path__ = [os.path.join(_REPO, "pumiumtally_tpu")]
+    sys.modules["pumiumtally_tpu"] = _stub
+
+from pumiumtally_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""One-command local lint: exactly what CI's static-analysis workflow
+runs, so a green ``python tools/lint_all.py`` predicts green CI.
+
+Runs, in order:
+
+1. ruff  — ``ruff check pumiumtally_tpu/ tests/ bench.py`` (the pinned
+   generic Python linter; CI pins ``ruff==X`` and pyproject's ``dev``
+   extra carries the same pin — this script warns when the local ruff
+   version drifts from that pin, since a drifted local can pass rules
+   CI fails or vice versa). Skipped with a warning when ruff is not
+   installed (``pip install -e .[dev]`` provides it).
+2. jaxlint — ``python -m pumiumtally_tpu.analysis pumiumtally_tpu/
+   bench.py`` (the JAX-aware trace-safety analyzer; rules JL001–JL005,
+   docs/STATIC_ANALYSIS.md). Always available: pure stdlib.
+
+This is the documented pre-PR check (README). Exit status is non-zero
+if ANY linter that ran found issues; a missing ruff does not mask a
+jaxlint failure (and vice versa). clang-tidy (the native layer's
+linter) is CI-only — it needs a system toolchain this script does not
+assume.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUFF_TARGETS = ["pumiumtally_tpu/", "tests/", "bench.py"]
+JAXLINT_TARGETS = ["pumiumtally_tpu/", "bench.py"]
+
+
+def pinned_ruff_version() -> str | None:
+    """The ruff pin from pyproject's dev extra (single source of truth
+    shared with .github/workflows/static-analysis.yml)."""
+    try:
+        with open(os.path.join(REPO, "pyproject.toml")) as f:
+            m = re.search(r'"ruff==([0-9.]+)"', f.read())
+        return m.group(1) if m else None
+    except OSError:
+        return None
+
+
+def run_ruff() -> int | None:
+    """ruff check; None = ruff unavailable (skipped, with a warning)."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        print(
+            "lint_all: ruff not installed — SKIPPING the ruff pass "
+            "(CI will still run it; `pip install -e .[dev]` installs "
+            "the pinned version)",
+            file=sys.stderr,
+        )
+        return None
+    pin = pinned_ruff_version()
+    local = subprocess.run(
+        [ruff, "--version"], capture_output=True, text=True
+    ).stdout.strip().split()[-1]
+    if pin and local != pin:
+        print(
+            f"lint_all: WARNING — local ruff {local} != pinned {pin} "
+            "(pyproject [dev] / static-analysis.yml); results may "
+            "differ from CI",
+            file=sys.stderr,
+        )
+    print(f"lint_all: ruff check {' '.join(RUFF_TARGETS)}")
+    return subprocess.run([ruff, "check", *RUFF_TARGETS], cwd=REPO).returncode
+
+
+def run_jaxlint() -> int:
+    print(f"lint_all: jaxlint {' '.join(JAXLINT_TARGETS)}")
+    # Via tools/jaxlint.py, whose stub-package bootstrap keeps the
+    # analyzer importable without jax — same entry CI uses.
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "jaxlint.py"),
+         *JAXLINT_TARGETS],
+        cwd=REPO,
+    ).returncode
+
+
+def main() -> int:
+    codes = [run_ruff(), run_jaxlint()]
+    ran = [c for c in codes if c is not None]
+    if any(ran):
+        print("lint_all: FAILED", file=sys.stderr)
+        return 1
+    print("lint_all: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
