@@ -151,9 +151,12 @@ _TUNED_KNOBS: dict | None = None
 def tuned_knobs() -> dict:
     """Walk-kernel knobs measured ONCE on this backend for the bench
     mesh (utils/autotune.py; disable with PUMIUMTALLY_BENCH_AUTOTUNE=0).
-    Tuning cannot change physics — every candidate runs the same
-    bitwise-specified walk — so the conservation gate still applies
-    unchanged to the tuned engine."""
+    Tuning cannot change physics: the sweep MEASURES an approximate
+    candidate (the bf16 two-tier tables — documented tie-class
+    divergence) but the autotuner never ADOPTS it without
+    allow_approximate, so the returned knobs always specify a walk
+    bitwise-equivalent to the defaults and the conservation gate still
+    applies unchanged to the tuned engine."""
     global _TUNED_KNOBS
     if _TUNED_KNOBS is None:
         if os.environ.get("PUMIUMTALLY_BENCH_AUTOTUNE", "1") == "0":
@@ -175,9 +178,21 @@ def tuned_knobs() -> dict:
                 _TUNED_KNOBS = {
                     f"walk_{k}": v for k, v in cfg.walk_kwargs()
                 }
-                print(f"# autotuned: {dict(cfg.walk_kwargs())} "
-                      f"({report[0]['moves_per_sec'] / 1e6:.2f}M moves/s in "
-                      "the sweep)", file=sys.stderr)
+                # The ADOPTED entry's rate, not report[0]'s: an
+                # approximate-tier candidate may top the raw sweep
+                # without being adopted — and an all-approximate sweep
+                # adopts nothing (defaults kept), which must not pair
+                # the defaults with the approximate rate.
+                adopted = next(
+                    (r for r in report if r.get("adopted")), None
+                )
+                note = (
+                    f"({adopted['moves_per_sec'] / 1e6:.2f}M moves/s in "
+                    "the sweep)" if adopted
+                    else "(no adoptable candidate; defaults kept)"
+                )
+                print(f"# autotuned: {dict(cfg.walk_kwargs())} {note}",
+                      file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — tuning is best-effort
                 print(f"# autotune failed, using default knobs: {e}",
                       file=sys.stderr)
@@ -341,6 +356,25 @@ def run_pincell(n: int, moves: int, tuned: bool = False) -> dict:
     return res
 
 
+def run_table_precision_ab() -> dict | None:
+    """Component row: f32 single-tier vs bf16 two-tier walk tables
+    (tools/exp_table_precision_ab.py run_ab) — rates interleaved,
+    select-tier bytes provenance, flux divergence vs the f32 arm.
+    Makes the byte-halving bet (or a regression) visible in every
+    round bench; best-effort. The headline engines stay on the f32
+    default — this row is the measured evidence for (or against)
+    flipping walk_table_dtype. Reduced shape (200k particles, 3 moves)
+    so the extra row costs minutes, not a second full bench."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_table_precision_ab
+
+    return exp_table_precision_ab.run_ab(
+        n=min(N, 200_000), div=MESH_DIV, moves=3, trials=3
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -443,11 +477,20 @@ def _current_round() -> int | None:
 
 def _is_standard_workload() -> bool:
     """Only the canonical headline workload is worth caching as 'this
-    round's measurement' — env-resized dev/test runs are not."""
-    return not any(os.environ.get(k) for k in (
+    round's measurement' — env-resized dev/test runs are not, and
+    neither is a run with the walk-table tier flipped to bf16 (the r6
+    suite's A/B stage): its headline is not the default-config
+    number."""
+    if any(os.environ.get(k) for k in (
         "PUMIUMTALLY_BENCH_N", "PUMIUMTALLY_BENCH_DIV",
         "PUMIUMTALLY_BENCH_MOVES",
-    ))
+    )):
+        return False
+    # Only a NON-default tier makes the run nonstandard — an explicit
+    # float32/auto still measures the default-config headline.
+    return os.environ.get("PUMIUMTALLY_WALK_TABLE_DTYPE", "float32") in (
+        "float32", "auto"
+    )
 
 
 def record_success(rec: dict) -> None:
@@ -471,6 +514,23 @@ def record_success(rec: dict) -> None:
         print(f"# could not persist bench result: {e}", file=sys.stderr)
 
 
+def _refuse_stale(reason: str) -> None:
+    """Terminal refusal of the stale-result fallback: exit 0 with ONE
+    machine-parseable JSON line ``{"stale_refused": true, "reason"}``.
+
+    The r5 round record showed why rc=1-and-no-JSON is the wrong shape
+    here: the driver recorded ``parsed: null`` and the refusal's reason
+    lived only in stderr nobody keeps. A refusal is a successfully
+    reported OUTCOME ("no number exists for this round, and here is
+    why"), not a crash — so it parses like every other bench record,
+    and consumers key on ``stale_refused`` exactly as they key on
+    ``stale``. No ``metric``/``value`` keys ride along: a consumer
+    that ignores the flag gets nothing it could mistake for a rate."""
+    print(f"# {reason}", file=sys.stderr)
+    print(json.dumps({"stale_refused": True, "reason": reason}))
+    sys.exit(0)
+
+
 def _report_stale_result_or_die() -> None:
     """Device unreachable: fall back to this round's last SUCCESSFUL
     on-chip measurement, conspicuously flagged as stale.
@@ -482,26 +542,36 @@ def _report_stale_result_or_die() -> None:
     honest than an empty record, and the flag keeps it from ever
     being mistaken for a fresh round-end measurement. A cached result
     from another round (round-id mismatch, or past the age backstop
-    when no round id is known) still dies: that would be a different
-    round's number. PUMIUMTALLY_BENCH_NO_STALE=1 disables the
-    fallback entirely."""
+    when no round id is known) is still refused: that would be a
+    different round's number — but the refusal itself reports as a
+    single ``{"stale_refused": true, ...}`` JSON line with rc 0 (see
+    _refuse_stale). PUMIUMTALLY_BENCH_NO_STALE=1 disables the
+    fallback entirely (also reporting the refusal record)."""
     if os.environ.get("PUMIUMTALLY_BENCH_NO_STALE") == "1":
-        sys.exit(1)
+        _refuse_stale(
+            "device unreachable and PUMIUMTALLY_BENCH_NO_STALE=1: "
+            "stale-result fallback disabled"
+        )
     try:
         with open(LAST_SUCCESS_PATH) as f:
             rec = json.load(f)
     except (OSError, ValueError):
-        sys.exit(1)
+        _refuse_stale(
+            "device unreachable and no cached successful bench result "
+            "exists for this round"
+        )
     rnd, rec_rnd = _current_round(), rec.get("measured_in_round")
     if rnd is not None and rec_rnd is not None and int(rec_rnd) != rnd:
-        print(f"# cached bench result is from round {rec_rnd}, this is "
-              f"round {rnd}; refusing to report it", file=sys.stderr)
-        sys.exit(1)
+        _refuse_stale(
+            f"cached bench result is from round {rec_rnd}, this is "
+            f"round {rnd}; refusing to report it"
+        )
     age = time.time() - float(rec.get("measured_at_epoch", 0))
     if age > STALE_MAX_AGE_S:
-        print(f"# cached bench result is {age/3600:.1f}h old — another "
-              "round's number; refusing to report it", file=sys.stderr)
-        sys.exit(1)
+        _refuse_stale(
+            f"cached bench result is {age / 3600:.1f}h old — another "
+            "round's number; refusing to report it"
+        )
     rec.pop("measured_at_epoch", None)
     rec["stale"] = True
     # Distinct metric name: a consumer keying on metric/value alone
@@ -524,17 +594,26 @@ def measure_link_bandwidth(mb: float = 8.0) -> float | None:
 
     Recorded so vs_baseline numbers are interpretable across
     tunnel-quality changes: the staging-bound protocols scale with this.
+
+    The timed region is the TRANSFER alone: device_put followed by
+    block_until_ready on the resulting array. The earlier form summed
+    the array and fetched the scalar to force the transfer, which
+    charged a reduction kernel launch plus a D2H scalar round-trip to
+    the link number — on the remote tunnel that overhead dominated and
+    the probe reported 31 MB/s on a ~35 MB/s link as if staging were
+    the whole story. block_until_ready on a just-transferred array is
+    an honest fence for the transfer itself (the laziness caveat in
+    PERF_NOTES r1 §5 concerns COMPUTE dispatched asynchronously; the
+    put's completion is what the handle's ready-event tracks), and the
+    warmup transfer absorbs any one-time client/allocation setup.
     """
     try:
         import jax
-        import jax.numpy as jnp
 
         buf = np.random.default_rng(2).random(int(mb * 1e6 / 8))
-        # Warm with the IDENTICAL expression: the timed region must not
-        # include jnp.sum's first-call compile.
-        float(jnp.sum(jax.device_put(buf)))
+        jax.device_put(buf).block_until_ready()  # warmup transfer
         t0 = time.perf_counter()
-        float(jnp.sum(jax.device_put(buf)))  # forces the transfer + a sync
+        jax.device_put(buf).block_until_ready()
         dt = time.perf_counter() - t0
         return buf.nbytes / 1e6 / dt
     except Exception as e:  # noqa: BLE001 — diagnostic only
@@ -654,6 +733,12 @@ def _measure_and_report() -> None:
             redistribution = run_redistribution_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# redistribution A/B failed: {e}", file=sys.stderr)
+    table_precision = None
+    if os.environ.get("PUMIUMTALLY_BENCH_TABLE_PRECISION", "1") != "0":
+        try:
+            table_precision = run_table_precision_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# table-precision A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -757,6 +842,12 @@ def _measure_and_report() -> None:
         # argsort-vs-rank redistribution component (speedup > 1 means
         # the sort-free counting-rank path wins on this backend).
         "redistribution": redistribution,
+        # f32-vs-bf16 two-tier walk-table component (select-tier bytes
+        # provenance + interleaved rates + flux divergence). The
+        # headline engines stay on the f32 default; speedup > 1 with a
+        # benign flux_l1_rel_divergence is the evidence for flipping
+        # TallyConfig.walk_table_dtype.
+        "table_precision": table_precision,
         "gather_blocked": None if gblocked is None else {
             "moves_per_sec": gblocked["moves_per_sec"],
             "blocks_per_chip": gblocked["blocks_per_chip"],

@@ -36,6 +36,10 @@ class PartitionedPumiTally(PumiTally):
     """Track-length tally with the tet mesh sharded across the device
     mesh (element ownership + particle migration)."""
 
+    # The engine builds its own per-chip (possibly tiered) tables from
+    # the partition — see PumiTally._replicated_mesh_walk.
+    _replicated_mesh_walk = False
+
     def __init__(
         self,
         mesh: Union[TetMesh, str],
@@ -85,6 +89,7 @@ class PartitionedPumiTally(PumiTally):
             vmem_walk_max_elems=self.config.walk_vmem_max_elems,
             block_kernel=self.config.walk_block_kernel,
             partition_method=self.config.resolved_partition_method(),
+            table_dtype=self._table_dtype,
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

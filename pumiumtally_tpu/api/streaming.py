@@ -419,6 +419,10 @@ class StreamingPartitionedTally(StreamingTally):
     flux accumulates across chunks.
     """
 
+    # Per-chip tiered tables come from build_partition, not the
+    # replicated mesh — see PumiTally._replicated_mesh_walk.
+    _replicated_mesh_walk = False
+
     def __init__(
         self,
         mesh: Union[TetMesh, str],
@@ -476,16 +480,32 @@ class StreamingPartitionedTally(StreamingTally):
         # through the same helper the engines use, or a prebuilt part
         # could carry blocks the kernel cannot compile on hardware.
         from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
+        from pumiumtally_tpu.parallel.partition import (
+            block_elems_bound,
+            resolve_block_kernel,
+        )
 
         # The Mosaic scoped-VMEM clamp applies only to the vmem block
-        # kernel; the gather block kernel has no such ceiling.
-        if self.config.walk_block_kernel == "vmem":
+        # kernel; the gather block kernel has no such ceiling. A bf16
+        # two-tier config routes blocked walks through the gather
+        # kernel (same resolution the engines apply), with the block
+        # element bound at 2x — the half-width select tier keeps
+        # resident bytes constant.
+        block_kernel = resolve_block_kernel(
+            self.config.walk_block_kernel, self._table_dtype
+        )
+        if block_kernel == "vmem":
             vmem_bound = effective_vmem_bound(self.config.walk_vmem_max_elems)
         else:
             vmem_bound = self.config.walk_vmem_max_elems
-        part = build_partition(mesh, per * derive_blocks_per_chip(
-            mesh.nelems, per, vmem_bound
-        ))
+        part = build_partition(
+            mesh,
+            per * derive_blocks_per_chip(
+                mesh.nelems, per,
+                block_elems_bound(vmem_bound, self._table_dtype),
+            ),
+            table_dtype=self._table_dtype,
+        )
         caches = [dict() for _ in range(ngroups)]
         # Each engine is sized to its chunk's REAL particle count (a
         # padded slot would otherwise be a live particle piling onto

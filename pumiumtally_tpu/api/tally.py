@@ -339,6 +339,13 @@ class PumiTally:
         jax.block_until_ready(self.x)
         self.tally_times.initialization_time += time.perf_counter() - t0
 
+    # Facades whose walks gather from the replicated ``self.mesh``
+    # tables (monolithic/sharded/streaming) adopt the two-tier tables
+    # here; the partitioned facades set this False — they build their
+    # own per-chip tiered tables in build_partition and a converted
+    # monolithic mesh would just pin dead [E]-sized arrays on device.
+    _replicated_mesh_walk = True
+
     def _init_common(self, mesh, num_particles, config) -> TetMesh:
         """Shared construction: config resolution, mesh load, counters."""
         self.config = config or TallyConfig()
@@ -355,6 +362,12 @@ class PumiTally:
             self.dtype = mesh.coords.dtype
         elif mesh.coords.dtype != self.dtype:
             mesh = mesh.astype(self.dtype)
+        self._table_dtype = self.config.resolved_table_dtype()
+        if self._table_dtype == "bfloat16" and self._replicated_mesh_walk:
+            # walk_kwargs() emits the matching static table_dtype key,
+            # so the walk kernel runs the two-tier path against these
+            # tables (select-in-bf16 / commit-in-f32, docs/DESIGN.md).
+            mesh = mesh.with_lowp_tables()
         self.mesh = mesh
         self.num_particles = int(num_particles)
         self._tol = self.config.resolved_tolerance(self.dtype)
@@ -847,5 +860,6 @@ class PumiTally:
         xp = walk_xpoints(
             self.mesh, x0, e0, dests, fly,
             tol=self._tol, max_iters=self._max_iters,
+            table_dtype=self._table_dtype,
         )
         return np.asarray(xp)[: self.num_particles]

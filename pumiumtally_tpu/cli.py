@@ -160,8 +160,17 @@ def cmd_autotune(args) -> None:
         ", ".join(f"walk_{k}={v!r}" for k, v in kw)
         if kw else "<defaults — no knob beats them on this backend>"
     )
-    print(f"\nbest: {report[0]['moves_per_sec'] / 1e6:.3f}M moves/s with "
-          f"TallyConfig({settings})")
+    # The adopted entry, not report[0]: an approximate-tier candidate
+    # (never adopted by default) may top the raw sweep — and an
+    # all-approximate sweep adopts nothing, so no rate is paired with
+    # the kept defaults.
+    adopted = next((r for r in report if r.get("adopted")), None)
+    if adopted is None:
+        print(f"\nbest: no adoptable candidate (approximate tiers are "
+              f"measured but not adopted); keeping TallyConfig({settings})")
+    else:
+        print(f"\nbest: {adopted['moves_per_sec'] / 1e6:.3f}M moves/s with "
+              f"TallyConfig({settings})")
 
 
 def cmd_aot_check(args) -> None:

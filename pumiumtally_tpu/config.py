@@ -192,6 +192,37 @@ class TallyConfig:
     walk_perm_mode: Optional[str] = None
     walk_window_factor: Optional[int] = None
     walk_min_window: Optional[int] = None
+    # Walk-table precision tier (ops/walk.py TABLE_DTYPES,
+    # docs/PERF_NOTES.md "Table precision tiers"):
+    #   None / "auto" — resolve via PUMIUMTALLY_WALK_TABLE_DTYPE
+    #                   (default "float32").
+    #   "float32"     — the packed single-tier row table (historical
+    #                   layout; bitwise-identical to pre-knob builds).
+    #   "bfloat16"    — two-tier: a half-width bf16 SELECT row picks
+    #                   the exit face (32 B gathered vs 80 B), then ONE
+    #                   full-precision refinement gather of the winning
+    #                   face's (plane, neighbor) row (20 B) recomputes
+    #                   the crossing exactly before committing — 52 B
+    #                   per crossing vs 80. Track lengths and committed
+    #                   positions carry full working-dtype accuracy;
+    #                   wrong-face selection needs two crossings tying
+    #                   within ~bf16 epsilon and lands in the
+    #                   documented benign divergence class
+    #                   (docs/DESIGN.md select-in-bf16/commit-in-f32
+    #                   invariant). NOT bitwise vs "float32"; the
+    #                   engines' conservation gates apply unchanged.
+    #                   Neighbor ids live in the refinement rows'
+    #                   float lane — exact below 2^24 elements (f32),
+    #                   the same ceiling as the packed layout; builds
+    #                   past it refuse.
+    # Resolved at config time (like walk_perm_mode) so the tier lands
+    # in the engines' static jit keys; facades convert their mesh /
+    # partition tables accordingly. Partitioned engines with the bf16
+    # tier route blocked walks through the GATHER block kernel (the
+    # vmem one-hot kernel has no two-tier lowering yet — ops/vmem_walk
+    # ceiling notes) with block tables at 2x the f32 element bound
+    # (same resident bytes).
+    walk_table_dtype: Optional[str] = None
     # How every redistribution site (cascade stage boundaries, the
     # partitioned walk's in-round compaction, particle migration)
     # computes its stable partition permutation: "rank" (counting ranks
@@ -273,6 +304,13 @@ class TallyConfig:
                 "walk_perm_mode must be auto/arrays/packed/indirect/"
                 f"sorted, got {self.walk_perm_mode!r}"
             )
+        if self.walk_table_dtype is not None and self.walk_table_dtype not in (
+            "auto", "float32", "bfloat16"
+        ):
+            raise ValueError(
+                "walk_table_dtype must be auto/float32/bfloat16, "
+                f"got {self.walk_table_dtype!r}"
+            )
         if self.walk_partition_method is not None and (
             self.walk_partition_method not in ("rank", "argsort")
         ):
@@ -330,6 +368,15 @@ class TallyConfig:
             else int(self.walk_cond_every)
         )
 
+    def resolved_table_dtype(self) -> str:
+        """Walk-table precision tier with env resolution applied
+        (consumed by every facade to decide whether the mesh/partition
+        carries the two-tier tables; the monolithic walks also get it
+        through walk_kwargs so it is part of the static jit key)."""
+        from pumiumtally_tpu.ops.walk import _resolve_table_dtype
+
+        return _resolve_table_dtype(self.walk_table_dtype or "auto")
+
     def resolved_partition_method(self) -> str:
         """Partition-permutation method with the default applied
         (consumed by the partitioned engines; the monolithic walks get
@@ -347,7 +394,9 @@ class TallyConfig:
         jit cache keys match pre-knob builds)."""
         from pumiumtally_tpu.ops.walk import (
             PERM_MODE_DEFAULT,
+            TABLE_DTYPE_DEFAULT,
             _resolve_perm_mode,
+            _resolve_table_dtype,
         )
 
         out = []
@@ -368,6 +417,13 @@ class TallyConfig:
         # the explicit choice.
         if mode != PERM_MODE_DEFAULT or mode != _resolve_perm_mode("auto"):
             out.append(("perm_mode", mode))
+        # Same resolution + emission rule for the table-precision tier:
+        # resolved here so the tier is a static jit key (env flip ⇒
+        # recompile); default-equal dropped for cache-key parity; an
+        # explicit "float32" under a contrary env var still emitted.
+        td = _resolve_table_dtype(self.walk_table_dtype or "auto")
+        if td != TABLE_DTYPE_DEFAULT or td != _resolve_table_dtype("auto"):
+            out.append(("table_dtype", td))
         if self.walk_window_factor is not None:
             out.append(("window_factor", int(self.walk_window_factor)))
         if self.walk_min_window is not None:

@@ -59,6 +59,24 @@ WALK_TABLE_OFFSETS = slice(12, 16)  # 4 face-plane offsets
 WALK_TABLE_ADJ = slice(16, 20)  # 4 neighbor ids, as floats
 WALK_TABLE_WIDTH = 20
 
+# Two-tier layout (the bf16 select tier + full-precision refinement
+# tier; docs/PERF_NOTES.md "Table precision tiers"). The SELECT tier is
+# a half-width bf16 row holding only the face planes — adjacency ids
+# cannot live in bf16 lanes (8 mantissa bits ⇒ exact only below 2^8).
+# The REFINEMENT tier is a per-FACE table: row ``elem*4 + f`` holds
+# ``(nx, ny, nz, off, adj)`` of face f in the working dtype, so
+# recomputing the WINNING face's crossing exactly AND fetching its
+# neighbor id costs ONE [WALK_PLANE_WIDTH]-row gather (20 B f32)
+# instead of re-fetching the whole 80 B packed row plus an adjacency
+# row. The adj lane carries the id as a float — exact below
+# 2^(mantissa+1), the SAME ceiling the packed [E,20] layout already
+# lives under — so the two-tier build refuses past it rather than
+# silently corrupting neighbor ids.
+WALK_TABLE_LO_NORMALS = slice(0, 12)  # bf16, 4 faces × 3 components
+WALK_TABLE_LO_OFFSETS = slice(12, 16)  # bf16, 4 face-plane offsets
+WALK_TABLE_LO_WIDTH = 16
+WALK_PLANE_WIDTH = 5  # refinement row: (nx, ny, nz, off, adj) of ONE face
+
 
 def _pack_walk_table(xp, normals, offsets, adj):
     """Assemble the [E,WALK_TABLE_WIDTH] row (xp: np or jnp namespace).
@@ -74,6 +92,35 @@ def _pack_walk_table(xp, normals, offsets, adj):
     )
     assert row.shape[1] == WALK_TABLE_WIDTH
     return row
+
+
+def pack_lo_table(xp, normals, offsets):
+    """Assemble the bf16 SELECT tier: [E,WALK_TABLE_LO_WIDTH] rows of
+    normals|offsets (xp: np or jnp namespace). bf16 rounding happens
+    here, once, on the host-precision inputs."""
+    ne = offsets.shape[0]
+    row = xp.concatenate([normals.reshape(ne, 12), offsets], axis=1)
+    assert row.shape[1] == WALK_TABLE_LO_WIDTH
+    return jnp.asarray(row, dtype=jnp.bfloat16)
+
+
+def pack_plane_table(xp, normals, offsets, adj, dtype):
+    """Assemble the REFINEMENT tier: [E*4, WALK_PLANE_WIDTH] rows, one
+    per (elem, face), holding (nx, ny, nz, off, adj) in ``dtype``.
+    ``adj`` rows must carry ids exactly representable in ``dtype``
+    (caller-checked via ``_exact_id_limit``) and must be float64 (or
+    exact) on entry so they survive the cast, like the packed table."""
+    ne = offsets.shape[0]
+    row = xp.concatenate(
+        [
+            normals.reshape(ne * 4, 3),
+            offsets.reshape(ne * 4, 1),
+            adj.astype(xp.float64).reshape(ne * 4, 1),
+        ],
+        axis=1,
+    )
+    assert row.shape[1] == WALK_PLANE_WIDTH
+    return jnp.asarray(row, dtype=dtype)
 
 
 def _signed_volumes(coords: np.ndarray, tet2vert: np.ndarray) -> np.ndarray:
@@ -122,19 +169,33 @@ class TetMesh:
     # it — walk geometry is kept once in HBM, not twice.
     stored_face_normals: Any = None  # [E,4,3] float, unit outward
     stored_face_offsets: Any = None  # [E,4] float
+    # Two-tier walk tables (both non-None, or both None): the bf16
+    # SELECT tier gathered per crossing to pick the exit face, and the
+    # full-precision per-face REFINEMENT tier gathered once for the
+    # winning face only. When present, ``walk_table`` is dropped — the
+    # refinement tier is then the full-precision source of truth the
+    # face_normals/face_offsets properties derive from.
+    walk_table_lo: Any = None  # [E,WALK_TABLE_LO_WIDTH] bf16
+    walk_table_hi: Any = None  # [E*4,WALK_PLANE_WIDTH] working dtype
 
     @property
     def face_normals(self) -> Any:
         if self.stored_face_normals is not None:
             return self.stored_face_normals
-        ne = self.walk_table.shape[0]
-        return self.walk_table[:, WALK_TABLE_NORMALS].reshape(ne, 4, 3)
+        if self.walk_table is not None:
+            ne = self.walk_table.shape[0]
+            return self.walk_table[:, WALK_TABLE_NORMALS].reshape(ne, 4, 3)
+        ne = self.walk_table_hi.shape[0] // 4
+        return self.walk_table_hi.reshape(ne, 4, WALK_PLANE_WIDTH)[:, :, :3]
 
     @property
     def face_offsets(self) -> Any:
         if self.stored_face_offsets is not None:
             return self.stored_face_offsets
-        return self.walk_table[:, WALK_TABLE_OFFSETS]
+        if self.walk_table is not None:
+            return self.walk_table[:, WALK_TABLE_OFFSETS]
+        ne = self.walk_table_hi.shape[0] // 4
+        return self.walk_table_hi.reshape(ne, 4, WALK_PLANE_WIDTH)[:, :, 3]
 
     # -- pytree protocol ------------------------------------------------
     def tree_flatten(self):
@@ -146,6 +207,8 @@ class TetMesh:
             self.walk_table,
             self.stored_face_normals,
             self.stored_face_offsets,
+            self.walk_table_lo,
+            self.walk_table_hi,
         )
         return children, None
 
@@ -157,7 +220,7 @@ class TetMesh:
     @classmethod
     def from_arrays(
         cls, coords: np.ndarray, tet2vert: np.ndarray, dtype: Any = None,
-        force_unpacked: bool = False,
+        force_unpacked: bool = False, table_dtype: str = "float32",
     ) -> "TetMesh":
         """Build a mesh (host-side precompute) from raw connectivity.
 
@@ -165,7 +228,10 @@ class TetMesh:
         planes, face adjacency, and volumes. ``force_unpacked`` keeps
         the walk arrays separate (the layout meshes past the exact
         float-id limit fall back to) — for testing that path at small
-        sizes.
+        sizes. ``table_dtype="bfloat16"`` builds the two-tier walk
+        tables (bf16 select tier + working-dtype per-face refinement
+        tier) straight from the f64 intermediates instead of the packed
+        f32 row table.
         """
         if dtype is None:
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -208,7 +274,24 @@ class TetMesh:
         # stored in the float dtype; exact only below 2^(mantissa+1) —
         # past that the walk falls back to separate gathers.
         ne = tet2vert.shape[0]
-        if ne < _exact_id_limit(dtype) and not force_unpacked:
+        lo = hi = None
+        if table_dtype == "bfloat16":
+            # Two-tier tables from the f64 intermediates. The
+            # refinement tier carries the winning face's neighbor id in
+            # its float adj lane — same exactness ceiling as the packed
+            # layout, enforced rather than silently corrupted.
+            if ne >= _exact_id_limit(dtype):
+                raise ValueError(
+                    f"two-tier walk tables store neighbor ids in "
+                    f"{np.dtype(dtype).name} refinement rows; {ne} "
+                    f"elements exceed the exact-id limit "
+                    f"{_exact_id_limit(dtype)}"
+                )
+            walk_table = None
+            stored_n = stored_off = None
+            lo = pack_lo_table(np, n, offsets)
+            hi = pack_plane_table(np, n, offsets, face_adj, dtype)
+        elif ne < _exact_id_limit(dtype) and not force_unpacked:
             walk_table = jnp.asarray(
                 _pack_walk_table(np, n, offsets, face_adj), dtype=dtype
             )
@@ -226,6 +309,8 @@ class TetMesh:
             walk_table=walk_table,
             stored_face_normals=stored_n,
             stored_face_offsets=stored_off,
+            walk_table_lo=lo,
+            walk_table_hi=hi,
         )
 
     # -- queries ---------------------------------------------------------
@@ -246,8 +331,70 @@ class TetMesh:
         c = np.asarray(self.coords)
         return c.min(axis=0), c.max(axis=0)
 
+    def with_lowp_tables(self) -> "TetMesh":
+        """This mesh with the two-tier walk tables (bf16 select tier +
+        working-dtype refinement tier) in place of the packed f32 row
+        table. Idempotent. The tiers are built from the current
+        full-precision planes — when the mesh came from ``from_arrays``
+        those are the f64-derived values rounded once to the working
+        dtype, so a post-hoc conversion differs from a
+        ``table_dtype="bfloat16"`` build only below working-dtype
+        precision (invisible at bf16 granularity for the select tier).
+        """
+        if self.walk_table_lo is not None:
+            return self
+        dtype = self.volumes.dtype
+        if self.tet2vert.shape[0] >= _exact_id_limit(dtype):
+            raise ValueError(
+                "two-tier walk tables store neighbor ids in "
+                f"{jnp.dtype(dtype).name} refinement rows; "
+                f"{self.tet2vert.shape[0]} elements exceed the exact-id "
+                f"limit {_exact_id_limit(dtype)}"
+            )
+        # The planes need no f64 round-trip (bf16/working-dtype
+        # rounding of the stored values IS the conversion); the adj
+        # lane goes through f64 inside pack_plane_table so ids survive
+        # the cast, like the packed-row rebuild in astype().
+        fn = self.face_normals
+        fo = self.face_offsets
+        return TetMesh(
+            coords=self.coords,
+            tet2vert=self.tet2vert,
+            face_adj=self.face_adj,
+            volumes=self.volumes,
+            walk_table=None,
+            stored_face_normals=None,
+            stored_face_offsets=None,
+            walk_table_lo=pack_lo_table(jnp, fn, fo),
+            walk_table_hi=pack_plane_table(jnp, fn, fo, self.face_adj,
+                                           dtype),
+        )
+
     def astype(self, dtype: Any) -> "TetMesh":
         ne = self.tet2vert.shape[0]
+        if self.walk_table_lo is not None:
+            # Two-tier meshes stay two-tier: the select tier is already
+            # bf16 (re-rounding is the identity) and the refinement
+            # tier converts directly — its adj lane holds integers
+            # whose f32/f64 conversions are exact within the checked
+            # id limit.
+            if ne >= _exact_id_limit(dtype):
+                raise ValueError(
+                    f"cannot convert two-tier tables to "
+                    f"{jnp.dtype(dtype).name}: {ne} elements exceed "
+                    f"the exact-id limit {_exact_id_limit(dtype)}"
+                )
+            return TetMesh(
+                coords=self.coords.astype(dtype),
+                tet2vert=self.tet2vert,
+                face_adj=self.face_adj,
+                volumes=self.volumes.astype(dtype),
+                walk_table=None,
+                stored_face_normals=None,
+                stored_face_offsets=None,
+                walk_table_lo=self.walk_table_lo,
+                walk_table_hi=self.walk_table_hi.astype(dtype),
+            )
         # A mesh already in the unpacked layout stays unpacked: its ids
         # may exceed the new dtype's exact range too, and a
         # force_unpacked test mesh must not silently repack.

@@ -96,9 +96,22 @@ W_TILE_DEFAULT = 1024
 # v5p target with 2x the VMEM — so the ceiling applies to every chip
 # generation; _chip_vmem_ceiling provides only an env override.
 VMEM_FEASIBLE_MAX_ELEMS = 8192
+# PROJECTED ceiling for a bf16 SELECT-tier resident table (the
+# two-tier layout, docs/PERF_NOTES.md "Table precision tiers"): the
+# [Lp,16] bf16 operand is 32 B/elem vs the f32 [Lp,32]-padded 128 B —
+# at the binding w_tile=1024 the scoped stack is tile-driven (r5 law
+# above), so halved TABLE bytes should extend the feasible block
+# length ~2x. UNVERIFIED until the next chip window's AOT sweep
+# (tools/r6_onchip_suite.sh) — this kernel does not yet LOWER the
+# two-tier walk (bf16 lanes cannot hold adjacency ids, and a resident
+# f32 refinement operand would give back the saving), so the constant
+# exists for the armed experiment and the sub-split sizing math only;
+# engines route bf16 blocked walks through the gather kernel
+# (parallel/partition.py resolve_block_kernel).
+VMEM_FEASIBLE_MAX_ELEMS_BF16 = 2 * VMEM_FEASIBLE_MAX_ELEMS
 
 
-def _chip_vmem_ceiling() -> int:
+def _chip_vmem_ceiling(table_dtype: str = "float32") -> int:
     """The block-size ceiling actually in force.
 
     PUMIUMTALLY_VMEM_CEILING_ELEMS overrides outright (a new chip
@@ -111,16 +124,22 @@ def _chip_vmem_ceiling() -> int:
     physical VMEM is 2x v5e's) — so scaling the ceiling by physical
     per-core VMEM, as the first ADVICE-r4 fix did, was the wrong model.
     Operators raising the compiler's scoped limit
-    (--xla_tpu_scoped_vmem_limit_kib) can raise this via the env."""
+    (--xla_tpu_scoped_vmem_limit_kib) can raise this via the env.
+    A bf16 select-tier table gets the PROJECTED doubled default (see
+    VMEM_FEASIBLE_MAX_ELEMS_BF16) — the env override still wins."""
     import os
 
     env = os.environ.get("PUMIUMTALLY_VMEM_CEILING_ELEMS")
     if env:
         return int(env)
+    if table_dtype == "bfloat16":
+        return VMEM_FEASIBLE_MAX_ELEMS_BF16
     return VMEM_FEASIBLE_MAX_ELEMS
 
 
-def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
+def effective_vmem_bound(
+    bound: Optional[int], table_dtype: str = "float32"
+) -> Optional[int]:
     """The walk_vmem_max_elems value an engine may actually use:
     clamped to the scoped-VMEM ceiling (measured default or env
     override — _chip_vmem_ceiling) on compiled-TPU backends (a larger
@@ -128,13 +147,19 @@ def effective_vmem_bound(bound: Optional[int]) -> Optional[int]:
     in interpret mode. EVERY path that derives a partition from the
     knob must clamp through here — clamping after a partition is built
     leaves blocks the kernel cannot run (the sub-split constructor
-    then rejects the configuration)."""
+    then rejects the configuration).
+
+    ``table_dtype="bfloat16"`` applies the PROJECTED bf16 select-tier
+    ceiling (VMEM_FEASIBLE_MAX_ELEMS_BF16) — today that path never
+    reaches this kernel (engines reroute bf16 blocked walks to the
+    gather kernel), so the parameter arms the next chip window's AOT
+    sweep without a code change."""
     if bound is None:
         return None
     bound = int(bound)
     if backend_needs_interpret():
         return bound
-    ceiling = _chip_vmem_ceiling()
+    ceiling = _chip_vmem_ceiling(table_dtype)
     if bound > ceiling:
         from pumiumtally_tpu.utils.logging import get_logger
 

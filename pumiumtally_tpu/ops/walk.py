@@ -19,7 +19,13 @@ end. Per iteration, for every not-done particle:
   1. gather the packed walk row of its current tet — 4 face planes +
      4 neighbor ids in ONE contiguous [20]-float row (replaces PUMIPic's
      per-particle adjacency chase; packing measured ~2.6× faster than
-     three separate gathers on TPU),
+     three separate gathers on TPU). Under ``table_dtype="bfloat16"``
+     this splits into the two-tier form: a half-width bf16 SELECT row
+     picks the exit face and ONE full-precision refinement row of the
+     winning face commits the crossing + neighbor — 52 B of gather per
+     crossing instead of 80 (select-in-bf16 / commit-in-f32,
+     docs/DESIGN.md; cost model docs/PERF_NOTES.md "Table precision
+     tiers"),
   2. exit coordinate ``s_f`` over faces with ``n_f·d_remaining > tol``
      (same crossing predicate as the reference fork's search internals;
      semantics pinned by the oracles in BASELINE.md),
@@ -69,6 +75,8 @@ from pumiumtally_tpu.ops.bucketize import (
 from pumiumtally_tpu.mesh.tetmesh import (
     TetMesh,
     WALK_TABLE_ADJ,
+    WALK_TABLE_LO_NORMALS,
+    WALK_TABLE_LO_OFFSETS,
     WALK_TABLE_NORMALS,
     WALK_TABLE_OFFSETS,
 )
@@ -114,6 +122,37 @@ _PERM_MODES = ("arrays", "packed", "indirect", "sorted")
 
 # The mode "auto" resolves to when PUMIUMTALLY_WALK_PERM is unset.
 PERM_MODE_DEFAULT = "packed"
+
+# Walk-table precision tiers (docs/PERF_NOTES.md "Table precision
+# tiers"). "float32" is the packed single-tier table (the historical
+# layout; actually the mesh's working dtype — f64 under x64).
+# "bfloat16" is the two-tier form: a half-width bf16 SELECT row picks
+# the exit face, then ONE full-precision refinement gather of the
+# winning face's plane recomputes the crossing exactly before anything
+# commits — select-in-bf16 / commit-in-f32 (docs/DESIGN.md invariant).
+TABLE_DTYPES = ("float32", "bfloat16")
+TABLE_DTYPE_DEFAULT = "float32"
+
+
+def _resolve_table_dtype(dtype: str) -> str:
+    """Resolve "auto" via the PUMIUMTALLY_WALK_TABLE_DTYPE env var.
+
+    Mirrors ``_resolve_perm_mode``: TallyConfig.walk_kwargs() resolves
+    at CONFIG time so the tier lands in the engines' static jit keys
+    (an env flip recompiles instead of silently reusing the stale
+    tier); a direct walk() call with table_dtype="auto" resolves at
+    trace time instead.
+    """
+    if dtype == "auto":
+        dtype = os.environ.get(
+            "PUMIUMTALLY_WALK_TABLE_DTYPE", TABLE_DTYPE_DEFAULT
+        )
+    if dtype not in TABLE_DTYPES:
+        raise ValueError(
+            f"walk_table_dtype must be one of {TABLE_DTYPES} or 'auto', "
+            f"got {dtype!r}"
+        )
+    return dtype
 
 
 def _resolve_perm_mode(mode: str) -> str:
@@ -201,7 +240,98 @@ def _gather_walk_row(mesh: TetMesh, elem: jnp.ndarray):
     return mesh.face_normals[elem], mesh.face_offsets[elem], mesh.face_adj[elem]
 
 
-def _advance_geometry(mesh, s, elem, dest, d0, tol, one):
+def _resolve_lo_select(mesh, table_dtype: str) -> bool:
+    """Shared entry-point guard: resolve the tier and require the
+    two-tier tables when it is bf16 — ONE definition so walk() and the
+    walk_xpoints replay can never diverge in resolution rule or error
+    contract."""
+    lo_select = _resolve_table_dtype(table_dtype) == "bfloat16"
+    if lo_select and mesh.walk_table_lo is None:
+        raise ValueError(
+            "table_dtype='bfloat16' needs the two-tier walk tables — "
+            "build the mesh with table_dtype='bfloat16' or convert it "
+            "with TetMesh.with_lowp_tables()"
+        )
+    return lo_select
+
+
+def _lift_bf16(x, fdtype):
+    """bf16 → working dtype, EXACT, via the bit identity (bf16 is
+    truncated f32, so the upcast is a 16-bit left shift of the bit
+    pattern). Not a style choice: XLA:CPU lowers the native bf16
+    convert element-at-a-time — measured ~5× the cost of the whole
+    candidate einsum at bench shape, which sank the CPU A/B arm — while
+    the shift form vectorizes on every backend and computes the
+    identical function (pinned by the A/B's conservation equality)."""
+    u = lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32) << 16
+    f = lax.bitcast_convert_type(u, jnp.float32)
+    return f if jnp.dtype(fdtype) == jnp.float32 else f.astype(fdtype)
+
+
+def select_faces_lo(table_lo, s, elem, dest, d0, tol, one):
+    """bf16 SELECT tier: candidate crossings of all four faces from the
+    half-width bf16 row, returning the per-face candidate minimum and
+    the winning face index. Shared by the replicated walk and the
+    partitioned ``walk_local`` so the selection semantics cannot drift
+    between engines. The candidate values are computed in the walk's
+    working dtype FROM bf16-rounded planes — the only precision lost is
+    the one-time storage rounding, so two candidates must tie within
+    ~bf16 epsilon before a wrong face can win (docs/PERF_NOTES.md tie
+    analysis)."""
+    fdtype = s.dtype
+    row = _lift_bf16(
+        table_lo[elem], fdtype  # [N,WALK_TABLE_LO_WIDTH] — the 32 B gather
+    )
+    n = row.shape[0]
+    fn = row[:, WALK_TABLE_LO_NORMALS].reshape(n, 4, 3)
+    fo = row[:, WALK_TABLE_LO_OFFSETS]
+    both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
+    a = both[..., 0]  # n·d0 (bf16-rounded n)
+    b = fo - both[..., 1] + a  # off − n·x0
+    crossing = a * (one - s)[:, None] > tol
+    s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+    # Clamp-then-argmin, EXACTLY the f32 path's rule. A candidate whose
+    # bf16 value lands at-or-behind the current coordinate clamps to s
+    # and wins the argmin — in the common case that candidate is the
+    # true exit rounded behind, and the refinement recomputes its real
+    # forward crossing, so the walk stays correct. (A forward-first
+    # variant that demoted clamped candidates was tried and REVERTED:
+    # it broke exactly those rounded-behind true exits — 10× more
+    # hull-exit drift, 4% flux divergence. The cost of keeping the
+    # clamp is the rare wrong-corridor dead end documented in
+    # docs/PERF_NOTES.md: a genuinely-behind BOUNDARY face can absorb
+    # an exiting particle slightly inside the hull, at tie-class rate.)
+    s_f = jnp.maximum(s_f, s[:, None])
+    return jnp.min(s_f, axis=1), jnp.argmin(s_f, axis=1)
+
+
+def refine_face_hi(table_hi, s, elem, f_exit, s_sel, dest, d0, tol, one):
+    """Full-precision REFINEMENT tier: ONE [WALK_PLANE_WIDTH]-row
+    gather (20 B) of the WINNING face recomputes its crossing exactly —
+    so track lengths and committed positions carry working-dtype
+    accuracy — and yields that face's neighbor id from the row's adj
+    lane (exact within the checked id limit), so no separate adjacency
+    gather or take-along-axis runs per crossing. Returns
+    ``(s_exit, next_elem)``. A face the full-precision predicate no
+    longer recognizes as a forward crossing (only possible within
+    tolerance of parallel — the bf16 candidacy flipped it) keeps its
+    bf16 candidate value: that is exactly what a pure low-precision
+    walk would commit, and the max(s) clamp still forbids backward
+    steps."""
+    plane = table_hi[elem * 4 + f_exit]  # [N,WALK_PLANE_WIDTH]
+    nw = plane[:, 0:3]
+    aw = jnp.einsum("nc,nc->n", nw, d0)
+    bw = plane[:, 3] - jnp.einsum("nc,nc->n", nw, dest) + aw
+    genuine = aw * (one - s) > tol
+    s_ref = jnp.where(genuine, bw / jnp.where(genuine, aw, one), s_sel)
+    s_ref = jnp.maximum(s_ref, s)
+    # No bf16 candidate at all (s_sel = inf): destination inside the
+    # current tet — keep inf so the caller's reached test fires.
+    s_exit = jnp.where(jnp.isinf(s_sel), s_sel, s_ref)
+    return s_exit, plane[:, 4].astype(jnp.int32)
+
+
+def _advance_geometry(mesh, s, elem, dest, d0, tol, one, lo_select=False):
     """The per-step crossing geometry shared by ``walk`` and the
     ``walk_xpoints`` debug replay — ONE definition so the replay can
     never diverge from the transport it reconstructs.
@@ -212,19 +342,34 @@ def _advance_geometry(mesh, s, elem, dest, d0, tol, one):
     reference's per-step test exactly; the max(s) clamp keeps a
     committed point that sits epsilon-outside a face from stepping
     backwards. ``reached`` covers a destination inside the current tet
-    and the no-forward-crossing corner (zero-length segment)."""
-    fn, fo, adj = _gather_walk_row(mesh, elem)
-    both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
-    a = both[..., 0]  # n·d0
-    b = fo - both[..., 1] + a  # off − n·x0
-    crossing = a * (one - s)[:, None] > tol
-    s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
-    s_f = jnp.maximum(s_f, s[:, None])
-    s_exit = jnp.min(s_f, axis=1)
-    f_exit = jnp.argmin(s_f, axis=1)
+    and the no-forward-crossing corner (zero-length segment).
+
+    ``lo_select`` switches to the two-tier path: face selection from
+    the mesh's bf16 select tier, then ONE full-precision refinement row
+    of the winning face commits the crossing AND supplies its neighbor
+    id from the row's float adj lane (exact within the checked id
+    ceiling — ``face_adj`` is never gathered here). Select-in-bf16 /
+    commit-in-f32, docs/DESIGN.md."""
+    if lo_select:
+        s_sel, f_exit = select_faces_lo(
+            mesh.walk_table_lo, s, elem, dest, d0, tol, one
+        )
+        s_exit, next_elem = refine_face_hi(
+            mesh.walk_table_hi, s, elem, f_exit, s_sel, dest, d0, tol, one
+        )
+    else:
+        fn, fo, adj = _gather_walk_row(mesh, elem)
+        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0, dest], axis=-1))
+        a = both[..., 0]  # n·d0
+        b = fo - both[..., 1] + a  # off − n·x0
+        crossing = a * (one - s)[:, None] > tol
+        s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+        s_f = jnp.maximum(s_f, s[:, None])
+        s_exit = jnp.min(s_f, axis=1)
+        f_exit = jnp.argmin(s_f, axis=1)
+        next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
     reached = s_exit >= one
     s_new = jnp.where(reached, one, s_exit)
-    next_elem = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
     hit_boundary = (~reached) & (next_elem == -1)
     return s_new, reached, next_elem, hit_boundary
 
@@ -247,6 +392,7 @@ def walk(
     window_factor: int = WINDOW_FACTOR_DEFAULT,
     perm_mode: str = "auto",
     partition_method: str = "rank",
+    table_dtype: str = "auto",
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -289,7 +435,17 @@ def walk(
     produce the IDENTICAL permutation, so results are bitwise equal;
     the knob exists for parity tests and on-chip A/B
     (tools/exp_partition_ab.py).
+
+    ``table_dtype`` selects the walk-table precision tier
+    (``TABLE_DTYPES``): "float32" gathers the packed single-tier row;
+    "bfloat16" selects the exit face from the mesh's bf16 tier and
+    refines only the winning face at full precision (NOT bitwise vs
+    the f32 tier — wrong-face selection on sub-bf16-epsilon ties is
+    the documented benign divergence; conservation is preserved by the
+    s-telescoping tally). "auto" resolves via
+    ``PUMIUMTALLY_WALK_TABLE_DTYPE`` (default "float32").
     """
+    lo_select = _resolve_lo_select(mesh, table_dtype)
     fdtype = x.dtype
     n_total = x.shape[0]
     one = jnp.asarray(1.0, fdtype)
@@ -313,7 +469,7 @@ def walk(
         to scatter (per iteration, or fused across an unrolled group)."""
         active = ~done
         s_new, reached, next_elem, hit_boundary = _advance_geometry(
-            mesh, s, elem, dest, d0, tol, one
+            mesh, s, elem, dest, d0, tol, one, lo_select
         )
 
         if tally:
@@ -523,6 +679,7 @@ def walk_xpoints(
     *,
     tol: float,
     max_iters: int,
+    table_dtype: str = "auto",
 ) -> jnp.ndarray:
     """Replay a transport and return each particle's LAST
     face-intersection point — the reference's white-box debug surface
@@ -537,6 +694,9 @@ def walk_xpoints(
     the production walk's s-parametrization deliberately discards the
     per-crossing positions this reconstructs.
     """
+    # The replay must run the SAME tier as the transport it
+    # reconstructs (shared resolution + missing-tables guard).
+    lo_select = _resolve_lo_select(mesh, table_dtype)
     fdtype = x.dtype
     one = jnp.asarray(1.0, fdtype)
     is_flying = in_flight[:, None] == 1
@@ -553,7 +713,7 @@ def walk_xpoints(
         it, s, elem, done, s_cross = state
         active = ~done
         s_new, reached, next_elem, hit_boundary = _advance_geometry(
-            mesh, s, elem, dest, d0, tol, one
+            mesh, s, elem, dest, d0, tol, one, lo_select
         )
         # A face was intersected this step (interior crossing OR the
         # boundary exit) -> record its location's ray coordinate.

@@ -61,7 +61,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from pumiumtally_tpu.mesh.tetmesh import (
     TetMesh,
+    WALK_PLANE_WIDTH,
     WALK_TABLE_ADJ,
+    WALK_TABLE_LO_WIDTH,
     WALK_TABLE_NORMALS,
     WALK_TABLE_OFFSETS,
 )
@@ -76,6 +78,8 @@ from pumiumtally_tpu.ops.walk import (
     _MIN_WINDOW,
     COND_EVERY_DEFAULT,
     fused_tally_body,
+    refine_face_hi,
+    select_faces_lo,
 )
 from pumiumtally_tpu.parallel.sharded import _axis_name, shard_map_check_kwargs
 from pumiumtally_tpu.utils.profiling import register_entry_point
@@ -127,13 +131,23 @@ class MeshPartition:
     owner: np.ndarray  # [E] original elem -> chip
     glid_of_orig: Any  # [E] int32, original elem -> padded global id
     orig_of_glid: Any  # [ndev*L] int32, padded global id -> orig elem (-1 pad)
-    table: Any  # [ndev*L, 20] local walk rows (adj local-encoded)
+    # The SELECT-tier walk rows the per-crossing gather touches:
+    # [ndev*L, 20] float packed rows (adj local-encoded) in the
+    # single-tier layout, or [ndev*L, WALK_TABLE_LO_WIDTH] bf16 plane
+    # rows when the partition was built two-tier (table_hi non-None;
+    # adjacency then rides the refinement rows' adj lane).
+    table: Any
     # Non-None when the padded id range exceeds what the float dtype
     # represents exactly (f32 past 2^24): adjacency then lives in its
     # own int32 array and the table's adj lanes are unused. Costs the
     # walk a second (4-int) gather per iteration but removes the mesh
     # size ceiling — a ~2M-tet f32 mesh on 8 chips builds fine.
     adj_int: Any = None  # [ndev*L, 4] int32 local-encoded adjacency
+    # Two-tier refinement tier (docs/PERF_NOTES.md "Table precision
+    # tiers"): full-precision per-face (plane, local-encoded adj) rows,
+    # row glid*4 + f, gathered ONCE per crossing for the winning face
+    # only.
+    table_hi: Any = None  # [ndev*L*4, WALK_PLANE_WIDTH]
 
     def flux_to_original(self, flux_padded: jnp.ndarray) -> jnp.ndarray:
         """Reorder an owned [ndev*L] flux into original element order."""
@@ -154,17 +168,54 @@ def derive_blocks_per_chip(
     )
 
 
+def resolve_block_kernel(block_kernel: str, table_dtype: str) -> str:
+    """The block kernel a two-tier partition actually runs: the vmem
+    one-hot kernel has no two-tier lowering yet (bf16 adjacency lanes
+    are impossible — 8 mantissa bits — and a resident f32 refinement
+    operand would give back the VMEM the select tier saved; see
+    ops/vmem_walk.py), so bf16 partitions route blocked walks through
+    the GATHER block kernel, whose resident-block benefit is exactly
+    what the half-width select tier doubles."""
+    if table_dtype == "bfloat16" and block_kernel == "vmem":
+        return "gather"
+    return block_kernel
+
+
+def block_elems_bound(
+    vmem_walk_max_elems: Optional[int], table_dtype: str
+) -> Optional[int]:
+    """The per-block ELEMENT bound the sub-split derives blocks from.
+    The knob is calibrated in f32-table resident bytes (80 B/elem); the
+    bf16 select tier is 32 B/elem, so the same byte budget covers 2x
+    the elements — block tables at 2x L, halving block count and with
+    it the migration-round pressure (the lattice's 45-round problem,
+    docs/PERF_NOTES.md)."""
+    if vmem_walk_max_elems is None:
+        return None
+    if table_dtype == "bfloat16":
+        return int(vmem_walk_max_elems) * 2
+    return int(vmem_walk_max_elems)
+
+
 def build_partition(
     mesh: TetMesh,
     ndev: int,
     dtype: Optional[Any] = None,
     force_split_adj: bool = False,
+    table_dtype: str = "float32",
 ) -> MeshPartition:
     """Partition ``mesh`` into ``ndev`` contiguous padded element blocks.
 
     ``force_split_adj`` stores adjacency as int32 out-of-row even when
     the float dtype could hold it exactly (the automatic fallback for
-    big f32 meshes, forced for testing).
+    big f32 meshes, forced for testing). ``table_dtype="bfloat16"``
+    builds the two-tier per-chip tables: ``table`` becomes the bf16
+    select tier and ``table_hi`` the full-precision per-face
+    refinement tier, whose adj lane carries the local-encoded neighbor
+    (one 20 B gather serves refinement AND adjacency) — ids must
+    therefore fit the float dtype exactly, the SAME ceiling as the
+    packed in-row encoding; past it the two-tier build refuses (use
+    the f32 layout, whose int32 sidecar has no ceiling).
     """
     if dtype is None:
         dtype = mesh.coords.dtype
@@ -182,9 +233,25 @@ def build_partition(
     # Remote faces encode -(glid+2) with glid < ndev*L, so THAT is the
     # magnitude that must survive a float walk-table round-trip; past
     # the exact-id limit adjacency moves to a separate int32 array.
-    split_adj = force_split_adj or (
-        ndev * L + 2 >= 2 ** (np.finfo(np.dtype(dtype)).nmant + 1)
+    two_tier = table_dtype == "bfloat16"
+    if two_tier and force_split_adj:
+        raise ValueError(
+            "force_split_adj is incompatible with table_dtype="
+            "'bfloat16': two-tier partitions carry adjacency in the "
+            "refinement rows' float lane, never in an int32 sidecar"
+        )
+    ids_fit = (
+        ndev * L + 2 < 2 ** (np.finfo(np.dtype(dtype)).nmant + 1)
     )
+    if two_tier and not ids_fit:
+        raise ValueError(
+            f"two-tier partition tables store local-encoded neighbor "
+            f"ids in {np.dtype(dtype).name} refinement rows; "
+            f"{ndev}x{L} padded elements exceed the exact-id range "
+            "(use walk_table_dtype='float32', whose int32 adjacency "
+            "sidecar has no ceiling)"
+        )
+    split_adj = force_split_adj or not ids_fit
 
     # Renumber: elements of chip d occupy glids [d*L, d*L+counts[d]).
     order = np.argsort(owner, kind="stable")  # orig elems grouped by owner
@@ -209,16 +276,36 @@ def build_partition(
 
     # Padded per-chip walk table; padding rows have no crossing faces
     # (zero normals -> t_exit=inf -> 'reached') and are never entered.
-    table = np.zeros((ndev * L, 20), dtype=np.float64)
-    table[glid_of_orig, WALK_TABLE_NORMALS] = normals.reshape(ne, 12)
-    table[glid_of_orig, WALK_TABLE_OFFSETS] = offsets
     adj_full = np.full((ndev * L, 4), -1.0)
     adj_full[glid_of_orig] = local_adj
     adj_int = None
-    if split_adj:
-        adj_int = jnp.asarray(adj_full.astype(np.int32))
+    table_hi = None
+    if two_tier:
+        # Select tier: the half-width bf16 plane rows (32 B vs 80 B
+        # per crossing gather). Refinement tier: per-FACE full-
+        # precision planes + the face's local-encoded neighbor, row
+        # glid*4 + f — padding rows keep adj −1 (boundary), though the
+        # walk never enters them (zero normals ⇒ no crossing).
+        lo = np.zeros((ndev * L, WALK_TABLE_LO_WIDTH), dtype=np.float64)
+        lo[glid_of_orig, 0:12] = normals.reshape(ne, 12)
+        lo[glid_of_orig, 12:16] = offsets
+        hi = np.zeros((ndev * L, 4, WALK_PLANE_WIDTH), dtype=np.float64)
+        hi[:, :, 4] = adj_full
+        hi[glid_of_orig, :, 0:3] = normals
+        hi[glid_of_orig, :, 3] = offsets
+        table = jnp.asarray(lo, dtype=jnp.bfloat16)
+        table_hi = jnp.asarray(
+            hi.reshape(ndev * L * 4, WALK_PLANE_WIDTH), dtype=dtype
+        )
     else:
-        table[:, WALK_TABLE_ADJ] = adj_full
+        table_np = np.zeros((ndev * L, 20), dtype=np.float64)
+        table_np[glid_of_orig, WALK_TABLE_NORMALS] = normals.reshape(ne, 12)
+        table_np[glid_of_orig, WALK_TABLE_OFFSETS] = offsets
+        if split_adj:
+            adj_int = jnp.asarray(adj_full.astype(np.int32))
+        else:
+            table_np[:, WALK_TABLE_ADJ] = adj_full
+        table = jnp.asarray(table_np, dtype=dtype)
 
     return MeshPartition(
         ndev=ndev,
@@ -227,8 +314,9 @@ def build_partition(
         owner=owner,
         glid_of_orig=jnp.asarray(glid_of_orig, jnp.int32),
         orig_of_glid=jnp.asarray(orig_of_glid),
-        table=jnp.asarray(table, dtype=dtype),
+        table=table,
         adj_int=adj_int,
+        table_hi=table_hi,
     )
 
 
@@ -255,10 +343,20 @@ def walk_local(
     compact: bool = True,
     min_window: int = _MIN_WINDOW,
     partition_method: str = "rank",
+    table_hi: Optional[jnp.ndarray] = None,  # [L*4,5] two-tier refinement
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
     chip. Returns (x, lelem, done, exited, pending, flux, iters).
+
+    ``table_hi`` switches to the two-tier path (docs/PERF_NOTES.md
+    "Table precision tiers"): ``table`` is then the bf16 SELECT tier
+    ([L,WALK_TABLE_LO_WIDTH] plane rows), the exit face is picked from
+    it, and the winning face's crossing AND local-encoded neighbor
+    come from ONE full-precision ``table_hi`` row before committing —
+    the same select-in-bf16 / commit-in-f32 contract as the replicated
+    walk (shared helpers ops/walk.py select_faces_lo / refine_face_hi).
+    ``adj_int`` is then unused (the refinement row carries adjacency).
 
     Parametrized by the ray coordinate ``s`` along this ROUND's fixed
     segment ``x → dest`` (see ops/walk.py): both face projections are
@@ -316,25 +414,43 @@ def walk_local(
 
     def advance(s, lelem, done, exited, pending, x0_c, d0_c, eff_c):
         active = ~done & (pending < 0)
-        row = table[lelem]
-        n = row.shape[0]
-        fn = row[:, WALK_TABLE_NORMALS].reshape(n, 4, 3)
-        fo = row[:, WALK_TABLE_OFFSETS]
-        if adj_int is not None:
-            adj = adj_int[lelem]
+        if table_hi is not None:
+            # Two-tier: bf16 select + full-precision single-face refine
+            # (helpers shared with ops/walk.py so the selection
+            # semantics cannot drift between engines; they take the
+            # dest-based projection, so rebuild dest from the carried
+            # ray invariants). The refinement row also carries the
+            # winning face's local-encoded neighbor — no adjacency
+            # gather, no take-along-axis.
+            dest_c = x0_c + d0_c
+            s_sel, f_exit = select_faces_lo(
+                table, s, lelem, dest_c, d0_c, tol, one
+            )
+            s_exit, nxt = refine_face_hi(
+                table_hi, s, lelem, f_exit, s_sel, dest_c, d0_c, tol, one
+            )
         else:
-            adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
-        both = jnp.einsum("nfc,nck->nfk", fn, jnp.stack([d0_c, x0_c], axis=-1))
-        a = both[..., 0]
-        b = fo - both[..., 1]
-        crossing = a * (one - s)[:, None] > tol
-        s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
-        s_f = jnp.maximum(s_f, s[:, None])
-        s_exit = jnp.min(s_f, axis=1)
-        f_exit = jnp.argmin(s_f, axis=1)
+            row = table[lelem]
+            n = row.shape[0]
+            fn = row[:, WALK_TABLE_NORMALS].reshape(n, 4, 3)
+            fo = row[:, WALK_TABLE_OFFSETS]
+            if adj_int is not None:
+                adj = adj_int[lelem]
+            else:
+                adj = row[:, WALK_TABLE_ADJ].astype(jnp.int32)
+            both = jnp.einsum(
+                "nfc,nck->nfk", fn, jnp.stack([d0_c, x0_c], axis=-1)
+            )
+            a = both[..., 0]
+            b = fo - both[..., 1]
+            crossing = a * (one - s)[:, None] > tol
+            s_f = jnp.where(crossing, b / jnp.where(crossing, a, one), jnp.inf)
+            s_f = jnp.maximum(s_f, s[:, None])
+            s_exit = jnp.min(s_f, axis=1)
+            f_exit = jnp.argmin(s_f, axis=1)
+            nxt = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
         reached = s_exit >= one
         s_new = jnp.where(reached, one, s_exit)
-        nxt = jnp.take_along_axis(adj, f_exit[:, None], axis=1)[:, 0]
         hit_boundary = (~reached) & (nxt == -1)
         goes_remote = (~reached) & (nxt <= -2)
 
@@ -627,6 +743,22 @@ def _locate_chunk(
     )
 
 
+def _locate_chunk_hi(
+    table_hi: jnp.ndarray,  # [L*4,5] refinement-tier (plane, adj) rows
+    valid: jnp.ndarray,
+    pts: jnp.ndarray,
+    tol: float,
+) -> jnp.ndarray:
+    """Two-tier variant of ``_locate_chunk``: point location reads the
+    FULL-PRECISION refinement tier (bf16 planes would misplace points
+    near faces), whose per-face row layout is exactly what the
+    half-space test wants."""
+    L = table_hi.shape[0] // 4
+    return locate_chunk_by_planes(
+        table_hi[:, 0:3], table_hi[:, 3].reshape(L, 4), valid, pts, tol,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Round-driving engine
 # ---------------------------------------------------------------------------
@@ -658,6 +790,7 @@ class PartitionedEngine:
         vmem_walk_max_elems: Optional[int] = None,
         block_kernel: str = "vmem",
         partition_method: str = "rank",
+        table_dtype: str = "float32",
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -702,6 +835,15 @@ class PartitionedEngine:
                 f"partition_method must be one of {PARTITION_METHODS}, "
                 f"got {partition_method!r}"
             )
+        # A prebuilt partition fixes the precision tier regardless of
+        # the knob (the tables ARE the tier); the vmem kernel has no
+        # two-tier lowering, so bf16 reroutes blocked walks to gather.
+        if part is not None:
+            table_dtype = (
+                "bfloat16" if part.table_hi is not None else "float32"
+            )
+        self.table_dtype = table_dtype
+        block_kernel = resolve_block_kernel(block_kernel, table_dtype)
         self.block_kernel = block_kernel
         self.partition_method = partition_method
         if block_kernel == "vmem":
@@ -713,15 +855,19 @@ class PartitionedEngine:
             nparts = self.part.ndev  # build_partition's part count
         else:
             nparts = self.ndev * derive_blocks_per_chip(
-                mesh.nelems, self.ndev, vmem_walk_max_elems
+                mesh.nelems, self.ndev,
+                block_elems_bound(vmem_walk_max_elems, table_dtype),
             )
-            self.part = build_partition(mesh, nparts)
+            self.part = build_partition(
+                mesh, nparts, table_dtype=table_dtype
+            )
         if nparts % self.ndev:
             raise ValueError(
                 f"partition has {nparts} parts, not a multiple of the "
                 f"{self.ndev}-device mesh"
             )
         self.nparts = nparts
+        self.two_tier = self.part.table_hi is not None
         self.blocks_per_chip = nparts // self.ndev
         cap_b = int(-(-self.n // nparts) * capacity_factor + 1)
         if self.blocks_per_chip > 1 and block_kernel == "vmem":
@@ -743,10 +889,11 @@ class PartitionedEngine:
         self.cond_every = int(cond_every)
         self.min_window = int(min_window)
         self.use_vmem_walk = (
-            block_kernel == "vmem"
+            block_kernel == "vmem"  # bf16 tiers never resolve to vmem
             and vmem_walk_max_elems is not None
             and self.part.L <= int(vmem_walk_max_elems)
             and self.part.adj_int is None
+            and not self.two_tier
         )
         if self.blocks_per_chip > 1 and not self.use_vmem_walk and (
             block_kernel != "gather"
@@ -822,6 +969,9 @@ class PartitionedEngine:
         sentinel = jnp.asarray(self.nparts * self.part.L, jnp.int32)
         tol = self.tol
         C = self._locate_chunk_size
+        # Two-tier partitions locate against the full-precision
+        # refinement tier (the operand _locate_points passes).
+        chunk_fn = _locate_chunk_hi if self.two_tier else _locate_chunk
 
         @jax.jit
         @partial(
@@ -833,7 +983,7 @@ class PartitionedEngine:
         )
         def locate(table, valid, pts):
             le = lax.map(
-                lambda p: _locate_chunk(table, valid, p, tol),
+                lambda p: chunk_fn(table, valid, p, tol),
                 pts.reshape(-1, C, 3),
             ).reshape(-1)
             d = lax.axis_index(ax).astype(jnp.int32)
@@ -869,7 +1019,8 @@ class PartitionedEngine:
             pts = jnp.concatenate(
                 [pts, jnp.full((m - self.n, 3), 2e30, pts_n.dtype)]
             )
-        return locate(self.part.table, self._valid, pts)[: self.n]
+        tbl = self.part.table_hi if self.two_tier else self.part.table
+        return locate(tbl, self._valid, pts)[: self.n]
 
     def localize(
         self, dest_n: jnp.ndarray, defer_sync: bool = False
@@ -1000,15 +1151,15 @@ class PartitionedEngine:
         min_window = self.min_window
         has_adj = self.part.adj_int is not None
         pmethod = self.partition_method
+        two_tier = self.two_tier
 
         use_vmem = self.use_vmem_walk
 
         def round_kernel(table, *rest):
-            if has_adj:
-                adj, x, lelem, dest, fly, w, done, exited, flux = rest
-            else:
-                adj = None
-                x, lelem, dest, fly, w, done, exited, flux = rest
+            rest = list(rest)
+            adj = rest.pop(0) if has_adj else None
+            hi = rest.pop(0) if two_tier else None
+            x, lelem, dest, fly, w, done, exited, flux = rest
             if use_vmem:
                 from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
 
@@ -1065,6 +1216,12 @@ class PartitionedEngine:
                         lax.dynamic_slice(adj, (eo, z), (part_L, 4))
                         if has_adj else None
                     )
+                    hi_b = (
+                        lax.dynamic_slice(
+                            hi, (eo * 4, z), (part_L * 4, WALK_PLANE_WIDTH)
+                        )
+                        if two_tier else None
+                    )
                     xb, leb, dnb, exb, pb, fxb, _ = walk_local(
                         lax.dynamic_slice(
                             table, (eo, z), (part_L, twidth)
@@ -1080,6 +1237,7 @@ class PartitionedEngine:
                         tally=tally, tol=tol, max_iters=max_iters,
                         adj_int=a_b, cond_every=cond_every,
                         min_window=min_window, partition_method=pmethod,
+                        table_hi=hi_b,
                     )
                     return (
                         t + 1,
@@ -1102,7 +1260,7 @@ class PartitionedEngine:
                     table, x, lelem, dest, fly, w, done, exited, flux,
                     tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
                     cond_every=cond_every, min_window=min_window,
-                    partition_method=pmethod,
+                    partition_method=pmethod, table_hi=hi,
                 )
                 # One whole-partition walk per chip per round.
                 n_disp = jnp.sum(jnp.zeros_like(lelem)) + 1
@@ -1117,7 +1275,7 @@ class PartitionedEngine:
             return (x, lelem, done, exited, pending, flux, n_pending,
                     n_not_done, n_disp)
 
-        n_in = 10 if has_adj else 9
+        n_in = 9 + int(has_adj) + int(two_tier)
         # Output-type checking (check_vma on current jax, check_rep on
         # jax 0.4.x — shard_map_check_kwargs resolves the spelling) is
         # disabled ONLY for the vmem-kernel variant: the pallas
@@ -1136,7 +1294,7 @@ class PartitionedEngine:
         )
 
         @jax.jit
-        def phase(table, adj, state, flux):
+        def phase(table, adj, hi, state, flux):
             st = dict(state)
             st["done"] = ~st["alive"] | (st["fly"] == 0)
             # Per-walk flag, like the single-chip engine's fresh
@@ -1150,9 +1308,14 @@ class PartitionedEngine:
             )
 
             def call_round(st, fx):
-                args = (table,) + ((adj,) if has_adj else ()) + (
-                    st["x"], st["lelem"], st["dest"], st["fly"], st["w"],
-                    st["done"], st["exited"], fx,
+                args = (
+                    (table,)
+                    + ((adj,) if has_adj else ())
+                    + ((hi,) if two_tier else ())
+                    + (
+                        st["x"], st["lelem"], st["dest"], st["fly"],
+                        st["w"], st["done"], st["exited"], fx,
+                    )
                 )
                 (x, lelem, done, exited, pending, fx, n_p, n_nd,
                  n_disp) = round_sm(*args)
@@ -1220,7 +1383,8 @@ class PartitionedEngine:
         the raise abandons the run."""
         phase = self._phase_program(tally)
         st, fx, found_all, ovf, rounds, disp = phase(
-            self.part.table, self.part.adj_int, self.state, self.flux_padded
+            self.part.table, self.part.adj_int, self.part.table_hi,
+            self.state, self.flux_padded,
         )
         # Lazy device scalars; fetched only if someone reads the
         # last_walk_rounds / last_block_dispatches diagnostics (a fetch

@@ -73,7 +73,25 @@ DEFAULT_CANDIDATES: Tuple[dict, ...] = (
     {"walk_perm_mode": "sorted", "walk_cond_every": 4},
     {"walk_perm_mode": "packed", "walk_cond_every": 4,
      "walk_partition_method": "argsort"},
+    # Table-precision axis (two-tier bf16 select + f32 refine,
+    # docs/PERF_NOTES.md "Table precision tiers"): measured in every
+    # sweep so the chip window records the byte-halving's real rate,
+    # but adopted as the winner only under allow_approximate=True —
+    # the tier is NOT bitwise vs f32 (benign tie-class divergence),
+    # and autotune's default contract is that tuning never changes
+    # physics.
+    {"walk_table_dtype": "bfloat16", "walk_cond_every": 4},
 )
+
+# Knobs that change results beyond bitwise/scatter-order equivalence;
+# adopting one as the tuned winner needs the caller's explicit opt-in.
+_APPROXIMATE_KNOBS = ("walk_table_dtype",)
+
+
+def _is_approximate(knobs: dict) -> bool:
+    return any(
+        knobs.get(k) not in (None, "float32") for k in _APPROXIMATE_KNOBS
+    )
 
 
 def _workload(mesh, n: int, moves: int, mean_step: float, seed: int):
@@ -101,15 +119,20 @@ def autotune_walk(
     base: Optional[TallyConfig] = None,
     seed: int = 0,
     verbose: bool = False,
+    allow_approximate: bool = False,
 ) -> Tuple[TallyConfig, List[dict]]:
     """Measure each candidate's continue-mode walk rate on the current
     backend; return (best TallyConfig, full report).
 
     ``mesh`` is a ``TetMesh`` (or anything ``build_box`` etc. return).
     The report is a list of ``{"knobs", "moves_per_sec"}`` dicts sorted
-    fastest-first; entry 0 produced the returned config. The sweep uses
-    the raw kernel (``ops.walk.walk``) — no facade/staging noise — with
-    one warmup (compile) move per candidate and ``moves`` timed moves.
+    fastest-first; the fastest ADOPTABLE entry produced the returned
+    config: approximate-tier candidates (walk_table_dtype="bfloat16")
+    are always measured and reported, but only adopted when
+    ``allow_approximate=True`` — otherwise the returned config keeps
+    the never-changes-physics contract. The sweep uses the raw kernel
+    (``ops.walk.walk``) — no facade/staging noise — with one warmup
+    (compile) move per candidate and ``moves`` timed moves.
     """
     import jax
     import jax.numpy as jnp
@@ -137,19 +160,26 @@ def autotune_walk(
     w = jnp.ones((n_particles,), mesh.coords.dtype)
 
     report = []
+    mesh_lo = None  # built once, only if a bf16-tier candidate runs
     for knobs in cands:
         cfg = dataclasses.replace(base, **knobs)
         kw = dict(cfg.walk_kwargs())
+        if kw.get("table_dtype") == "bfloat16":
+            if mesh_lo is None:
+                mesh_lo = mesh.with_lowp_tables()
+            m_c = mesh_lo
+        else:
+            m_c = mesh
         g = jax.jit(partial(
             walk, tally=True, tol=tol, max_iters=max_iters, **kw
         ))
         flux0 = jnp.zeros((mesh.nelems,), mesh.coords.dtype)
-        r = g(mesh, x0, e0, pts[1], fly, w, flux0)  # warmup/compile
+        r = g(m_c, x0, e0, pts[1], fly, w, flux0)  # warmup/compile
         float(jnp.sum(r.flux))  # sync (block_until_ready is lazy on
         x, e, flux = r.x, r.elem, r.flux  # some remote backends)
         t0 = time.perf_counter()
         for m in range(2, moves + 2):
-            r = g(mesh, x, e, pts[m], fly, w, flux)
+            r = g(m_c, x, e, pts[m], fly, w, flux)
             x, e, flux = r.x, r.elem, r.flux
         float(jnp.sum(flux))
         rate = n_particles * moves / (time.perf_counter() - t0)
@@ -158,7 +188,21 @@ def autotune_walk(
             print(f"autotune: {knobs} -> {rate / 1e6:.3f}M moves/s")
 
     report.sort(key=lambda r: -r["moves_per_sec"])
-    best = dataclasses.replace(base, **_drop_defaults(report[0]["knobs"]))
+    adoptable = [
+        r for r in report
+        if allow_approximate or not _is_approximate(r["knobs"])
+    ]
+    if not adoptable:
+        # Every candidate was approximate and adoption is disallowed:
+        # the sweep's rates are still in the report, but the returned
+        # config stays the (physics-identical) base.
+        return dataclasses.replace(base), report
+    # Mark which report entry produced the returned config — with
+    # approximate candidates in the sweep, report[0] may NOT be the
+    # adopted winner, and provenance printers must pair the adopted
+    # settings with the adopted entry's rate, not the sweep-fastest's.
+    adoptable[0]["adopted"] = True
+    best = dataclasses.replace(base, **_drop_defaults(adoptable[0]["knobs"]))
     return best, report
 
 
@@ -187,4 +231,6 @@ def _drop_defaults(knobs: dict) -> dict:
         "auto"
     ):
         out.pop("walk_perm_mode")
+    if out.get("walk_table_dtype") == "float32":
+        out.pop("walk_table_dtype")
     return out

@@ -1,0 +1,419 @@
+"""Two-tier walk tables: oracle parity, conservation, knob plumbing.
+
+The bf16 select tier + full-precision refinement tier
+(docs/PERF_NOTES.md "Table precision tiers", docs/DESIGN.md
+select-in-bf16/commit-in-f32 invariant) is NOT bitwise vs the f32
+tier: wrong-face selection on sub-bf16-epsilon crossing ties commits
+the adjacent neighbor — the documented benign divergence class. What
+IS pinned here:
+
+- the BASELINE.md flux oracles reproduce at the reference tolerance
+  (the oracle rays cross well-separated faces, so selection is
+  unambiguous and the refined commit is full-precision-exact);
+- conservation holds at the engines' gate on random workloads, for
+  the monolithic, partitioned, and gather-blocked engines;
+- the per-element flux divergence vs the f32 arm stays in the
+  tie-class band (small L1, not a systematic bias);
+- the walk_table_dtype knob resolves at CONFIG time into the static
+  jit key (env flip => recompile), mirroring the walk_perm_mode
+  plumbing tests;
+- the tier build itself: layout, derived properties, astype, and the
+  partition's 2x block-element bound.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+
+NUM = 5
+TOL = 1e-8  # reference comparison tolerance (oracle suite)
+
+
+def _flat(points):
+    return np.ascontiguousarray(
+        np.asarray(points, dtype=np.float64).reshape(-1)
+    )
+
+
+def _bf16_cfg(**kw):
+    return TallyConfig(walk_table_dtype="bfloat16", **kw)
+
+
+def _random_workload(mesh, n, seed=0):
+    lo, hi = mesh.bounding_box()
+    rng = np.random.default_rng(seed)
+    span = hi - lo
+    src = lo + rng.uniform(0.05, 0.95, (n, 3)) * span
+    dst = lo + rng.uniform(0.05, 0.95, (n, 3)) * span
+    return src, dst
+
+
+def _run_one_move(cls_or_factory, mesh, n, cfg, src, dst):
+    t = cls_or_factory(mesh, n, cfg)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    return np.asarray(t.flux, np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Tier build (mesh layer)
+# ---------------------------------------------------------------------------
+
+def test_lowp_table_build_and_views():
+    """with_lowp_tables: layout constants hold, the derived
+    face_normals/face_offsets keep FULL precision (they come from the
+    refinement tier), the select tier is the bf16 rounding of them,
+    and the packed row table is dropped (the tiers replace it)."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.mesh.tetmesh import (
+        WALK_PLANE_WIDTH,
+        WALK_TABLE_LO_WIDTH,
+    )
+
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    two = mesh.with_lowp_tables()
+    ne = mesh.nelems
+    assert two.walk_table is None
+    assert two.walk_table_lo.shape == (ne, WALK_TABLE_LO_WIDTH)
+    assert two.walk_table_lo.dtype == jnp.bfloat16
+    assert two.walk_table_hi.shape == (ne * 4, WALK_PLANE_WIDTH)
+    assert two.walk_table_hi.dtype == mesh.coords.dtype
+    # Full-precision planes survive the conversion bit-for-bit.
+    np.testing.assert_array_equal(
+        np.asarray(two.face_normals), np.asarray(mesh.face_normals)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(two.face_offsets), np.asarray(mesh.face_offsets)
+    )
+    # Select tier == bf16 rounding of the same planes.
+    np.testing.assert_array_equal(
+        np.asarray(two.walk_table_lo[:, 0:12], np.float64),
+        np.asarray(
+            mesh.face_normals.reshape(ne, 12).astype(jnp.bfloat16),
+            np.float64,
+        ),
+    )
+    # Idempotent; astype round-trips stay two-tier.
+    assert two.with_lowp_tables() is two
+    f32 = two.astype(np.float32)
+    assert f32.walk_table_lo is not None and f32.walk_table is None
+    assert f32.walk_table_hi.dtype == jnp.float32
+    # from_arrays builds the tiers directly too.
+    from pumiumtally_tpu.mesh.box import box_arrays
+    from pumiumtally_tpu.mesh.tetmesh import TetMesh
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    direct = TetMesh.from_arrays(coords, tets, table_dtype="bfloat16")
+    assert direct.walk_table is None and direct.walk_table_lo is not None
+    np.testing.assert_array_equal(
+        np.asarray(direct.walk_table_lo, np.float64),
+        np.asarray(two.walk_table_lo, np.float64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Oracle parity (BASELINE.md values) + conservation gates
+# ---------------------------------------------------------------------------
+
+def test_two_tier_oracle_sequence():
+    """The reference's exact-arithmetic flux oracles under the bf16
+    tier, at the ORACLE tolerance: the oracle rays cross well-
+    separated faces (no bf16-epsilon ties), so the refined commit
+    reproduces the full-precision values exactly — this is the
+    documented numerical contract, not luck."""
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    t = PumiTally(mesh, NUM, _bf16_cfg())
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    t.CopyInitialPosition(_flat(init), 3 * NUM)
+    np.testing.assert_array_equal(t.elem_ids, np.full(NUM, 2))
+    np.testing.assert_allclose(np.asarray(t.flux), 0.0, atol=TOL)
+
+    dests = np.tile([1.2, 0.4, 0.5], (NUM, 1))
+    t.MoveToNextLocation(_flat(init), _flat(dests),
+                         np.ones(NUM, np.int8), np.ones(NUM), 3 * NUM)
+    np.testing.assert_array_equal(t.elem_ids, np.full(NUM, 4))
+    np.testing.assert_allclose(
+        t.positions, np.tile([1.0, 0.4, 0.5], (NUM, 1)), atol=TOL
+    )
+    expected1 = np.array([0.0, 0.0, 0.3 * NUM, 0.1 * NUM, 0.5 * NUM, 0.0])
+    np.testing.assert_allclose(np.asarray(t.flux), expected1, atol=TOL)
+
+    origins = np.tile([1.0, 0.4, 0.5], (NUM, 1))
+    next_pos = origins.copy()
+    flying2 = np.zeros(NUM, dtype=np.int8)
+    weights2 = np.ones(NUM)
+    next_pos[0] = [0.15, 0.05, 0.20]
+    flying2[0], weights2[0] = 1, 2.0
+    next_pos[2] = [0.85, 0.05, 0.10]
+    flying2[2], weights2[2] = 1, 0.5
+    t.MoveToNextLocation(_flat(origins), _flat(next_pos), flying2, weights2,
+                         3 * NUM)
+    np.testing.assert_allclose(t.positions, next_pos, atol=TOL)
+    np.testing.assert_array_equal(t.elem_ids, [3, 4, 4, 4, 4])
+    expected2 = expected1.copy()
+    expected2[3] += 0.08790490988459178 * 2.0
+    expected2[4] += 0.879049070406094 * 2.0 + 0.552268050859363 * 0.5
+    np.testing.assert_allclose(np.asarray(t.flux), expected2, atol=TOL)
+
+
+def test_two_tier_random_parity_and_conservation():
+    """Random bench-shaped workload: both arms conserve at the gate;
+    the per-element divergence stays in the tie-class band (small L1
+    reattribution between face-adjacent elements, no systematic
+    bias)."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 4000
+    src, dst = _random_workload(mesh, n)
+    expect = float(np.linalg.norm(dst - src, axis=1).sum())
+    f32 = _run_one_move(PumiTally, mesh, n, TallyConfig(), src, dst)
+    bf = _run_one_move(PumiTally, mesh, n, _bf16_cfg(), src, dst)
+    assert abs(f32.sum() - expect) / expect < 1e-9
+    assert abs(bf.sum() - expect) / expect < 1e-9
+    # Tie-class reattribution: ~1e-3 relative L1 observed; 1e-2 is the
+    # refuse-a-systematic-bias line, not a precision promise.
+    assert np.abs(f32 - bf).sum() / expect < 1e-2
+
+
+def test_two_tier_partitioned_multichip():
+    """The partitioned engine under the bf16 tier on 8 virtual chips:
+    conserves at the gate and stays in the tie-class band vs the f32
+    partitioned arm."""
+    from pumiumtally_tpu import PartitionedPumiTally
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 3000
+    src, dst = _random_workload(mesh, n, seed=1)
+    expect = float(np.linalg.norm(dst - src, axis=1).sum())
+
+    multi = _run_one_move(
+        PartitionedPumiTally, mesh, n,
+        _bf16_cfg(device_mesh=make_device_mesh(8), capacity_factor=4.0),
+        src, dst,
+    )
+    assert abs(multi.sum() - expect) / expect < 1e-9
+    f32 = _run_one_move(
+        PartitionedPumiTally, mesh, n,
+        TallyConfig(device_mesh=make_device_mesh(8), capacity_factor=4.0),
+        src, dst,
+    )
+    assert np.abs(f32 - multi).sum() / expect < 1e-2
+
+
+def test_two_tier_gather_blocked():
+    """The single-device gather sub-split under the bf16 tier:
+    conserves, derives blocks from 2x the f32 element bound (same
+    resident bytes at half the row width), and routes around the vmem
+    kernel (no two-tier lowering)."""
+    from pumiumtally_tpu import PartitionedPumiTally
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 3000
+    src, dst = _random_workload(mesh, n, seed=1)
+    expect = float(np.linalg.norm(dst - src, axis=1).sum())
+
+    t = PartitionedPumiTally(
+        mesh, n,
+        _bf16_cfg(capacity_factor=4.0, walk_vmem_max_elems=100),
+    )
+    # vmem has no two-tier lowering: rerouted to the gather kernel,
+    # with the block bound doubled (100 -> 200 elements per block).
+    assert t.engine.block_kernel == "gather"
+    assert t.engine.two_tier
+    from pumiumtally_tpu.parallel.partition import derive_blocks_per_chip
+
+    f32_blocks = derive_blocks_per_chip(mesh.nelems, 1, 100)
+    assert t.engine.blocks_per_chip == derive_blocks_per_chip(
+        mesh.nelems, 1, 200
+    )
+    assert t.engine.blocks_per_chip < f32_blocks
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                         np.ones(n, np.int8), np.ones(n))
+    blocked = np.asarray(t.flux, np.float64)
+    assert abs(blocked.sum() - expect) / expect < 1e-9
+    # Tie-class band vs the monolithic f32 walk on the same workload.
+    f32 = _run_one_move(PumiTally, mesh, n, TallyConfig(), src, dst)
+    assert np.abs(f32 - blocked).sum() / expect < 1e-2
+
+
+def test_two_tier_hull_exit_divergence_bounded():
+    """The documented hull-exit caveat (PERF_NOTES tie anatomy): under
+    the bf16 tier a small fraction of boundary-EXITING particles may
+    terminate slightly inside the hull (wrong-corridor dead end). Pin
+    the BOUNDS: rate a few percent of exits, magnitude a few percent
+    of a segment, total flux within 1e-3 of the f32 arm — a regression
+    past these means the selection/refinement contract broke, not just
+    a tie."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 4000
+    rng = np.random.default_rng(3)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dst = rng.uniform(0.0, 1.4, (n, 3))  # many exit the hull
+    out = {}
+    for label, cfg in (("f32", TallyConfig()), ("bf16", _bf16_cfg())):
+        t = PumiTally(mesh, n, cfg)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out[label] = (t.positions, np.asarray(t.flux, np.float64))
+    exited = (dst > 1.0).any(axis=1)
+    assert exited.sum() > 500  # the probe must actually probe exits
+    x32, xbf = out["f32"][0], out["bf16"][0]
+    # f32: every exiting particle commits ON the hull.
+    assert np.isclose(x32[exited].max(axis=1), 1.0, atol=1e-5).all()
+    # bf16: bounded dead-end tail, not a systematic drift.
+    inside = 1.0 - xbf[exited].max(axis=1)
+    assert np.mean(inside > 1e-5) < 0.05  # rate: a few % of exits
+    assert inside.max() < 0.2  # magnitude: a fraction of one segment
+    f32_sum, bf_sum = out["f32"][1].sum(), out["bf16"][1].sum()
+    assert abs(f32_sum - bf_sum) / f32_sum < 1e-3
+
+
+def test_two_tier_requires_lo_tables():
+    """A direct walk() call asking for the bf16 tier on a mesh without
+    the tiers must refuse loudly (a silent f32 fallback would
+    invalidate every A/B built on the knob)."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.ops.walk import walk
+
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    n = 4
+    with pytest.raises(ValueError, match="two-tier"):
+        walk(
+            mesh,
+            jnp.zeros((n, 3), mesh.coords.dtype),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n, 3), mesh.coords.dtype),
+            jnp.ones((n,), jnp.int8),
+            jnp.ones((n,), mesh.coords.dtype),
+            jnp.zeros((mesh.nelems,), mesh.coords.dtype),
+            tally=True, tol=1e-8, max_iters=8,
+            table_dtype="bfloat16",
+        )
+    # The tier build refuses when neighbor ids cannot be exact in the
+    # refinement rows' float adj lane (same ceiling as the packed
+    # layout — enforced, not silently corrupted). f32's limit is 2^24;
+    # fake a tiny limit to exercise the guard at test size.
+    import pumiumtally_tpu.mesh.tetmesh as tm
+
+    orig = tm._exact_id_limit
+    tm._exact_id_limit = lambda dtype: 4
+    try:
+        with pytest.raises(ValueError, match="exact-id"):
+            mesh.with_lowp_tables()
+    finally:
+        tm._exact_id_limit = orig
+
+
+# ---------------------------------------------------------------------------
+# Knob plumbing (mirrors the walk_perm_mode env-resolution tests)
+# ---------------------------------------------------------------------------
+
+def test_table_dtype_env_resolves_in_walk_kwargs(monkeypatch):
+    """PUMIUMTALLY_WALK_TABLE_DTYPE must resolve at CONFIG resolution
+    (into the static jit key), not at trace time — an env flip in a
+    running process then recompiles instead of silently reusing the
+    stale tier (same contract as PUMIUMTALLY_WALK_PERM)."""
+    monkeypatch.delenv("PUMIUMTALLY_WALK_TABLE_DTYPE", raising=False)
+    assert TallyConfig().walk_kwargs() == ()
+    # An explicit default-equal tier normalizes away (cache-key parity).
+    assert TallyConfig(walk_table_dtype="float32").walk_kwargs() == ()
+    monkeypatch.setenv("PUMIUMTALLY_WALK_TABLE_DTYPE", "bfloat16")
+    assert ("table_dtype", "bfloat16") in TallyConfig().walk_kwargs()
+    assert ("table_dtype", "bfloat16") in TallyConfig(
+        walk_table_dtype="auto"
+    ).walk_kwargs()
+    # An explicit DEFAULT tier under a contrary env var must still be
+    # emitted (the kernel's trace-time fallback would otherwise
+    # override the explicit choice).
+    assert ("table_dtype", "float32") in TallyConfig(
+        walk_table_dtype="float32"
+    ).walk_kwargs()
+    # The facades' mesh conversion follows the same resolution.
+    assert TallyConfig().resolved_table_dtype() == "bfloat16"
+    assert TallyConfig(
+        walk_table_dtype="float32"
+    ).resolved_table_dtype() == "float32"
+    # A bogus env value fails loudly at config resolution.
+    monkeypatch.setenv("PUMIUMTALLY_WALK_TABLE_DTYPE", "f16")
+    with pytest.raises(ValueError):
+        TallyConfig().walk_kwargs()
+    with pytest.raises(ValueError):
+        TallyConfig(walk_table_dtype="bogus")
+
+
+def test_table_dtype_env_flip_recompiles(monkeypatch):
+    """End to end: flipping the env var between two engines over the
+    same mesh shape changes the static jit key, so the second engine
+    COMPILES rather than silently reusing the f32 program (the
+    retrace-tripwire budgets in config.py already admit the two keys).
+    """
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    n = 64
+    src, dst = _random_workload(mesh, n, seed=2)
+
+    def drive(cfg):
+        t = PumiTally(mesh, n, cfg)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, dst.reshape(-1).copy())
+        return float(np.asarray(t.flux, np.float64).sum())
+
+    monkeypatch.delenv("PUMIUMTALLY_WALK_TABLE_DTYPE", raising=False)
+    drive(TallyConfig())  # prime the f32 jit cache for this shape
+    with retrace_guard(raise_on_exceed=False) as g:
+        monkeypatch.setenv("PUMIUMTALLY_WALK_TABLE_DTYPE", "bfloat16")
+        drive(TallyConfig())
+    assert g.compiles.get("walk_continue", 0) >= 1
+    # Flipping back reuses the pre-flip cache: zero new compiles.
+    with retrace_guard(raise_on_exceed=False) as g2:
+        monkeypatch.delenv("PUMIUMTALLY_WALK_TABLE_DTYPE", raising=False)
+        drive(TallyConfig())
+    assert g2.compiles.get("walk_continue", 0) == 0
+
+
+def test_autotune_sweeps_but_does_not_adopt_bf16():
+    """The autotuner measures the bf16-tier candidate (the chip window
+    needs its rate) but must not ADOPT it without allow_approximate —
+    tuning's default contract is that it never changes physics."""
+    from pumiumtally_tpu.utils.autotune import autotune_walk
+
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    cands = [
+        {"walk_cond_every": 2},
+        {"walk_table_dtype": "bfloat16"},
+    ]
+    cfg, report = autotune_walk(
+        mesh, n_particles=256, moves=1, candidates=cands
+    )
+    assert {"walk_table_dtype": "bfloat16"} in [r["knobs"] for r in report]
+    assert cfg.walk_table_dtype is None
+    cfg2, _ = autotune_walk(
+        mesh, n_particles=256, moves=1,
+        candidates=[{"walk_table_dtype": "bfloat16"}],
+        allow_approximate=True,
+    )
+    assert cfg2.walk_table_dtype == "bfloat16"
+
+
+def test_xpoints_replay_matches_two_tier_transport():
+    """The intersection-points replay must run the SAME tier as the
+    transport (the shared-advance contract): under the bf16 tier the
+    oracle ray's last crossing is still the boundary point."""
+    mesh = build_box(1, 1, 1, 1, 1, 1)
+    t = PumiTally(mesh, NUM, _bf16_cfg(record_xpoints=True))
+    init = np.tile([0.1, 0.4, 0.5], (NUM, 1))
+    t.CopyInitialPosition(_flat(init), 3 * NUM)
+    dests = np.tile([1.2, 0.4, 0.5], (NUM, 1))
+    t.MoveToNextLocation(_flat(init), _flat(dests),
+                         np.ones(NUM, np.int8), np.ones(NUM))
+    np.testing.assert_allclose(
+        t.intersection_points(), np.tile([1.0, 0.4, 0.5], (NUM, 1)),
+        atol=TOL,
+    )

@@ -1,0 +1,210 @@
+"""A/B: f32 single-tier vs bf16 two-tier walk tables, at bench shape.
+
+The two-tier bet (docs/PERF_NOTES.md "Table precision tiers"): the hot
+loop's floor is the random-row gather of the packed f32 [E,20] walk
+table (~80 B/crossing). The bf16 SELECT tier halves the row the
+per-crossing gather touches (32 B planes + 16 B int32 adjacency), and
+ONE full-precision refinement gather of the winning face's plane
+(16 B) keeps track lengths and committed positions at working-dtype
+accuracy — select-in-bf16 / commit-in-f32 (docs/DESIGN.md invariant).
+
+This tool measures both arms on the CURRENT backend with the
+bench-shaped continue-mode workload (same mesh family, same clipped-
+gaussian steps) at the raw kernel level — no facade/staging noise:
+
+1. correctness first: both arms must pass the conservation gate, and
+   the flux L1 divergence between them is reported (the benign
+   tie-class reattribution, expected ~1e-3 relative);
+2. rates: timed passes INTERLEAVED between arms (PERF_NOTES r5
+   measurement note: back-to-back whole-arm runs fold frequency/cache
+   ramp into the first arm), median per arm;
+3. bytes provenance: select-tier table bytes (the per-crossing random
+   gather's working set — the number that must halve), total walk-
+   geometry bytes per arm, and the modeled B/crossing.
+
+Prints one JSON line; ``run_ab`` is also called in-process by
+bench.py's ``table_precision`` row. Run on CPU now (the recorded
+PERF_NOTES numbers) and unchanged in the next chip window
+(tools/r6_onchip_suite.sh, under the suite's chip-window interlock).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/exp_table_precision_ab.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N = int(os.environ.get("PUMIUMTALLY_AB_N", 200_000))
+DIV = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))  # 20^3 cells = 48k tets
+MOVES = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 3))
+TRIALS = int(os.environ.get("PUMIUMTALLY_AB_TRIALS", 3))
+MEAN_STEP = 0.25
+CONSERVATION_RTOL = 1e-6
+
+
+def _workload(n: int, moves: int, dtype):
+    rng = np.random.default_rng(0)
+    pts = [rng.uniform(0.05, 0.95, (n, 3))]
+    for _ in range(moves + 1):
+        step = rng.normal(scale=MEAN_STEP / np.sqrt(3.0), size=(n, 3))
+        pts.append(np.clip(pts[-1] + step, 0.02, 0.98))
+    import jax.numpy as jnp
+
+    return [jnp.asarray(p, dtype) for p in pts]
+
+
+def geometry_bytes(mesh) -> dict:
+    """Byte provenance of one arm's walk-geometry tables. ``select``
+    is the working set the per-crossing random row gather touches —
+    the quantity the bf16 tier halves (and the table that must be
+    resident for the gather sub-split's small-table regime);
+    ``refine`` is the per-face tier whose winning row (plane + adj
+    lane) is the ONLY other per-crossing gather. The f32 arm's
+    adjacency rides inside its packed row; the bf16 arm's rides the
+    refinement row."""
+    if mesh.walk_table_lo is not None:
+        sel = mesh.walk_table_lo.nbytes
+        refine = mesh.walk_table_hi.nbytes
+        lo_row = (
+            mesh.walk_table_lo.dtype.itemsize * mesh.walk_table_lo.shape[1]
+        )
+        hi_row = (
+            mesh.walk_table_hi.dtype.itemsize * mesh.walk_table_hi.shape[1]
+        )
+        per_crossing = lo_row + hi_row  # select row + ONE refined face
+    else:
+        sel = mesh.walk_table.nbytes
+        refine = 0
+        row = mesh.walk_table.dtype.itemsize * mesh.walk_table.shape[1]
+        per_crossing = row
+    return {
+        "select_table_bytes": int(sel),
+        "refine_table_bytes": int(refine),
+        "total_bytes": int(sel + refine),
+        "modeled_bytes_per_crossing": int(per_crossing),
+    }
+
+
+def run_ab(
+    n: int = N, div: int = DIV, moves: int = MOVES, trials: int = TRIALS
+) -> dict:
+    """Measure both arms; return the summary record (see module
+    docstring). Raises SystemExit on a conservation-gate failure —
+    a silently corrupted arm must not report a rate."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.api.tally import _localize_step
+    from pumiumtally_tpu.ops.walk import walk
+
+    cfg = TallyConfig()
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    mesh_lo = mesh.with_lowp_tables()
+    dtype = mesh.coords.dtype
+    tol = cfg.resolved_tolerance(dtype)
+    max_iters = cfg.resolved_max_iters(mesh.nelems)
+    pts = _workload(n, moves, dtype)
+
+    # One shared localization: identical start state for both arms.
+    c0 = jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0)
+    x0, e0, done, _ = _localize_step(
+        mesh, jnp.broadcast_to(c0, (n, 3)), jnp.zeros((n,), jnp.int32),
+        pts[0], tol=tol, max_iters=max_iters,
+    )
+    assert bool(jnp.all(done)), "A/B workload failed to localize"
+    fly = jnp.ones((n,), jnp.int8)
+    w = jnp.ones((n,), dtype)
+
+    arms = {
+        "f32": (mesh, "float32"),
+        "bf16": (mesh_lo, "bfloat16"),
+    }
+    progs = {
+        k: jax.jit(partial(
+            walk, tally=True, tol=tol, max_iters=max_iters, table_dtype=td,
+        ))
+        for k, (_, td) in arms.items()
+    }
+
+    def run_pass(k):
+        m, _ = arms[k]
+        x, e, flux = x0, e0, jnp.zeros((mesh.nelems,), dtype)
+        t0 = time.perf_counter()
+        for mv in range(1, moves + 1):
+            r = progs[k](m, x, e, pts[mv], fly, w, flux)
+            x, e, flux = r.x, r.elem, r.flux
+        total = float(jnp.sum(flux))  # the sync
+        return time.perf_counter() - t0, flux, e, total
+
+    # Warmup (compiles) + correctness capture, then interleaved trials.
+    results = {k: run_pass(k) for k in arms}
+    expect = sum(
+        float(np.linalg.norm(
+            np.asarray(pts[mv], np.float64)
+            - np.asarray(pts[mv - 1], np.float64),
+            axis=1,
+        ).sum())
+        for mv in range(1, moves + 1)
+    )
+    cons = {}
+    for k, (_, flux, _, total) in results.items():
+        rel = abs(total - expect) / expect
+        cons[k] = rel
+        if rel > CONSERVATION_RTOL:
+            print(f"# FATAL: {k} arm conservation off by {rel:.2e}",
+                  file=sys.stderr)
+            sys.exit(1)
+    f_f32 = np.asarray(results["f32"][1], np.float64)
+    f_bf = np.asarray(results["bf16"][1], np.float64)
+    e_f32 = np.asarray(results["f32"][2])
+    e_bf = np.asarray(results["bf16"][2])
+
+    times = {k: [] for k in arms}
+    for _ in range(trials):
+        for k in arms:  # interleaved — see module docstring
+            times[k].append(run_pass(k)[0])
+    rate = {k: n * moves / float(np.median(ts)) for k, ts in times.items()}
+
+    bytes_ab = {k: geometry_bytes(m) for k, (m, _) in arms.items()}
+    return {
+        "row": "table_precision",
+        "f32_moves_per_sec": rate["f32"],
+        "bf16_moves_per_sec": rate["bf16"],
+        "speedup": rate["bf16"] / rate["f32"],
+        "select_table_bytes_f32": bytes_ab["f32"]["select_table_bytes"],
+        "select_table_bytes_bf16": bytes_ab["bf16"]["select_table_bytes"],
+        "select_bytes_ratio": (
+            bytes_ab["bf16"]["select_table_bytes"]
+            / bytes_ab["f32"]["select_table_bytes"]
+        ),
+        "bytes": bytes_ab,
+        "conservation_rel_err": cons,
+        "flux_l1_rel_divergence": float(np.abs(f_f32 - f_bf).sum() / expect),
+        "elem_divergence_frac": float(np.mean(e_f32 != e_bf)),
+        "workload": {"particles": n, "mesh_tets": 6 * div ** 3,
+                     "moves": moves, "trials": trials},
+    }
+
+
+def main() -> None:
+    if "--quick" in sys.argv:
+        rec = run_ab(n=20_000, div=6, moves=2)
+    else:
+        rec = run_ab()
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

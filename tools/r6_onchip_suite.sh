@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-6 on-chip suite: fired by a probe loop (tools/r5_probe_loop.sh
+# pattern) the moment the TPU tunnel answers. ORDER MATTERS (r4
+# lesson): a QUICK headline bench runs first (a short window must
+# still yield a fresh cached measurement), then the full bench (which
+# now includes the table_precision A/B row in-process), then this
+# round's experiment — the two-tier walk-table A/B at full bench
+# scale — then the inherited engine experiments; the production-VMEM
+# compile+measure goes LAST because its remote compile request remains
+# the prime wedge suspect (r4's helper hung rather than erroring).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir (a window
+# that closes mid-stage leaves the partial log in place), the digest is
+# regenerated before AND after every stage, and the digest write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r6_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r6 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success) for the
+# round record. The full bench then overwrites it with the complete
+# row set (incl. the table_precision A/B at its reduced shape).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-6 measurement: f32 vs bf16 two-tier walk tables at the
+# FULL headline shape (500k particles, 48k tets — the in-bench row
+# runs 200k to bound its budget). The select-tier gather is the
+# measured bandwidth floor; this is the number that accepts or kills
+# the tier (docs/PERF_NOTES.md "Table precision tiers").
+run table_ab   1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+# bf16-tier gather sub-split on the bench workload: blocks at 2x L
+# (same resident bytes, half the migration-round pressure) — compare
+# against bench_clean's f32 gather_blocked row.
+run table_ab_blocked 1800 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_REDISTRIBUTION=0 PUMIUMTALLY_WALK_TABLE_DTYPE=bfloat16 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run blocked    3300 python tools/exp_r5_blocked.py 500000 4
+run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects): the vmem
+# kernel sweep, now also asserting the PROJECTED bf16 select-tier
+# ceiling (VMEM_FEASIBLE_MAX_ELEMS_BF16) via the AOT path.
+run vmem_prod  1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
