@@ -315,6 +315,68 @@ def run_gather_blocked(n: int, moves: int) -> dict:
     return res
 
 
+def run_blocked_profile(n: int, moves: int) -> dict:
+    """Component budget of the gather-blocked engine: per-round
+    walk / migrate / occupancy / bookkeeping ms from the profiled
+    phase driver (parallel/partition.py PhaseProfile) plus rounds,
+    per-block dispatches, and the frontier-size max/mean — the
+    frontier-local-migration evidence row (docs/PERF_NOTES.md
+    "Frontier-local migration"). Best-effort in main(): a failure may
+    not cost the headline. Reduced shape (200k particles by default)
+    like the table_precision row; the profiled driver pays one sync
+    per section per round by design, so its absolute rate is NOT the
+    engine's throughput — only the per-component ratios are the
+    signal. PUMIUMTALLY_BENCH_CAP_FRONTIER sizes the slab (default
+    n//8; an overflowing round falls back and is counted in
+    fallback_rounds, honestly)."""
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel.partition import PhaseProfile
+
+    bound = int(os.environ.get("PUMIUMTALLY_BENCH_BLOCK_ELEMS", 3072))
+    cap_frontier = int(
+        os.environ.get("PUMIUMTALLY_BENCH_CAP_FRONTIER", max(1, n // 8))
+    )
+    mesh = build_box(1.0, 1.0, 1.0, MESH_DIV, MESH_DIV, MESH_DIV)
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(capacity_factor=2.0,
+                    walk_vmem_max_elems=bound,
+                    walk_block_kernel="gather",
+                    cap_frontier=cap_frontier,
+                    check_found_all=False, fenced_timing=False),
+    )
+    rng = np.random.default_rng(0)
+    pts = make_trajectory(rng, n, moves + 1)
+    t.CopyInitialPosition(pts[0].reshape(-1).copy())
+    eng = t.engine
+    dt = eng.state["x"].dtype
+
+    def profiled_move(m: int, prof: PhaseProfile) -> None:
+        import jax.numpy as jnp
+
+        eng.move(None, jnp.asarray(pts[m], dt),
+                 jnp.asarray(np.ones(n, np.int8)),
+                 jnp.asarray(np.ones(n), dt), profile=prof)
+
+    profiled_move(1, PhaseProfile())  # warmup: compiles the programs
+    prof = PhaseProfile()
+    for m in range(2, moves + 2):
+        profiled_move(m, prof)
+    import jax.numpy as jnp
+
+    total_flux = float(np.float64(jnp.sum(t.flux)))
+    rel = check_conservation(total_flux, pts, 1, moves + 1)
+    rec = prof.as_dict()
+    rec.update({
+        "conservation_rel_err": rel,
+        "blocks_per_chip": eng.blocks_per_chip,
+        "block_elems": eng.part.L,
+        "particles": n,
+        "moves": moves,
+    })
+    return rec
+
+
 def run_pincell(n: int, moves: int, tuned: bool = False) -> dict:
     """Continue-mode rate on the pincell O-grid (~22k tets) — the
     BASELINE configs[0-1] geometry: anisotropic tets, curved fuel
@@ -392,6 +454,29 @@ def run_redistribution_ab() -> dict | None:
             exp_partition_ab.bench_cascade_boundary(N),
             exp_partition_ab.bench_migrate_round(N),
         )
+    }
+
+
+def run_frontier_ab() -> dict | None:
+    """Component row: full-capacity vs frontier-slab in-loop migration
+    (tools/exp_frontier_ab.py bench_migrate_round) at bench capacity,
+    at a small (2%) and a large (20%) crossing front — the frontier
+    bet's per-round cost on this backend, honest in both regimes (the
+    CPU-measured pattern is a win when the front is small and a loss
+    when it is a double-digit fraction of capacity; the slab is a
+    configured knob precisely because the crossover is workload- and
+    backend-dependent). Slab-size invariance is asserted bitwise
+    inside the tool before timing. Best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_frontier_ab
+
+    return {
+        f"frac_{int(f * 100)}pct": exp_frontier_ab.bench_migrate_round(
+            N, frac=f
+        )
+        for f in (0.02, 0.20)
     }
 
 
@@ -739,6 +824,18 @@ def _measure_and_report() -> None:
             table_precision = run_table_precision_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# table-precision A/B failed: {e}", file=sys.stderr)
+    blocked_profile = None
+    if os.environ.get("PUMIUMTALLY_BENCH_BLOCKED_PROFILE", "1") != "0":
+        try:
+            blocked_profile = run_blocked_profile(min(N, 200_000), 3)
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# blocked-profile row failed: {e}", file=sys.stderr)
+    frontier = None
+    if os.environ.get("PUMIUMTALLY_BENCH_FRONTIER", "1") != "0":
+        try:
+            frontier = run_frontier_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# frontier A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -854,6 +951,17 @@ def _measure_and_report() -> None:
             "block_elems": gblocked["block_elems"],
             "walk_rounds_last_move": gblocked["walk_rounds_last_move"],
         },
+        # Component budget of the blocked engine (frontier-local
+        # migration instrumentation): per-round walk/migrate/occupancy
+        # ms from the profiled phase driver, rounds, per-block
+        # dispatches, frontier-size max/mean + slab fallback count.
+        # Ratios are the signal (the profiled driver syncs per
+        # section); best-effort like the other component rows.
+        "blocked_profile": blocked_profile,
+        # Full-capacity vs frontier-slab in-loop migrate at two
+        # crossing-front sizes (speedup > 1 = the slab wins at that
+        # front on this backend; honest in both regimes).
+        "frontier_migrate": frontier,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

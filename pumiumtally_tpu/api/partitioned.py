@@ -90,6 +90,7 @@ class PartitionedPumiTally(PumiTally):
             block_kernel=self.config.walk_block_kernel,
             partition_method=self.config.resolved_partition_method(),
             table_dtype=self._table_dtype,
+            cap_frontier=self.config.cap_frontier,
         )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0

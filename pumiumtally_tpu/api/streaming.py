@@ -526,6 +526,7 @@ class StreamingPartitionedTally(StreamingTally):
                 vmem_walk_max_elems=vmem_bound,
                 block_kernel=self.config.walk_block_kernel,
                 partition_method=self.config.resolved_partition_method(),
+                cap_frontier=self.config.cap_frontier,
             ))
         # Base-class sync/view lists are unused in this mode.
         self._x = []

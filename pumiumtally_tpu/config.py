@@ -58,6 +58,15 @@ RETRACE_BUDGETS: dict = {
     "localize": 4,
     "partition_locate": 3,
     "cascade_phase": 5,
+    # Profiled-phase programs (parallel/partition.py component-budget
+    # instrumentation): one jitted single-round program per
+    # (engine, tally) — a profiled two-phase move drives both tally
+    # keys — plus one migrate and one occupancy program per engine;
+    # measured max 2 each (profiled-vs-fused parity tests run one
+    # profiled engine, the A/B tool two).
+    "partition_round": 3,
+    "partition_migrate": 3,
+    "partition_occupancy": 3,
     "sharded_walk": 2,
     "sharded_walk_continue": 2,
     "sharded_locate": 2,
@@ -173,6 +182,24 @@ class TallyConfig:
     device_mesh: Optional[jax.sharding.Mesh] = None
     capacity_factor: float = 1.5
     max_migration_rounds: int = 64
+    # Partitioned engines only: per-round migration frontier slab.
+    # When set, each in-loop walk/migrate round moves ONLY the
+    # particles that actually paused at a partition/block face —
+    # compacted into a static slab of this many slots — instead of
+    # re-bucketing every one of the nparts × cap_per_chip slots
+    # (parallel/partition.py _frontier_migrate_impl): per-round
+    # migrate cost then scales with the crossing front, not the
+    # capacity. A round whose front exceeds the slab falls back to the
+    # full-capacity migrate (today's semantics, bitwise — shapes stay
+    # static either way), so the knob is a pure performance lever:
+    # conservation and per-particle observables are unchanged; only
+    # the slot layout (hence flux scatter-add rounding order, the same
+    # documented class as walk_perm_mode="sorted") differs from the
+    # unset default. None (default) keeps the historical full-capacity
+    # migrate every round; 0 forces the fallback every round (testing
+    # hook). Size it from PartitionedEngine.last_frontier_max — a slab
+    # at or above the workload's largest front never falls back.
+    cap_frontier: Optional[int] = None
     # Walk-kernel tuning knobs (ops/walk.py) — exposed so a deployment
     # can adopt the best measured configuration for its chip without
     # code changes. Defaults = the kernel's own defaults (None = leave
@@ -344,6 +371,11 @@ class TallyConfig:
             raise ValueError(
                 "walk_block_kernel must be 'vmem' or 'gather', "
                 f"got {self.walk_block_kernel!r}"
+            )
+        if self.cap_frontier is not None and int(self.cap_frontier) < 0:
+            raise ValueError(
+                f"cap_frontier must be >= 0 (0 = forced full-capacity "
+                f"fallback) or None, got {self.cap_frontier!r}"
             )
 
     def resolved_min_window(self) -> int:

@@ -29,6 +29,11 @@ Here:
   sharded mesh this lowers to the all-to-all/collective-permute the
   reference gets from MPI. Slots are over-provisioned by
   ``capacity_factor``; overflow raises rather than silently dropping.
+  In-loop rounds can run FRONTIER-LOCAL (``TallyConfig.cap_frontier``):
+  only the rows that actually paused move, through a static slab, with
+  stayers fixed in place and a bitwise full-capacity fallback when the
+  crossing front overflows the slab (``_frontier_migrate_impl``;
+  docs/DESIGN.md "Frontier-local migration").
 - **Flux** is owned: each chip accumulates only elements it owns, so no
   cross-chip reduction is needed at all (the ICI traffic is particle
   migration) and the result is deterministic by construction.
@@ -82,7 +87,7 @@ from pumiumtally_tpu.ops.walk import (
     select_faces_lo,
 )
 from pumiumtally_tpu.parallel.sharded import _axis_name, shard_map_check_kwargs
-from pumiumtally_tpu.utils.profiling import register_entry_point
+from pumiumtally_tpu.utils.profiling import phase_timer, register_entry_point
 
 try:  # jax >= 0.8
     from jax import shard_map
@@ -714,10 +719,254 @@ def _default_state(cap: int, like: dict) -> dict:
     return d
 
 
+def _occupancy_counts(done: jnp.ndarray, nparts: int) -> jnp.ndarray:
+    """[nparts] count of not-done slots per part — the occupied-block
+    list's ground truth, recomputed with one full-capacity scan. The
+    frontier path replaces the per-round call to this with incremental
+    departure/arrival deltas (``_update_occupancy``). Pinned int32
+    (jnp.sum would promote to the x64 default int) so the two update
+    paths carry one type."""
+    return jnp.sum(
+        (~done).reshape(nparts, -1), axis=1, dtype=jnp.int32
+    )
+
+
+def _frontier_migrate_impl(part_L: int, nparts: int, cap_per_chip: int,
+                           cap_frontier: int, state: dict,
+                           partition_method: str = "rank"):
+    """Frontier-slab migration: per-round cost proportional to the
+    CROSSING FRONT, not the capacity.
+
+    ``_migrate_impl`` re-buckets every slot every round: a
+    ``(nparts+1)``-bucket counting rank over all ``cap`` slots (the
+    one-hot rank slabs scale with ``ceil(nparts/64) · cap``) plus two
+    packed full-capacity scatters — even when only a handful of
+    particles paused at a partition face. Here the PENDING rows are
+    first compacted (stable, sort-free binary partition) into a static
+    ``cap_frontier`` slab; the expensive multi-bucket rank and every
+    row movement then run at slab size. Placement is STAYER-FIXED:
+
+    - non-pending slots (alive or dead) keep their slots — zero row
+      movement for the part of the population that did not cross;
+    - departing slots reset to defaults, becoming free;
+    - arrivals scatter into their target part's free slots, free slots
+      taken in ascending slot order, arrivals ordered by source slot —
+      a deterministic, permutation-free destination for every row.
+
+    What remains O(cap) is one int32 bookkeeping lane (the free-slot
+    prefix sums and the binary-partition cumsum) — a few bytes per
+    slot against ``_migrate_impl``'s full state-row traffic and rank
+    slabs (docs/PERF_NOTES.md "Frontier-local migration" cost model).
+
+    The overflow condition is IDENTICAL to ``_migrate_impl``'s: part d
+    overflows iff stayers + arrivals > cap_per_chip, i.e. an arrival's
+    within-target rank reaches the part's free-slot count. The caller
+    must guarantee ``n_pending <= cap_frontier`` (the slab-overflow
+    cond in ``_inloop_migrate_step``): rows beyond the slab would be
+    left unmigrated, so the full-capacity fallback is mandatory, not
+    advisory.
+
+    Returns ``(state, overflow, departures, arrivals)``; the [nparts]
+    departure/arrival counts feed the incremental occupied-block
+    bookkeeping.
+    """
+    cap = state["pid"].shape[0]
+    pending = state["pending"]
+    alive = state["alive"]
+    moving = pending >= 0
+    iota = jnp.cumsum(jnp.ones_like(pending)) - 1
+    slot_chip = iota // cap_per_chip
+    # Stable slab compaction: pending rows front-packed in slot order.
+    perm, counts, _ = partition_perm(
+        (~moving).astype(jnp.int32), 2, method=partition_method
+    )
+    n_move = counts[0]
+    src = perm[:cap_frontier]
+    slab_iota = jnp.cumsum(jnp.ones_like(src)) - 1
+    valid = slab_iota < n_move
+    # Free slots under stayer-fixed placement: never-occupied + the
+    # slots departures vacate this round. free_list inverts
+    # (part, within-part free rank) -> slot id.
+    fint = ((~alive) | moving).astype(jnp.int32)
+    excl = jnp.cumsum(fint) - fint
+    chip_base = excl.reshape(nparts, cap_per_chip)[:, 0]
+    free_rank = excl - chip_base[slot_chip]
+    n_free = jnp.sum(fint.reshape(nparts, cap_per_chip), axis=1)
+    fdest = jnp.where(
+        fint == 1, slot_chip * cap_per_chip + free_rank, cap
+    )
+    free_list = jnp.full((cap,), cap, iota.dtype).at[fdest].set(
+        iota, mode="drop"
+    )
+    # Arrival destinations: stable within-target rank over the SLAB
+    # (the nparts-scaling rank now costs ceil(nparts/64)·cap_frontier).
+    pend_slab = pending[src]
+    tgt = jnp.clip(pend_slab // part_L, 0, nparts - 1)
+    key = jnp.where(valid, tgt, nparts)
+    rank = counting_ranks(key, nparts + 1, method=partition_method)
+    overflow = jnp.any(valid & (rank >= n_free[tgt]))
+    ridx = tgt * cap_per_chip + jnp.minimum(rank, cap_per_chip - 1)
+    dest = jnp.where(valid, free_list[ridx], cap)
+    src_clear = jnp.where(valid, src, cap)
+
+    # Per-array frontier movement: gather the slab rows, clear the
+    # vacated sources to defaults, place arrivals — 1 gather + 2
+    # scatters of cap_frontier rows each, in place of the packed
+    # full-capacity scatter (packing itself would copy cap rows).
+    # Clear-before-place: an arrival's destination may be another
+    # departure's vacated slot.
+    defaults = _default_state(int(cap_frontier), state)
+    lelem_rows = jnp.where(
+        valid, pend_slab % part_L, jnp.zeros_like(pend_slab)
+    )
+    new_state = {}
+    for k, v in state.items():
+        rows = v[src]
+        if k == "lelem":
+            # Arrivals resume inside their new part's local mesh.
+            rows = lelem_rows
+        elif k == "pending":
+            rows = jnp.where(valid, jnp.asarray(-1, rows.dtype), rows)
+        new_state[k] = (
+            v.at[src_clear].set(defaults[k], mode="drop")
+            .at[dest].set(rows, mode="drop")
+        )
+    dep = jnp.bincount(
+        jnp.where(valid, src // cap_per_chip, nparts), length=nparts + 1
+    )[:nparts].astype(jnp.int32)
+    arr = jnp.bincount(key, length=nparts + 1)[:nparts].astype(jnp.int32)
+    return new_state, overflow, dep, arr
+
+
+def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
+                   cap_frontier, pmethod: str, state: dict,
+                   n_pending: jnp.ndarray):
+    """One in-loop migration round: the frontier slab when the crossing
+    front fits ``cap_frontier``, else the full-capacity
+    ``_migrate_impl`` (today's semantics, bitwise — it also re-compacts
+    every part, so an overflowing round doubles as a defragmenter).
+
+    ``cap_frontier`` is static: ``None`` keeps the full-capacity path
+    unconditionally (the historical default), ``0`` forces the
+    fallback every round (the parity-testing hook). Returns
+    ``(state, overflow, departures, arrivals, fellback)`` with zero
+    counts on fallback rounds (occupancy recomputes from scratch then —
+    ``_update_occupancy``)."""
+    z = jnp.zeros((nparts,), jnp.int32)
+    if cap_frontier is None or cap_frontier == 0:
+        st, ovf = _migrate_impl(part_L, nparts, cap_per_chip, state,
+                                pmethod)
+        return st, ovf, z, z, jnp.asarray(True)
+
+    def full(st):
+        st2, ovf = _migrate_impl(part_L, nparts, cap_per_chip, st,
+                                 pmethod)
+        return st2, ovf, z, z
+
+    def frontier(st):
+        return _frontier_migrate_impl(part_L, nparts, cap_per_chip,
+                                      cap_frontier, st, pmethod)
+
+    fellback = n_pending > cap_frontier
+    st, ovf, dep, arr = lax.cond(fellback, full, frontier, state)
+    return st, ovf, dep, arr, fellback
+
+
+def _update_occupancy(nparts: int, cap_frontier, state: dict,
+                      n_act: jnp.ndarray, dep: jnp.ndarray,
+                      arr: jnp.ndarray, fellback: jnp.ndarray):
+    """Next round's occupied-block counts: departure/arrival deltas on
+    frontier rounds, a full recompute after a full-capacity round
+    (whose re-compaction scrambles the slot layout the deltas assume
+    — and whose dep/arr counts are zeros)."""
+    if cap_frontier is None or cap_frontier == 0:
+        return _occupancy_counts(state["done"], nparts)
+    return lax.cond(
+        fellback,
+        lambda _: _occupancy_counts(state["done"], nparts),
+        lambda _: n_act - dep + arr,
+        None,
+    )
+
+
+def _inloop_migrate_step(part_L: int, nparts: int, cap_per_chip: int,
+                         cap_frontier, pmethod: str, state: dict,
+                         n_act: jnp.ndarray, n_pending: jnp.ndarray):
+    """Migration + occupancy bookkeeping for one phase-loop round —
+    the composition the fused phase program inlines; the profiled
+    driver dispatches the same two pieces separately so each section
+    can be fenced and timed."""
+    st, ovf, dep, arr, fellback = _migrate_round(
+        part_L, nparts, cap_per_chip, cap_frontier, pmethod, state,
+        n_pending,
+    )
+    n_act2 = _update_occupancy(nparts, cap_frontier, st, n_act, dep,
+                               arr, fellback)
+    return st, ovf, n_act2, fellback
+
+
 OVERFLOW_MESSAGE = (
     "partitioned-mode chip capacity exceeded during particle "
     "migration; raise TallyConfig.capacity_factor"
 )
+
+
+@dataclasses.dataclass
+class PhaseProfile:
+    """Component budget of profiled walk/migrate phases
+    (``PartitionedEngine.move(..., profile=...)``).
+
+    Sections are fenced wall seconds (utils/profiling.phase_timer):
+    ``walk_s`` the per-round block walks, ``migrate_s`` the
+    frontier/full migration, ``occupancy_s`` the occupied-block
+    bookkeeping, ``bookkeeping_s`` host-side staging and flag fetches.
+    ``frontier_sizes`` records each migration round's crossing-front
+    size (``n_pending``); ``fallback_rounds`` counts rounds the slab
+    overflowed into the full-capacity path (always 0 when
+    ``cap_frontier`` is unset). Profiled phases pay one host sync per
+    section per round — a measurement mode, not a production path; the
+    fused phase program stays the throughput path.
+    """
+
+    walk_s: float = 0.0
+    migrate_s: float = 0.0
+    occupancy_s: float = 0.0
+    bookkeeping_s: float = 0.0
+    rounds: int = 0
+    dispatches: int = 0
+    fallback_rounds: int = 0
+    cap_frontier: Optional[int] = None
+    frontier_sizes: list = dataclasses.field(default_factory=list)
+
+    @property
+    def frontier_max(self) -> int:
+        return max(self.frontier_sizes, default=0)
+
+    @property
+    def frontier_mean(self) -> float:
+        if not self.frontier_sizes:
+            return 0.0
+        return float(sum(self.frontier_sizes) / len(self.frontier_sizes))
+
+    def as_dict(self) -> dict:
+        """The bench row's shape (bench.py blocked_profile): per-phase
+        totals in ms plus per-round means and the frontier stats."""
+        r = max(self.rounds, 1)
+        return {
+            "walk_ms": self.walk_s * 1e3,
+            "migrate_ms": self.migrate_s * 1e3,
+            "occupancy_ms": self.occupancy_s * 1e3,
+            "bookkeeping_ms": self.bookkeeping_s * 1e3,
+            "walk_ms_per_round": self.walk_s * 1e3 / r,
+            "migrate_ms_per_round": self.migrate_s * 1e3 / r,
+            "occupancy_ms_per_round": self.occupancy_s * 1e3 / r,
+            "rounds": self.rounds,
+            "dispatches": self.dispatches,
+            "fallback_rounds": self.fallback_rounds,
+            "cap_frontier": self.cap_frontier,
+            "frontier_max": self.frontier_max,
+            "frontier_mean": self.frontier_mean,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -791,6 +1040,7 @@ class PartitionedEngine:
         block_kernel: str = "vmem",
         partition_method: str = "rank",
         table_dtype: str = "float32",
+        cap_frontier: Optional[int] = None,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -808,7 +1058,18 @@ class PartitionedEngine:
         moves inside one chip pause and re-bucket exactly like
         cross-chip moves, minus the collectives. Only partitions
         needing the int adjacency sidecar keep the gather walk
-        silently."""
+        silently.
+
+        ``cap_frontier`` (TallyConfig.cap_frontier): per-round
+        migration frontier slab — in-loop migration rounds move only
+        the pending rows through a static slab of this many slots
+        (stayer-fixed placement, ``_frontier_migrate_impl``); a round
+        whose crossing front exceeds the slab falls back to the
+        full-capacity ``_migrate_impl`` bitwise. ``None`` (default)
+        keeps the full-capacity migrate every round (historical
+        behavior, bitwise-stable); ``0`` forces the fallback every
+        round (testing hook). Localization and revival always use the
+        full migrate — their frontier IS the whole population."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -883,6 +1144,13 @@ class PartitionedEngine:
         self.cap_per_block = cap_b
         self.cap_per_chip = self.blocks_per_chip * cap_b
         self.cap = nparts * cap_b
+        # Clamp to capacity: a slab of cap rows IS the full-capacity
+        # frontier migrate (the parity-testing arm) — anything larger
+        # only wastes memory.
+        self.cap_frontier = (
+            None if cap_frontier is None
+            else max(0, min(int(cap_frontier), self.cap))
+        )
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
@@ -923,6 +1191,12 @@ class PartitionedEngine:
         self._last_rounds_cache = 0
         self._last_disp_dev = None
         self._last_disp_cache = 0
+        self._last_frontier_max_dev = None
+        self._last_frontier_max_cache = 0
+        self._last_frontier_sum_dev = None
+        self._last_frontier_sum_cache = 0
+        self._last_fallback_dev = None
+        self._last_fallback_cache = 0
         self._valid = self.part.orig_of_glid >= 0  # [ndev*L] bool
         self.state = {
             "x": jnp.zeros((self.cap, 3), dtype),
@@ -1116,6 +1390,50 @@ class PartitionedEngine:
         return self._last_disp_cache
 
     @property
+    def last_frontier_max(self) -> int:
+        """Largest per-round crossing front (pending particles at a
+        migration round) of the most recent phase; 0 for a phase with
+        no migrations. Sizes ``TallyConfig.cap_frontier``: a slab at or
+        above this value never falls back. Reading fetches one device
+        scalar (a sync), cached after the first read."""
+        if self._last_frontier_max_cache is None:
+            self._last_frontier_max_cache = (
+                0 if self._last_frontier_max_dev is None
+                else int(self._last_frontier_max_dev)
+            )
+        return self._last_frontier_max_cache
+
+    @property
+    def last_frontier_mean(self) -> float:
+        """Mean crossing front over the most recent phase's migration
+        rounds (0.0 with no migrations) — with ``last_frontier_max``,
+        the frontier-vs-capacity evidence the blocked_profile bench row
+        records. Reading fetches device scalars (a sync), cached."""
+        if self._last_frontier_sum_cache is None:
+            self._last_frontier_sum_cache = (
+                0 if self._last_frontier_sum_dev is None
+                else int(self._last_frontier_sum_dev)
+            )
+        migrations = self.last_walk_rounds - 1
+        if migrations <= 0:
+            return 0.0
+        return self._last_frontier_sum_cache / migrations
+
+    @property
+    def last_fallback_rounds(self) -> int:
+        """Migration rounds of the most recent phase whose crossing
+        front overflowed ``cap_frontier`` into the full-capacity
+        migrate (always 0 when the slab is unset; == every migration
+        round when cap_frontier=0, the forced-fallback testing hook).
+        Reading fetches one device scalar (a sync), cached."""
+        if self._last_fallback_cache is None:
+            self._last_fallback_cache = (
+                0 if self._last_fallback_dev is None
+                else int(self._last_fallback_dev)
+            )
+        return self._last_fallback_cache
+
+    @property
     def _n_lost(self) -> int:
         if self._n_lost_cache is None:
             self._n_lost_cache = (
@@ -1123,30 +1441,15 @@ class PartitionedEngine:
             )
         return self._n_lost_cache
 
-    def _phase_program(self, tally: bool):
-        """Cached jitted FULL phase: initial walk round plus as many
-        migrate→walk rounds as needed, all inside one ``lax.while_loop``
-        — zero per-round host syncs (the reference's search loop pays an
-        MPI rendezvous per migration instead)."""
-        # The closures bake in EVERY per-engine parameter they capture
-        # — capacity, round/iteration budgets, tolerance, and the
-        # partition itself — so the cache key must carry all of them:
-        # engines sharing a cache reuse a compiled phase only for a
-        # fully identical configuration (chunked engines differ in the
-        # last, smaller chunk's capacity).
-        key = ("phase", tally, self.cap_per_chip, self.max_rounds,
-               self.max_iters, self.tol, self.cond_every, self.min_window,
-               self.use_vmem_walk, self.blocks_per_chip,
-               self.partition_method, id(self.part))
-        if key in self._jit_cache:
-            return self._jit_cache[key]
+    def _make_round_sm(self, tally: bool):
+        """The shard_mapped one-walk-round kernel, shared by the fused
+        phase program (``_phase_program``) and the profiled per-round
+        driver (``_round_program``) so the two can never drift."""
         pp = P(self.axis)
         ax = self.axis
         part_L = self.part.L
-        nparts, cap_b = self.nparts, self.cap_per_block
         blocks = self.blocks_per_chip
         tol, max_iters = self.tol, self.max_iters
-        max_rounds = self.max_rounds
         cond_every = self.cond_every
         min_window = self.min_window
         has_adj = self.part.adj_int is not None
@@ -1159,7 +1462,7 @@ class PartitionedEngine:
             rest = list(rest)
             adj = rest.pop(0) if has_adj else None
             hi = rest.pop(0) if two_tier else None
-            x, lelem, dest, fly, w, done, exited, flux = rest
+            x, lelem, dest, fly, w, done, exited, flux, n_act = rest
             if use_vmem:
                 from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
 
@@ -1170,6 +1473,12 @@ class PartitionedEngine:
                 )
                 # The Pallas kernel sweeps every block unconditionally.
                 n_disp = jnp.sum(jnp.zeros_like(lelem)) + blocks
+                # Occupancy is unused by the sweep, but the carried
+                # counts must stay truthful for the migrate step's
+                # incremental bookkeeping.
+                n_act = jnp.sum(
+                    (~done).reshape(blocks, -1), axis=1, dtype=jnp.int32
+                )
             elif blocks > 1:
                 # Gather sub-split: run walk_local block-by-block,
                 # sequentially (NOT vmap — a batched gather over the
@@ -1193,10 +1502,16 @@ class PartitionedEngine:
                 # skipped lax.map step. A skipped block's state is
                 # exactly walk_local on an all-done batch: unchanged
                 # carries, fresh all- -1 pending, flux untouched.
+                #
+                # The occupied list comes from the CARRIED per-block
+                # not-done counts (incremental: walked blocks re-count
+                # themselves below, migration applies departure/arrival
+                # deltas — _update_occupancy), not a per-round
+                # full-capacity done scan.
                 ncap = x.shape[0]
                 cb = ncap // blocks
                 twidth = table.shape[-1]
-                occ = jnp.any(~done.reshape(blocks, cb), axis=1)
+                occ = n_act > 0
                 n_occ = jnp.sum(occ.astype(jnp.int32))
                 order, _, _ = partition_perm(
                     (~occ).astype(jnp.int32), 2, method=pmethod
@@ -1207,7 +1522,7 @@ class PartitionedEngine:
                     return c[0] < n_occ
 
                 def blk_body(c):
-                    t, x, lelem, done, exited, pending, flux = c
+                    t, x, lelem, done, exited, pending, flux, n_act = c
                     b = order[t]
                     po = b * cb  # first particle slot of block b
                     eo = b * part_L  # first element row of block b
@@ -1239,6 +1554,9 @@ class PartitionedEngine:
                         min_window=min_window, partition_method=pmethod,
                         table_hi=hi_b,
                     )
+                    n_act = n_act.at[b].set(
+                        jnp.sum(~dnb, dtype=jnp.int32)
+                    )
                     return (
                         t + 1,
                         lax.dynamic_update_slice(x, xb, (po, z)),
@@ -1247,12 +1565,14 @@ class PartitionedEngine:
                         lax.dynamic_update_slice(exited, exb, (po,)),
                         lax.dynamic_update_slice(pending, pb, (po,)),
                         lax.dynamic_update_slice(flux, fxb, (eo,)),
+                        n_act,
                     )
 
-                _, x, lelem, done, exited, pending, flux = lax.while_loop(
+                (_, x, lelem, done, exited, pending, flux,
+                 n_act) = lax.while_loop(
                     blk_cond, blk_body,
                     (jnp.sum(jnp.zeros_like(lelem)), x, lelem, done,
-                     exited, pending, flux),
+                     exited, pending, flux, n_act),
                 )
                 n_disp = n_occ
             else:
@@ -1264,6 +1584,7 @@ class PartitionedEngine:
                 )
                 # One whole-partition walk per chip per round.
                 n_disp = jnp.sum(jnp.zeros_like(lelem)) + 1
+                n_act = jnp.sum(~done, dtype=jnp.int32).reshape(1)
             # Global round status computed in-program (one psum each) so
             # the while_loop can branch on them without leaving the
             # device. n_disp: per-block walk dispatches this round, all
@@ -1272,10 +1593,10 @@ class PartitionedEngine:
             n_pending = lax.psum(jnp.sum(pending >= 0), ax)
             n_not_done = lax.psum(jnp.sum(~done), ax)
             n_disp = lax.psum(n_disp, ax)
-            return (x, lelem, done, exited, pending, flux, n_pending,
-                    n_not_done, n_disp)
+            return (x, lelem, done, exited, pending, flux, n_act,
+                    n_pending, n_not_done, n_disp)
 
-        n_in = 9 + int(has_adj) + int(two_tier)
+        n_in = 10 + int(has_adj) + int(two_tier)
         # Output-type checking (check_vma on current jax, check_rep on
         # jax 0.4.x — shard_map_check_kwargs resolves the spelling) is
         # disabled ONLY for the vmem-kernel variant: the pallas
@@ -1285,13 +1606,43 @@ class PartitionedEngine:
         # exactly this workaround). The gather variant keeps full
         # checking; result parity between the two engines is pinned by
         # tests/test_vmem_walk.py.
-        round_sm = shard_map(
+        return shard_map(
             round_kernel,
             mesh=self.device_mesh,
             in_specs=(pp,) * n_in,
-            out_specs=(pp,) * 6 + (P(), P(), P()),
+            out_specs=(pp,) * 7 + (P(), P(), P()),
             **shard_map_check_kwargs(not use_vmem),
         )
+
+    def _phase_key(self, kind: str, tally: bool) -> tuple:
+        """Shared cache-key components of the phase-family programs.
+        The closures bake in EVERY per-engine parameter they capture —
+        capacity, round/iteration budgets, tolerance, the frontier
+        slab, and the partition itself — so the key must carry all of
+        them: engines sharing a cache reuse a compiled program only
+        for a fully identical configuration (chunked engines differ in
+        the last, smaller chunk's capacity)."""
+        return (kind, tally, self.cap_per_chip, self.max_rounds,
+                self.max_iters, self.tol, self.cond_every,
+                self.min_window, self.use_vmem_walk, self.blocks_per_chip,
+                self.partition_method, self.cap_frontier, id(self.part))
+
+    def _phase_program(self, tally: bool):
+        """Cached jitted FULL phase: initial walk round plus as many
+        migrate→walk rounds as needed, all inside one ``lax.while_loop``
+        — zero per-round host syncs (the reference's search loop pays an
+        MPI rendezvous per migration instead)."""
+        key = self._phase_key("phase", tally)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        part_L = self.part.L
+        nparts, cap_b = self.nparts, self.cap_per_block
+        max_rounds = self.max_rounds
+        has_adj = self.part.adj_int is not None
+        pmethod = self.partition_method
+        two_tier = self.two_tier
+        cap_frontier = self.cap_frontier
+        round_sm = self._make_round_sm(tally)
 
         @jax.jit
         def phase(table, adj, hi, state, flux):
@@ -1307,57 +1658,75 @@ class PartitionedEngine:
                 (st["fly"] == 1)[:, None], st["dest"], st["x"]
             )
 
-            def call_round(st, fx):
+            def call_round(st, fx, n_act):
                 args = (
                     (table,)
                     + ((adj,) if has_adj else ())
                     + ((hi,) if two_tier else ())
                     + (
                         st["x"], st["lelem"], st["dest"], st["fly"],
-                        st["w"], st["done"], st["exited"], fx,
+                        st["w"], st["done"], st["exited"], fx, n_act,
                     )
                 )
-                (x, lelem, done, exited, pending, fx, n_p, n_nd,
+                (x, lelem, done, exited, pending, fx, n_act, n_p, n_nd,
                  n_disp) = round_sm(*args)
                 return (
                     dict(st, x=x, lelem=lelem, done=done, exited=exited,
                          pending=pending),
-                    fx, n_p, n_nd, n_disp,
+                    fx, n_act, n_p, n_nd, n_disp,
                 )
 
-            st, fx, n_p, n_nd, disp = call_round(st, flux)
+            n_act0 = _occupancy_counts(st["done"], nparts)
+            st, fx, n_act, n_p, n_nd, disp = call_round(st, flux, n_act0)
+            zero = jnp.zeros_like(n_p)
 
             def cond(c):
-                it, _st, _fx, n_p, _n_nd, _disp, ovf = c
+                it, _st, _fx, _na, n_p, _n_nd, _disp, ovf = c[:8]
                 return (n_p > 0) & (it < max_rounds) & ~ovf
 
             def body(c):
-                it, st, fx, n_p, n_nd, disp, ovf = c
-                st2, ovf2 = _migrate_impl(part_L, nparts, cap_b, st,
-                                          pmethod)
+                (it, st, fx, n_act, n_p, n_nd, disp, ovf, fmax, fsum,
+                 nfb) = c
+                st2, ovf2, n_act2, fellback = _inloop_migrate_step(
+                    part_L, nparts, cap_b, cap_frontier, pmethod, st,
+                    n_act, n_p,
+                )
                 # An overflowing migrate scatters colliding slots: do
                 # NOT walk (and tally) from that corrupted state — the
                 # loop cond exits on ovf and the host raises.
-                st3, fx3, n_p3, n_nd3, d3 = lax.cond(
+                st3, fx3, n_act3, n_p3, n_nd3, d3 = lax.cond(
                     ovf2,
-                    lambda op: (op[0], op[1], n_p, n_nd,
+                    lambda op: (op[0], op[1], op[2], n_p, n_nd,
                                 jnp.zeros_like(disp)),
                     lambda op: call_round(*op),
-                    (st2, fx),
+                    (st2, fx, n_act2),
                 )
-                return it + 1, st3, fx3, n_p3, n_nd3, disp + d3, ovf | ovf2
+                # Frontier diagnostics ride the carry: the crossing
+                # front this round (n_p), its running max/sum, and the
+                # slab-overflow fallback count (always 0 when the slab
+                # is off — static python branch keeps the carry clean).
+                nfb2 = (
+                    nfb + fellback.astype(nfb.dtype)
+                    if cap_frontier is not None else nfb
+                )
+                return (it + 1, st3, fx3, n_act3, n_p3, n_nd3,
+                        disp + d3, ovf | ovf2,
+                        jnp.maximum(fmax, n_p), fsum + n_p, nfb2)
 
-            it, st, fx, n_p, n_nd, disp, ovf = lax.while_loop(
+            (it, st, fx, _n_act, n_p, n_nd, disp, ovf, fmax, fsum,
+             nfb) = lax.while_loop(
                 cond, body,
-                (jnp.asarray(1, jnp.int32), st, fx, n_p, n_nd, disp,
-                 jnp.asarray(False)),
+                (jnp.asarray(1, jnp.int32), st, fx, n_act, n_p, n_nd,
+                 disp, jnp.asarray(False), zero, zero, zero),
             )
             found_all = (n_nd == 0) & (n_p == 0)
             # `it` counts walk rounds (== migrations + 1); `disp` the
             # per-block walk dispatches summed over rounds — cheap
             # diagnostics for capacity_factor / partition quality and
-            # the gather sub-split's empty-block skip.
-            return st, fx, found_all, ovf, it, disp
+            # the gather sub-split's empty-block skip. fmax/fsum/nfb:
+            # frontier-size max/sum over migrations and the number of
+            # slab-overflow fallback rounds.
+            return st, fx, found_all, ovf, it, disp, fmax, fsum, nfb
 
         # The cascade entry point: walk+migrate rounds compile as ONE
         # program per (engine, config-key) — tests sweeping several
@@ -1368,7 +1737,172 @@ class PartitionedEngine:
         self._jit_cache[key] = phase
         return phase
 
-    def _run_phase(self, tally: bool, defer_sync: bool = False):
+    # -- profiled phase programs (component-budget instrumentation) ------
+    def _round_program(self, tally: bool):
+        """Cached jitted SINGLE walk round — the profiled driver's walk
+        section (the fused phase runs the identical round_sm inside its
+        while_loop)."""
+        key = self._phase_key("round", tally)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        has_adj = self.part.adj_int is not None
+        two_tier = self.two_tier
+        round_sm = self._make_round_sm(tally)
+
+        @jax.jit
+        def round1(table, adj, hi, state, flux, n_act):
+            st = dict(state)
+            args = (
+                (table,)
+                + ((adj,) if has_adj else ())
+                + ((hi,) if two_tier else ())
+                + (
+                    st["x"], st["lelem"], st["dest"], st["fly"],
+                    st["w"], st["done"], st["exited"], flux, n_act,
+                )
+            )
+            (x, lelem, done, exited, pending, fx, n_act, n_p, n_nd,
+             n_disp) = round_sm(*args)
+            return (
+                dict(st, x=x, lelem=lelem, done=done, exited=exited,
+                     pending=pending),
+                fx, n_act, n_p, n_nd, n_disp,
+            )
+
+        round1 = register_entry_point("partition_round", round1)
+        self._jit_cache[key] = round1
+        return round1
+
+    def _migrate_program(self):
+        """Cached jitted in-loop migration round (frontier slab or
+        full-capacity fallback) — the profiled driver's migrate
+        section."""
+        key = self._phase_key("migrate_step", False)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        part_L = self.part.L
+        nparts, cap_b = self.nparts, self.cap_per_block
+        pmethod = self.partition_method
+        cap_frontier = self.cap_frontier
+
+        @jax.jit
+        def mig(state, n_pending):
+            return _migrate_round(part_L, nparts, cap_b, cap_frontier,
+                                  pmethod, state, n_pending)
+
+        mig = register_entry_point("partition_migrate", mig)
+        self._jit_cache[key] = mig
+        return mig
+
+    def _occupancy_program(self):
+        """Cached jitted occupied-block bookkeeping — the profiled
+        driver's occupancy section (also produces the initial counts:
+        pass ``fellback=True`` to force the full scan)."""
+        key = self._phase_key("occupancy", False)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        nparts = self.nparts
+        cap_frontier = self.cap_frontier
+
+        @jax.jit
+        def occ(state, n_act, dep, arr, fellback):
+            return _update_occupancy(nparts, cap_frontier, state, n_act,
+                                     dep, arr, fellback)
+
+        occ = register_entry_point("partition_occupancy", occ)
+        self._jit_cache[key] = occ
+        return occ
+
+    def _run_phase_profiled(self, tally: bool, prof: PhaseProfile):
+        """One walk+migrate phase driven round-by-round with a fenced
+        ``phase_timer`` section per component (walk / migrate /
+        occupancy / bookkeeping), accumulating into ``prof``.
+
+        Runs the SAME round/migrate/occupancy programs the fused phase
+        inlines (``_make_round_sm``, ``_migrate_round``,
+        ``_update_occupancy``), so physics — flux included — is
+        bitwise-identical to an unprofiled phase of the same engine
+        configuration; what changes is dispatch granularity: one host
+        sync per section per round, which is the price of attributing
+        time to components (the reason this is a measurement mode and
+        the fused while_loop stays the throughput path)."""
+        prof.cap_frontier = self.cap_frontier
+        round1 = self._round_program(tally)
+        mig = self._migrate_program()
+        occp = self._occupancy_program()
+        nparts = self.nparts
+        with phase_timer(prof, "bookkeeping_s"):
+            st = dict(self.state)
+            st["done"] = ~st["alive"] | (st["fly"] == 0)
+            st["exited"] = jnp.zeros_like(st["exited"])
+            st["dest"] = jnp.where(
+                (st["fly"] == 1)[:, None], st["dest"], st["x"]
+            )
+        zero_counts = jnp.zeros((nparts,), jnp.int32)
+        with phase_timer(prof, "occupancy_s"):
+            n_act = occp(st, zero_counts, zero_counts, zero_counts,
+                         jnp.asarray(True))
+            jax.block_until_ready(n_act)
+        fx = self.flux_padded
+        tbl, adj, hi = self.part.table, self.part.adj_int, self.part.table_hi
+        with phase_timer(prof, "walk_s"):
+            st, fx, n_act, n_p, n_nd, disp = round1(
+                tbl, adj, hi, st, fx, n_act
+            )
+            n_p_h = int(n_p)  # the fetch is the fence
+        rounds = 1
+        disp_total = int(disp)
+        phase_fronts: list = []
+        phase_fallbacks = 0
+        prof.rounds += 1
+        prof.dispatches += disp_total
+        while n_p_h > 0 and rounds < self.max_rounds:
+            prof.frontier_sizes.append(n_p_h)
+            phase_fronts.append(n_p_h)
+            with phase_timer(prof, "migrate_s"):
+                st, ovf, dep, arr, fb = mig(st, n_p)
+                ovf_h = bool(ovf)  # fence; also gates the next walk
+            if ovf_h:
+                # Pre-phase engine state stays committed, like
+                # _run_phase's default path.
+                raise RuntimeError(OVERFLOW_MESSAGE)
+            if self.cap_frontier is not None and bool(fb):
+                prof.fallback_rounds += 1
+                phase_fallbacks += 1
+            with phase_timer(prof, "occupancy_s"):
+                n_act = occp(st, n_act, dep, arr, fb)
+                jax.block_until_ready(n_act)
+            with phase_timer(prof, "walk_s"):
+                st, fx, n_act, n_p, n_nd, disp = round1(
+                    tbl, adj, hi, st, fx, n_act
+                )
+                n_p_h = int(n_p)
+            rounds += 1
+            prof.rounds += 1
+            prof.dispatches += int(disp)
+            disp_total += int(disp)
+        with phase_timer(prof, "bookkeeping_s"):
+            found_all = (int(n_nd) == 0) and n_p_h == 0
+            self.state = st
+            self.flux_padded = fx
+            # The last_* diagnostics keep their "most recent phase"
+            # contract under profiling: the profiled driver already
+            # holds the host values, so the caches are set directly
+            # (no lazy device scalar to fetch).
+            self._last_rounds_dev = None
+            self._last_rounds_cache = rounds
+            self._last_disp_dev = None
+            self._last_disp_cache = disp_total
+            self._last_frontier_max_dev = None
+            self._last_frontier_max_cache = max(phase_fronts, default=0)
+            self._last_frontier_sum_dev = None
+            self._last_frontier_sum_cache = sum(phase_fronts)
+            self._last_fallback_dev = None
+            self._last_fallback_cache = phase_fallbacks
+        return bool(found_all)
+
+    def _run_phase(self, tally: bool, defer_sync: bool = False,
+                   profile: Optional[PhaseProfile] = None):
         """One jitted walk+migrate phase.
 
         Default: a single host sync at the end; returns found_all
@@ -1380,9 +1914,21 @@ class PartitionedEngine:
         (found_all, overflow) scalars and commits unconditionally — the
         caller syncs a whole batch of chunks at once and raises then;
         on overflow the state is corrupt, which is acceptable because
-        the raise abandons the run."""
+        the raise abandons the run.
+
+        ``profile`` (a ``PhaseProfile``) switches to the round-by-round
+        profiled driver — per-component fenced timing, one sync per
+        section per round (``_run_phase_profiled``); incompatible with
+        ``defer_sync``."""
+        if profile is not None:
+            if defer_sync:
+                raise ValueError(
+                    "profile= and defer_sync=True are mutually "
+                    "exclusive (profiling syncs every round)"
+                )
+            return self._run_phase_profiled(tally, profile)
         phase = self._phase_program(tally)
-        st, fx, found_all, ovf, rounds, disp = phase(
+        st, fx, found_all, ovf, rounds, disp, fmax, fsum, nfb = phase(
             self.part.table, self.part.adj_int, self.part.table_hi,
             self.state, self.flux_padded,
         )
@@ -1394,6 +1940,12 @@ class PartitionedEngine:
         self._last_rounds_cache = None
         self._last_disp_dev = disp
         self._last_disp_cache = None
+        self._last_frontier_max_dev = fmax
+        self._last_frontier_max_cache = None
+        self._last_frontier_sum_dev = fsum
+        self._last_frontier_sum_cache = None
+        self._last_fallback_dev = nfb
+        self._last_fallback_cache = None
         if defer_sync:
             self.state = st
             self.flux_padded = fx
@@ -1411,11 +1963,15 @@ class PartitionedEngine:
         fly_n: jnp.ndarray,
         w_n: jnp.ndarray,
         defer_sync: bool = False,
+        profile: Optional[PhaseProfile] = None,
     ):
         """Full (or continue-mode) tallied move.
 
         Returns found_all (bool), or with ``defer_sync=True`` the lazy
-        (found_all, overflow) pair — see ``_run_phase``."""
+        (found_all, overflow) pair — see ``_run_phase``. ``profile``
+        accumulates a per-component budget of every phase this move
+        runs into the given ``PhaseProfile`` (measurement mode — one
+        sync per section per round)."""
         if origins_n is not None and self._n_lost:
             # Revival: a resampled origin inside the mesh re-locates a
             # lost particle (mirrors the single-chip engine, where
@@ -1435,7 +1991,8 @@ class PartitionedEngine:
             st["dest"] = self._by_pid(origins_n, jnp.asarray(0.0, st["x"].dtype))
             st["w"] = jnp.zeros_like(st["w"])
             self.state = st
-            ra = self._run_phase(tally=False, defer_sync=defer_sync)
+            ra = self._run_phase(tally=False, defer_sync=defer_sync,
+                                 profile=profile)
             if defer_sync:
                 ok_a, ovf_a = ra
             else:
@@ -1447,7 +2004,8 @@ class PartitionedEngine:
             st["w"] = self._by_pid(w_n, jnp.asarray(0.0, st["w"].dtype))
         st["dest"] = self._by_pid(dests_n, jnp.asarray(0.0, st["x"].dtype))
         self.state = st
-        rb = self._run_phase(tally=True, defer_sync=defer_sync)
+        rb = self._run_phase(tally=True, defer_sync=defer_sync,
+                             profile=profile)
         if defer_sync:
             ok_b, ovf_b = rb
             ovf = ovf_b if ovf_a is None else (ovf_a | ovf_b)

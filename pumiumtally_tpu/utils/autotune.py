@@ -206,6 +206,138 @@ def autotune_walk(
     return best, report
 
 
+def block_length_candidates(
+    mesh, mean_step: float, base_bound: Optional[int] = 3072
+) -> List[int]:
+    """Block-length (``walk_vmem_max_elems``) candidates for the gather
+    sub-split, derived from the workload's mean step length.
+
+    The L-vs-mean-free-path law (the lattice's 45-round problem,
+    docs/PERF_NOTES.md): a blocked walk round ends when a particle
+    crosses a block face, so the expected migration rounds per move
+    scale with mean_step / ell, where ell = (L / density)^(1/3) is the
+    linear size of an L-element block at the mesh's element density.
+    Small blocks buy table residency (the measured 2.2-2.4M moves/s
+    small-table regime) but pay rounds; the break-even block keeps the
+    expected crossings per move near one, i.e. ell ≈ mean_step ⇒
+    L* = density · mean_step³. The candidate grid brackets L* one
+    octave each way (the law fixes the scale, not the constant — the
+    round cost vs residency trade is backend-measured, never guessed),
+    keeps the configured base bound as the incumbent, and clips to
+    [256, nelems/2] so every candidate actually sub-splits and no
+    block degenerates below a VPU-lane-scale table.
+    """
+    coords = np.asarray(mesh.coords, np.float64)
+    span = coords.max(axis=0) - coords.min(axis=0)
+    vol = float(np.prod(np.maximum(span, 1e-30)))
+    density = mesh.nelems / vol
+    l_star = density * float(mean_step) ** 3
+    lo, hi = 256, max(256, int(mesh.nelems) // 2)
+    cands = {int(np.clip(round(l_star * f), lo, hi)) for f in (0.5, 1.0, 2.0)}
+    if base_bound is not None:
+        cands.add(int(np.clip(int(base_bound), lo, hi)))
+    return sorted(cands)
+
+
+def autotune_blocked(
+    mesh,
+    n_particles: int = 100_000,
+    moves: int = 2,
+    mean_step: float = 0.25,
+    candidates: Optional[Sequence[int]] = None,
+    base: Optional[TallyConfig] = None,
+    seed: int = 0,
+    verbose: bool = False,
+    _measure=None,
+) -> Tuple[TallyConfig, List[dict]]:
+    """Measure gather-blocked engines over block-length candidates;
+    adopt a candidate ONLY when it beats the incumbent configuration.
+
+    The incumbent is ``base`` itself (its ``walk_vmem_max_elems``, or
+    the unblocked engine when unset) — swept alongside the
+    ``block_length_candidates`` grid, so the returned config can only
+    change when a candidate measured strictly faster on THIS backend
+    and workload: the law above picks the grid, the measurement picks
+    the winner, and a wash keeps the incumbent (the same
+    never-adopt-on-faith contract as ``autotune_walk``'s approximate
+    tier). Physics is unchanged by construction — block length moves
+    the walk/migrate round schedule, not the tally (the engines'
+    conservation gates apply unchanged).
+
+    Returns (config, report); report rows are
+    ``{"walk_vmem_max_elems", "moves_per_sec", ["adopted"|"incumbent"]}``
+    sorted fastest-first. ``_measure`` (tests) overrides the per-config
+    rate measurement.
+    """
+    base = base if base is not None else TallyConfig()
+    incumbent = (
+        None if base.walk_vmem_max_elems is None
+        else int(base.walk_vmem_max_elems)
+    )
+    if candidates is None:
+        candidates = block_length_candidates(
+            mesh, mean_step, base_bound=incumbent
+        )
+    bounds = list(dict.fromkeys(
+        None if b is None else int(b)
+        for b in list(candidates) + [incumbent]
+    ))
+
+    if _measure is None:
+        _measure = partial(
+            _blocked_rate, mesh, n_particles, moves, mean_step, seed
+        )
+    report = []
+    for b in bounds:
+        cfg = dataclasses.replace(
+            base, walk_vmem_max_elems=b,
+            walk_block_kernel="gather" if b is not None
+            else base.walk_block_kernel,
+        )
+        rate = _measure(cfg)
+        row = {"walk_vmem_max_elems": b, "moves_per_sec": rate}
+        if b == incumbent:
+            row["incumbent"] = True
+        report.append(row)
+        if verbose:
+            print(f"autotune_blocked: L<={b} -> {rate / 1e6:.3f}M moves/s")
+    report.sort(key=lambda r: -r["moves_per_sec"])
+    inc_rate = next(
+        r["moves_per_sec"] for r in report if r.get("incumbent")
+    )
+    best = report[0]
+    if best.get("incumbent") or best["moves_per_sec"] <= inc_rate:
+        return dataclasses.replace(base), report  # wash: keep incumbent
+    best["adopted"] = True
+    return dataclasses.replace(
+        base, walk_vmem_max_elems=best["walk_vmem_max_elems"],
+        walk_block_kernel="gather",
+    ), report
+
+
+def _blocked_rate(mesh, n: int, moves: int, mean_step: float, seed: int,
+                  cfg: TallyConfig) -> float:
+    """Continue-mode moves/s of one (possibly blocked) partitioned
+    engine on the bench-shaped workload (warmup move excluded)."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
+
+    cfg = dataclasses.replace(
+        cfg, check_found_all=False, fenced_timing=False
+    )
+    pts = _workload(mesh, n, moves, mean_step, seed)
+    t = PartitionedPumiTally(mesh, n, cfg)
+    t.CopyInitialPosition(np.asarray(pts[0]).reshape(-1).copy())
+    t.MoveToNextLocation(None, np.asarray(pts[1]).reshape(-1).copy())
+    float(jnp.sum(t.flux))  # compile + sync
+    t0 = time.perf_counter()
+    for m in range(2, moves + 2):
+        t.MoveToNextLocation(None, np.asarray(pts[m]).reshape(-1).copy())
+    float(jnp.sum(t.flux))
+    return n * moves / (time.perf_counter() - t0)
+
+
 def _drop_defaults(knobs: dict) -> dict:
     """Strip knobs whose value equals the kernel default: the returned
     config must keep ``walk_kwargs() == ()`` whenever the winner is

@@ -156,6 +156,57 @@ def test_table_precision_ab_row(bench):
     assert res["f32_moves_per_sec"] > 0 and res["bf16_moves_per_sec"] > 0
 
 
+def test_blocked_profile_row(bench, monkeypatch):
+    """The blocked_profile component-budget row: every declared field
+    present, rounds/dispatches consistent, conservation gated, and the
+    frontier stats reflect an actual crossing front (the 6^3 fixture
+    mesh with a 100-element bound forces multiple blocks and at least
+    one migration round)."""
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_BLOCK_ELEMS", "100")
+    res = bench.run_blocked_profile(bench.N, 2)
+    for key in ("walk_ms", "migrate_ms", "occupancy_ms",
+                "bookkeeping_ms", "walk_ms_per_round",
+                "migrate_ms_per_round", "occupancy_ms_per_round",
+                "rounds", "dispatches", "fallback_rounds",
+                "cap_frontier", "frontier_max", "frontier_mean",
+                "blocks_per_chip", "block_elems",
+                "conservation_rel_err"):
+        assert key in res, key
+    assert res["rounds"] >= 2  # 2 moves, >= 1 round each
+    assert res["dispatches"] >= res["rounds"]
+    assert res["walk_ms"] > 0 and res["migrate_ms"] > 0
+    assert res["blocks_per_chip"] > 1 and res["block_elems"] <= 100
+    assert res["cap_frontier"] == bench.N // 8
+    assert res["frontier_max"] >= res["frontier_mean"]
+    assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+
+
+def test_frontier_ab_row(bench):
+    """The frontier-migrate component row: both front sizes present,
+    positive timings for both arms, and the tool's slab-invariance
+    bitwise check ran (it asserts internally before timing)."""
+    res = bench.run_frontier_ab()
+    assert set(res) == {"frac_2pct", "frac_20pct"}
+    for row in res.values():
+        assert row["full_ms"] > 0 and row["frontier_ms"] > 0
+        assert row["speedup"] > 0
+        assert row["slab_invariance_bitwise"] is True
+        assert row["frontier"] <= row["cap_frontier"]
+
+
+def test_blocked_profile_cap_frontier_env(bench, monkeypatch):
+    """PUMIUMTALLY_BENCH_CAP_FRONTIER sizes the slab; 0 forces the
+    full-capacity fallback every migration round and the row records
+    those rounds honestly."""
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_BLOCK_ELEMS", "100")
+    monkeypatch.setenv("PUMIUMTALLY_BENCH_CAP_FRONTIER", "0")
+    res = bench.run_blocked_profile(bench.N, 2)
+    assert res["cap_frontier"] == 0
+    migrations = res["rounds"] - 2  # 2 moves: one walk round each
+    assert res["fallback_rounds"] == migrations
+    assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
+
+
 @pytest.mark.slow
 def test_vmem_blocked_workload(bench, monkeypatch):
     """The blocked-vmem extra metric: conserves, reports its sub-split

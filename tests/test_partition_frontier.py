@@ -1,0 +1,388 @@
+"""Frontier-local migration: parity, conservation, occupancy, profiling.
+
+The frontier slab (``TallyConfig.cap_frontier``,
+parallel/partition.py ``_frontier_migrate_impl``) makes each in-loop
+migration round move only the particles that actually paused at a
+partition/block face. The parity contract (docs/DESIGN.md):
+
+- frontier vs the FULL-CAPACITY frontier arm (a slab of ``cap`` rows):
+  bitwise identical in everything, flux included — same scatter
+  destinations for every row, whatever the slab size;
+- the overflow fallback runs today's full-capacity ``_migrate_impl``
+  bitwise: an engine whose every round falls back (cap_frontier=0, the
+  testing hook) is bitwise identical to the cap_frontier=None default;
+- frontier vs the compaction default: per-particle observables
+  (positions, elements) bitwise, conservation exact, per-element flux
+  equal to scatter-add ordering — the same documented class as
+  ``walk_perm_mode="sorted"`` (a different, equally valid slot layout).
+
+The conftest retrace tripwire wraps every test here, so the frontier
+phase programs keep the existing compile budgets by construction.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh
+from pumiumtally_tpu.parallel.partition import (
+    OVERFLOW_MESSAGE,
+    PhaseProfile,
+    _frontier_migrate_impl,
+    _migrate_impl,
+)
+
+
+def _clustered_workload(n=800, seed=21, moves=2):
+    """Corner-clustered sources/destinations on a finely blocked mesh:
+    multiple migration rounds with a small crossing front — the
+    frontier's home turf."""
+    rng = np.random.default_rng(seed)
+    src = rng.uniform(0.05, 0.30, (n, 3))
+    dsts = [rng.uniform(0.05, 0.30, (n, 3)) for _ in range(moves)]
+    return src, dsts
+
+
+def _run_blocked(cap_frontier, src, dsts, n, profile=None, bound=100,
+                 **cfg):
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    t = PartitionedPumiTally(
+        mesh, n,
+        TallyConfig(walk_vmem_max_elems=bound, walk_block_kernel="gather",
+                    capacity_factor=20.0, cap_frontier=cap_frontier,
+                    **cfg),
+    )
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    for d in dsts:
+        if profile is not None:
+            dt = t.engine.state["x"].dtype
+            t.engine.move(None, jnp.asarray(d, dt),
+                          jnp.asarray(np.ones(n, np.int8)),
+                          jnp.asarray(np.ones(n), dt), profile=profile)
+        else:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+    return t
+
+
+# -- bitwise parity: frontier slab vs full-capacity slab ----------------
+
+def test_frontier_vs_full_capacity_slab_bitwise():
+    """The same-destinations contract: a working slab and a slab of
+    cap rows (the full-capacity frontier migrate) produce bitwise
+    identical flux, positions, and elements over a multi-round
+    clustered phase — and neither round falls back."""
+    n = 800
+    src, dsts = _clustered_workload(n)
+    t_slab = _run_blocked(4096, src, dsts, n)
+    t_full = _run_blocked(10**9, src, dsts, n)  # clamps to cap
+    assert t_slab.engine.cap_frontier == 4096
+    assert t_full.engine.cap_frontier == t_full.engine.cap
+    # Sanity: the slab actually held every round's front (else this
+    # test would silently compare fallback rounds).
+    assert t_slab.engine.last_frontier_max <= 4096
+    assert t_slab.engine.last_fallback_rounds == 0
+    assert t_slab.engine.last_walk_rounds >= 2  # migrations happened
+    np.testing.assert_array_equal(
+        np.asarray(t_slab.flux), np.asarray(t_full.flux)
+    )
+    np.testing.assert_array_equal(t_slab.positions, t_full.positions)
+    np.testing.assert_array_equal(t_slab.elem_ids, t_full.elem_ids)
+
+
+def test_forced_fallback_bitwise_vs_default():
+    """cap_frontier=0 (every round overflows the slab) must reproduce
+    the cap_frontier=None default engine bitwise — the fallback IS
+    today's ``_migrate_impl``, semantics included."""
+    n = 800
+    src, dsts = _clustered_workload(n, seed=23)
+    t_zero = _run_blocked(0, src, dsts, n)
+    t_def = _run_blocked(None, src, dsts, n)
+    migrations = t_zero.engine.last_walk_rounds - 1
+    assert migrations >= 1
+    assert t_zero.engine.last_fallback_rounds == migrations
+    assert t_def.engine.last_fallback_rounds == 0  # knob off: not counted
+    np.testing.assert_array_equal(
+        np.asarray(t_zero.flux), np.asarray(t_def.flux)
+    )
+    np.testing.assert_array_equal(t_zero.positions, t_def.positions)
+    np.testing.assert_array_equal(t_zero.elem_ids, t_def.elem_ids)
+
+
+def test_frontier_vs_default_engine_and_monolithic():
+    """Frontier engine vs the compaction default: per-particle
+    observables bitwise, flux equal to scatter-order rounding (the
+    documented divergence class) — and both conserve exactly against
+    the monolithic reference."""
+    n = 800
+    src, dsts = _clustered_workload(n, seed=29)
+    t_fr = _run_blocked(4096, src, dsts, n)
+    t_def = _run_blocked(None, src, dsts, n)
+    np.testing.assert_array_equal(t_fr.positions, t_def.positions)
+    np.testing.assert_array_equal(t_fr.elem_ids, t_def.elem_ids)
+    np.testing.assert_allclose(
+        np.asarray(t_fr.flux), np.asarray(t_def.flux),
+        rtol=1e-12, atol=1e-13,
+    )
+    # Conservation + parity with the monolithic engine.
+    ref = PumiTally(build_box(1, 1, 1, 6, 6, 6), n)
+    ref.CopyInitialPosition(src.reshape(-1).copy())
+    for d in dsts:
+        ref.MoveToNextLocation(None, d.reshape(-1).copy())
+    np.testing.assert_allclose(
+        np.asarray(t_fr.flux, np.float64),
+        np.asarray(ref.flux, np.float64), rtol=1e-10, atol=1e-13,
+    )
+    want = float(np.linalg.norm(dsts[0] - src, axis=1).sum()) + sum(
+        float(np.linalg.norm(dsts[m] - dsts[m - 1], axis=1).sum())
+        for m in range(1, len(dsts))
+    )
+    got = float(np.asarray(t_fr.flux, np.float64).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_frontier_multichip_two_phase_bitwise_vs_fullslab():
+    """8-chip mesh, two-phase moves (both tally phases migrate), the
+    cascade engaged inside walk_local: frontier-vs-full-slab parity
+    holds across the whole composition."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 2000
+    rng = np.random.default_rng(5)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dst = np.clip(src + rng.normal(scale=0.2, size=(n, 3)), -0.1, 1.1)
+    out = {}
+    for label, cf in (("slab", 2048), ("full", 10**9)):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=make_device_mesh(8),
+                        capacity_factor=6.0, walk_min_window=64,
+                        cap_frontier=cf),
+        )
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(src.reshape(-1).copy(), dst.reshape(-1).copy(),
+                             np.ones(n, np.int8), np.ones(n))
+        out[label] = t
+    assert out["slab"].engine.last_fallback_rounds == 0
+    assert out["slab"].engine.last_frontier_max > 0
+    np.testing.assert_array_equal(
+        np.asarray(out["slab"].flux), np.asarray(out["full"].flux)
+    )
+    np.testing.assert_array_equal(
+        out["slab"].positions, out["full"].positions
+    )
+    np.testing.assert_array_equal(
+        out["slab"].elem_ids, out["full"].elem_ids
+    )
+
+
+def test_frontier_two_tier_bf16_tables():
+    """The bf16 two-tier walk tables compose with the frontier slab:
+    bitwise parity against the full-capacity slab arm on the same
+    tiered engine (migration never touches the tables, but the phase
+    program threads them — pin the composition)."""
+    n = 600
+    src, dsts = _clustered_workload(n, seed=31, moves=1)
+    out = {}
+    for label, cf in (("slab", 4096), ("full", 10**9)):
+        # bound=50: the bf16 tier doubles the block-element bound at
+        # constant resident bytes (block_elems_bound), so halve it to
+        # keep the mesh finely blocked enough for migrations.
+        t = _run_blocked(cf, src, dsts, n, bound=50,
+                         walk_table_dtype="bfloat16")
+        assert t.engine.two_tier
+        out[label] = t
+    assert out["slab"].engine.last_walk_rounds >= 2
+    np.testing.assert_array_equal(
+        np.asarray(out["slab"].flux), np.asarray(out["full"].flux)
+    )
+    np.testing.assert_array_equal(
+        out["slab"].positions, out["full"].positions
+    )
+    np.testing.assert_array_equal(
+        out["slab"].elem_ids, out["full"].elem_ids
+    )
+
+
+# -- migrate-impl level: stayer-fixed placement ------------------------
+
+def test_frontier_migrate_impl_moves_only_the_frontier():
+    """Direct _frontier_migrate_impl: stayers keep their slots (zero
+    row movement off the frontier), departures reset to defaults,
+    arrivals land in the target part's free slots in stable order, and
+    the overflow flag matches _migrate_impl's condition exactly."""
+    nparts, cap_b, part_L = 5, 16, 50
+    cap = nparts * cap_b
+    rng = np.random.default_rng(11)
+    # Engine-like slack (~1.5x over-provisioning): without free slots,
+    # random targets overflow some part almost surely.
+    alive = rng.uniform(size=cap) < 0.6
+    pend = np.full(cap, -1, np.int32)
+    movers = alive & (rng.uniform(size=cap) < 0.2)
+    pend[movers] = rng.integers(0, nparts * part_L, movers.sum())
+    state = {
+        "x": jnp.asarray(rng.random((cap, 3))),
+        "w": jnp.asarray(rng.random(cap)),
+        "lelem": jnp.asarray(rng.integers(0, part_L, cap), jnp.int32),
+        "pending": jnp.asarray(pend),
+        "pid": jnp.asarray(np.where(alive, np.arange(cap), -1), jnp.int32),
+        "alive": jnp.asarray(alive),
+        "done": jnp.asarray(rng.uniform(size=cap) < 0.5),
+    }
+    st, ovf, dep, arr = _frontier_migrate_impl(
+        part_L, nparts, cap_b, cap, dict(state)
+    )
+    assert not bool(ovf)
+    moving = pend >= 0
+    stay = alive & ~moving
+    # Stayers bitwise in place.
+    for k in ("x", "w", "lelem", "pid"):
+        np.testing.assert_array_equal(
+            np.asarray(st[k])[stay], np.asarray(state[k])[stay], err_msg=k
+        )
+    # Departed sources are reset to defaults unless an arrival took
+    # the slot.
+    arrived = np.asarray(st["pending"] == -1) & np.asarray(st["alive"])
+    vacated = moving & ~np.asarray(st["alive"])
+    assert np.all(np.asarray(st["pid"])[vacated] == -1)
+    # Every mover arrived somewhere in its target part's slot range.
+    tgt_counts = np.bincount(pend[moving] // part_L, minlength=nparts)
+    new_chip = np.arange(cap) // cap_b
+    moved_in = arrived & ~stay
+    got_counts = np.bincount(new_chip[moved_in], minlength=nparts)
+    np.testing.assert_array_equal(got_counts, tgt_counts)
+    # Occupancy deltas: arrivals bucketed by target, departures by
+    # source part, both totalling the frontier.
+    np.testing.assert_array_equal(np.asarray(arr), tgt_counts)
+    np.testing.assert_array_equal(
+        np.asarray(dep),
+        np.bincount(np.arange(cap)[moving] // cap_b, minlength=nparts),
+    )
+    assert int(np.asarray(dep).sum()) == int(moving.sum())
+    # Same overflow condition as the full migrate.
+    _, ovf_full = _migrate_impl(part_L, nparts, cap_b, dict(state))
+    assert bool(ovf) == bool(ovf_full)
+
+
+def test_frontier_capacity_overflow_raises_like_default():
+    """A real capacity overflow (every particle into one corner block
+    with capacity_factor ~1) raises OVERFLOW_MESSAGE through the
+    frontier path exactly as through the default."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    n = 600
+    rng = np.random.default_rng(3)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dst = rng.uniform(0.02, 0.12, (n, 3))  # converge into one corner
+    for cf in (4096, None):
+        # 1.3x headroom: enough for the spread localization (Poisson
+        # block occupancy at n/blocks ~ 46), nowhere near enough for
+        # the corner convergence.
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(walk_vmem_max_elems=100,
+                        walk_block_kernel="gather",
+                        capacity_factor=1.3, cap_frontier=cf),
+        )
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        with pytest.raises(RuntimeError,
+                           match=OVERFLOW_MESSAGE.split(";")[0]):
+            t.MoveToNextLocation(None, dst.reshape(-1).copy())
+
+
+# -- incremental occupancy ---------------------------------------------
+
+def test_incremental_occupancy_equivalence():
+    """The incremental occupied-block list (departure/arrival deltas)
+    must dispatch exactly the blocks the default engine's full done
+    scan dispatches — block membership is physics, not layout — while
+    still skipping empty blocks on a clustered workload."""
+    n = 800
+    src, dsts = _clustered_workload(n, seed=21)
+    t_fr = _run_blocked(4096, src, dsts, n)
+    t_def = _run_blocked(None, src, dsts, n)
+    blocks = t_fr.engine.nparts
+    assert blocks >= 8
+    assert t_fr.engine.last_walk_rounds == t_def.engine.last_walk_rounds
+    assert (t_fr.engine.last_block_dispatches
+            == t_def.engine.last_block_dispatches)
+    rounds = t_fr.engine.last_walk_rounds
+    disp = t_fr.engine.last_block_dispatches
+    assert disp < rounds * blocks, (disp, rounds, blocks)
+    assert disp >= rounds
+
+
+# -- diagnostics + profiled driver -------------------------------------
+
+def test_frontier_diagnostics_populated():
+    n = 800
+    src, dsts = _clustered_workload(n, seed=37, moves=1)
+    t = _run_blocked(4096, src, dsts, n)
+    eng = t.engine
+    migrations = eng.last_walk_rounds - 1
+    assert migrations >= 1
+    assert eng.last_frontier_max >= 1
+    assert 0.0 < eng.last_frontier_mean <= eng.last_frontier_max
+    assert eng.last_fallback_rounds == 0
+    # Mean * migrations == the summed fronts (int bookkeeping).
+    assert eng.last_frontier_mean * migrations == pytest.approx(
+        eng._last_frontier_sum_cache
+    )
+
+
+def test_profiled_move_bitwise_and_budget():
+    """The profiled driver (one fenced dispatch per component per
+    round) runs the same round/migrate/occupancy programs as the fused
+    phase: flux/positions bitwise vs an unprofiled engine of the same
+    config, with every budget section populated."""
+    n = 800
+    src, dsts = _clustered_workload(n, seed=41)
+    prof = PhaseProfile()
+    t_prof = _run_blocked(4096, src, dsts, n, profile=prof)
+    t_fused = _run_blocked(4096, src, dsts, n)
+    np.testing.assert_array_equal(
+        np.asarray(t_prof.flux), np.asarray(t_fused.flux)
+    )
+    np.testing.assert_array_equal(t_prof.positions, t_fused.positions)
+    assert prof.rounds >= 2
+    assert prof.dispatches >= prof.rounds
+    assert prof.walk_s > 0 and prof.migrate_s > 0
+    assert prof.occupancy_s > 0 and prof.bookkeeping_s > 0
+    assert prof.fallback_rounds == 0
+    assert len(prof.frontier_sizes) == prof.rounds - len(dsts)
+    assert prof.frontier_max == max(prof.frontier_sizes)
+    # The last_* diagnostics keep their most-recent-phase contract
+    # under profiling (same workload -> same last phase as the fused
+    # engine's).
+    assert (t_prof.engine.last_walk_rounds
+            == t_fused.engine.last_walk_rounds >= 1)
+    assert (t_prof.engine.last_block_dispatches
+            == t_fused.engine.last_block_dispatches)
+    assert (t_prof.engine.last_frontier_max
+            == t_fused.engine.last_frontier_max)
+    assert t_prof.engine.last_fallback_rounds == 0
+    d = prof.as_dict()
+    for key in ("walk_ms", "migrate_ms", "occupancy_ms", "rounds",
+                "dispatches", "frontier_max", "frontier_mean",
+                "cap_frontier", "fallback_rounds"):
+        assert key in d
+    assert d["cap_frontier"] == 4096
+
+
+def test_profile_defer_sync_mutually_exclusive():
+    n = 64
+    src, dsts = _clustered_workload(n, seed=2, moves=1)
+    t = _run_blocked(None, src, dsts, n)
+    with pytest.raises(ValueError, match="defer_sync"):
+        t.engine._run_phase(tally=True, defer_sync=True,
+                            profile=PhaseProfile())
+
+
+def test_cap_frontier_config_validation():
+    with pytest.raises(ValueError, match="cap_frontier"):
+        TallyConfig(cap_frontier=-1)
+    assert TallyConfig(cap_frontier=0).cap_frontier == 0
+    assert TallyConfig().cap_frontier is None

@@ -304,6 +304,78 @@ def test_autotune_walk_returns_valid_tuned_config():
     np.testing.assert_allclose(out[0], out[1], rtol=1e-12, atol=1e-12)
 
 
+def test_block_length_candidates_law():
+    """The L-vs-mean-free-path law: candidates scale with the cube of
+    the mean step length at fixed mesh density, bracket the matched
+    block size one octave each way, keep the incumbent, and clip to
+    [256, nelems/2]."""
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.utils.autotune import block_length_candidates
+
+    mesh = build_box(1, 1, 1, 10, 10, 10)  # 6000 tets, density 6000
+    small = block_length_candidates(mesh, 0.1, base_bound=None)
+    large = block_length_candidates(mesh, 0.5, base_bound=None)
+    # density * step^3: 6 at step 0.1 (clips to 256), 750 at 0.5.
+    assert small == [256]
+    assert large == [375, 750, 1500]
+    assert max(large) <= mesh.nelems // 2 and min(large) >= 256
+    with_base = block_length_candidates(mesh, 0.5, base_bound=3072)
+    assert 3000 in with_base  # incumbent rides along (clipped to E/2)
+
+
+def test_autotune_blocked_adopts_only_when_faster():
+    """The adoption contract: the incumbent configuration is swept
+    alongside the law's candidates and only a STRICTLY faster
+    candidate displaces it — a wash keeps the base config. Rates are
+    injected so the contract is tested, not the CPU's mood."""
+    import dataclasses
+
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.utils.autotune import autotune_blocked
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    base = TallyConfig(walk_vmem_max_elems=300,
+                       walk_block_kernel="gather",
+                       check_found_all=False)
+
+    def rates(table):
+        return lambda cfg: table[cfg.walk_vmem_max_elems]
+
+    # Candidate 128 measures faster -> adopted.
+    cfg, report = autotune_blocked(
+        mesh, candidates=[128, 256], base=base,
+        _measure=rates({128: 3e5, 256: 1e5, 300: 2e5}),
+    )
+    assert cfg.walk_vmem_max_elems == 128
+    assert cfg.walk_block_kernel == "gather"
+    assert report[0]["adopted"] and report[0]["walk_vmem_max_elems"] == 128
+    assert any(r.get("incumbent") for r in report)
+    # Incumbent fastest -> wash, base returned unchanged.
+    cfg2, report2 = autotune_blocked(
+        mesh, candidates=[128, 256], base=base,
+        _measure=rates({128: 1e5, 256: 1e5, 300: 3e5}),
+    )
+    assert cfg2 == dataclasses.replace(base)
+    assert not any(r.get("adopted") for r in report2)
+
+
+@pytest.mark.slow
+def test_autotune_blocked_real_sweep_smoke():
+    """One real (tiny) measured sweep: returns a usable config and a
+    rate for every bound including the unblocked incumbent."""
+    from pumiumtally_tpu import TallyConfig, build_box
+    from pumiumtally_tpu.utils.autotune import autotune_blocked
+
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    cfg, report = autotune_blocked(
+        mesh, n_particles=1500, moves=2, candidates=[300],
+        base=TallyConfig(check_found_all=False),
+    )
+    assert len(report) == 2  # candidate + unblocked incumbent
+    assert all(r["moves_per_sec"] > 0 for r in report)
+    assert (cfg.walk_vmem_max_elems in (None, 300))
+
+
 # ---------------------------------------------------------------------------
 # Retrace tripwire (utils/profiling.py; docs/STATIC_ANALYSIS.md)
 # ---------------------------------------------------------------------------

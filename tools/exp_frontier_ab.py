@@ -1,0 +1,262 @@
+"""A/B: frontier-slab vs full-capacity in-loop migration, at bench scale.
+
+The blocked engine's phase loop used to pay a FULL-CAPACITY migrate
+round (an (nparts+1)-bucket counting rank over every slot plus two
+packed full-capacity scatters) every walk/migrate round — 45 rounds on
+the 1M-tet lattice smoke run — even when the crossing front was a
+handful of particles. parallel/partition.py's frontier slab
+(`TallyConfig.cap_frontier`) moves only the pending rows; this tool
+measures both arms on the CURRENT backend:
+
+1. ``migrate_round_frontier`` — one synthetic in-loop migration round
+   at the headline capacity (nparts=16 like the blocked bench), swept
+   over frontier fractions: full ``_migrate_impl`` ms vs
+   ``_frontier_migrate_impl`` ms. Slab-size invariance is asserted
+   bitwise before timing (slab=cap_frontier vs slab=cap produce the
+   identical state — the same-destinations contract).
+2. ``engine_frontier`` — end-to-end: the gather-blocked engine on the
+   bench box workload with cap_frontier OFF vs ON (slab self-sized to
+   the measured ``last_frontier_max``, so no round falls back), rates
+   interleaved. Per-particle observables are asserted bitwise equal
+   between the arms; flux agreement is scatter-order-only
+   (docs/DESIGN.md frontier invariant).
+
+``--profile`` instead emits the blocked component-budget row
+(bench.run_blocked_profile) — per-round walk/migrate/occupancy ms,
+rounds, dispatches, frontier max/mean — for the r6 chip window.
+
+Each row prints one JSON line. The honest contract from PR 1 applies:
+record a wash as a wash — the thesis is that the CHIP pays the
+full-capacity rank+scatter per block per round, CPU numbers are the
+armed bet's receipt, not its proof.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/exp_frontier_ab.py [--quick|--profile]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N = int(os.environ.get("PUMIUMTALLY_AB_N", 500_000))
+NPARTS = int(os.environ.get("PUMIUMTALLY_AB_NPARTS", 16))
+REPS = int(os.environ.get("PUMIUMTALLY_AB_REPS", 5))
+
+
+def _timed(fn, *args, reps: int = REPS) -> float:
+    """Median wall seconds of a jitted fn; forces a value fetch (the
+    only real sync on the lazy remote backends — PERF_NOTES r1 §5)."""
+    import jax.numpy as jnp
+
+    def once():
+        out = fn(*args)
+        leaf = out[0] if isinstance(out, tuple) else out
+        if isinstance(leaf, dict):
+            leaf = leaf["x"]
+        float(jnp.sum(leaf))
+
+    once()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        once()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _synthetic_state(cap: int, nparts: int, part_L: int, frac: float,
+                     seed: int = 7) -> dict:
+    """An in-loop-shaped state: ~2/3 of the slots alive (the engine's
+    1.5x capacity_factor headroom — without slack, random migration
+    targets overflow some part almost surely), a ``frac`` fraction of
+    them paused at a partition face (pending = a random remote glid)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    alive = rng.uniform(size=cap) < 1 / 1.5
+    pend = np.full(cap, -1, np.int32)
+    movers = alive & (rng.uniform(size=cap) < frac)
+    pend[movers] = rng.integers(0, nparts * part_L, movers.sum())
+    return {
+        "x": jnp.asarray(rng.random((cap, 3))),
+        "dest": jnp.asarray(rng.random((cap, 3))),
+        "w": jnp.asarray(rng.random(cap)),
+        "lelem": jnp.asarray(rng.integers(0, part_L, cap), jnp.int32),
+        "pending": jnp.asarray(pend),
+        "pid": jnp.asarray(
+            np.where(alive, np.arange(cap), -1), jnp.int32
+        ),
+        "alive": jnp.asarray(alive),
+        "done": jnp.asarray(~movers),
+        "exited": jnp.zeros((cap,), bool),
+        "lost": jnp.zeros((cap,), bool),
+        "fly": jnp.asarray(alive.astype(np.int8)),
+    }
+
+
+def bench_migrate_round(n: int = N, nparts: int = NPARTS,
+                        frac: float = 0.02) -> dict:
+    """One in-loop migration round, full-capacity vs frontier slab."""
+    import jax
+
+    from pumiumtally_tpu.parallel.partition import (
+        _frontier_migrate_impl,
+        _migrate_impl,
+    )
+
+    part_L = 4096
+    cap_b = int(n // nparts * 1.5)
+    cap = nparts * cap_b
+    state = _synthetic_state(cap, nparts, part_L, frac)
+    n_move = int(np.asarray(state["pending"] >= 0).sum())
+    # Static slab: the smallest power of two holding this front (what
+    # a deployment would configure from last_frontier_max).
+    cap_frontier = 1 << max(1, (n_move - 1)).bit_length()
+
+    @jax.jit
+    def full(st):
+        return _migrate_impl(part_L, nparts, cap_b, st)
+
+    def frontier(k):
+        @jax.jit
+        def f(st):
+            return _frontier_migrate_impl(part_L, nparts, cap_b, k, st)
+
+        return f
+
+    # Slab-size invariance (the same-destinations contract): the
+    # working slab and the full-capacity slab must produce the
+    # bitwise-identical state.
+    a = frontier(cap_frontier)(state)
+    b = frontier(cap)(state)
+    assert not bool(a[1]) and not bool(b[1]), "unexpected overflow"
+    for k in state:
+        assert np.array_equal(np.asarray(a[0][k]), np.asarray(b[0][k])), (
+            f"frontier slab-size divergence in {k!r}"
+        )
+    t_full = _timed(full, state)
+    t_frontier = _timed(frontier(cap_frontier), state)
+    return {
+        "row": "migrate_round_frontier", "cap": cap, "nparts": nparts,
+        "frontier": n_move, "frontier_frac": n_move / cap,
+        "cap_frontier": cap_frontier,
+        "full_ms": t_full * 1e3, "frontier_ms": t_frontier * 1e3,
+        "speedup": t_full / t_frontier,
+        "slab_invariance_bitwise": True,
+    }
+
+
+def bench_engine(n: int, div: int = 20, moves: int = 4) -> dict:
+    """End-to-end gather-blocked engine, cap_frontier off vs on."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+
+    import bench  # the canonical workload generator — one convention
+
+    bound = int(os.environ.get("PUMIUMTALLY_BENCH_BLOCK_ELEMS", 3072))
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(0)
+    pts = bench.make_trajectory(rng, n, moves + 1)
+
+    def build(cap_frontier):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(capacity_factor=2.0, walk_vmem_max_elems=bound,
+                        walk_block_kernel="gather",
+                        cap_frontier=cap_frontier,
+                        check_found_all=False, fenced_timing=False),
+        )
+        t.CopyInitialPosition(pts[0].reshape(-1).copy())
+        t.MoveToNextLocation(None, pts[1].reshape(-1).copy())  # warmup
+        float(jnp.sum(t.flux))
+        return t
+
+    t_off = build(None)
+    # Self-size the slab from the measured front: no fallback rounds,
+    # the pure frontier arm. Recorded in the row.
+    front_max = t_off.engine.last_frontier_max
+    cap_frontier = 1 << max(1, (max(front_max, 1) * 2 - 1)).bit_length()
+    t_on = build(cap_frontier)
+
+    def run(t):
+        t0 = time.perf_counter()
+        for m in range(2, moves + 2):
+            t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+        float(jnp.sum(t.flux))
+        return n * moves / (time.perf_counter() - t0)
+
+    # Interleaved trials, best-of (the exp_partition_ab ramp lesson).
+    rates = {"off": [], "on": []}
+    for _ in range(3):
+        rates["off"].append(run(t_off))
+        rates["on"].append(run(t_on))
+    # Per-particle observables must agree bitwise between the arms;
+    # flux agreement is scatter-order-only (different but equally
+    # valid slot layouts — docs/DESIGN.md), so the tolerance is a few
+    # ulps of the WORKING dtype (this tool runs f32 by default).
+    np.testing.assert_array_equal(t_on.positions, t_off.positions)
+    np.testing.assert_array_equal(t_on.elem_ids, t_off.elem_ids)
+    f_on = np.asarray(t_on.flux, np.float64)
+    f_off = np.asarray(t_off.flux, np.float64)
+    rtol = 1e-12 if np.asarray(t_on.flux).dtype == np.float64 else 2e-6
+    np.testing.assert_allclose(f_on, f_off, rtol=rtol, atol=rtol)
+    r_off, r_on = max(rates["off"]), max(rates["on"])
+    return {
+        "row": "engine_frontier", "n": n, "mesh_tets": mesh.nelems,
+        "blocks": t_off.engine.nparts, "cap": t_off.engine.cap,
+        "cap_frontier": cap_frontier,
+        "frontier_max": t_on.engine.last_frontier_max,
+        "frontier_mean": t_on.engine.last_frontier_mean,
+        "fallback_rounds": t_on.engine.last_fallback_rounds,
+        "walk_rounds_last_move": t_on.engine.last_walk_rounds,
+        "off_moves_per_sec": r_off, "on_moves_per_sec": r_on,
+        "speedup": r_on / r_off,
+        "positions_elems_bitwise": True,
+    }
+
+
+def run_all(n: int = N, nparts: int = NPARTS,
+            engine_n: int | None = None) -> list:
+    return [
+        bench_migrate_round(n, nparts, frac=0.02),
+        bench_migrate_round(n, nparts, frac=0.20),
+        bench_engine(engine_n if engine_n is not None else min(n, 200_000)),
+    ]
+
+
+def main() -> None:
+    import jax
+
+    from pumiumtally_tpu.utils.chiplock import chip_lock
+
+    quick = "--quick" in sys.argv
+    profile = "--profile" in sys.argv
+    n = 50_000 if quick else N
+    on_cpu = jax.default_backend() == "cpu"
+    with chip_lock(timeout_s=None, blocking=not on_cpu) as held:
+        if not on_cpu and not held:
+            print("# chip lock busy; measuring anyway", file=sys.stderr)
+        print(f"# backend: {jax.default_backend()}", file=sys.stderr)
+        if profile:
+            import bench
+
+            row = bench.run_blocked_profile(min(n, 200_000), 3)
+            row["row"] = "blocked_profile"
+            print(json.dumps(row))
+            return
+        for row in run_all(n, NPARTS, engine_n=n if quick else None):
+            print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

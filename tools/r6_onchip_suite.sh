@@ -60,6 +60,14 @@ run table_ab   1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python t
 # against bench_clean's f32 gather_blocked row.
 run table_ab_blocked 1800 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_REDISTRIBUTION=0 PUMIUMTALLY_WALK_TABLE_DTYPE=bfloat16 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
 run blocked    3300 python tools/exp_r5_blocked.py 500000 4
+# Frontier-local migration (PR 4): the in-loop migrate A/B (full
+# capacity vs frontier slab, synthetic + end-to-end) and the blocked
+# engine's per-component budget (walk/migrate/occupancy ms per round,
+# frontier max/mean) — the "measured component budget + one landed
+# optimization" VERDICT r5 item 2 asked for, captured without an
+# interactive session.
+run frontier_ab     1800 python tools/exp_frontier_ab.py
+run blocked_profile 1500 python tools/exp_frontier_ab.py --profile
 run native     1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
 # Chipless-certified compiles go last (wedge suspects): the vmem
 # kernel sweep, now also asserting the PROJECTED bf16 select-tier
