@@ -437,6 +437,28 @@ def run_table_precision_ab() -> dict | None:
     )
 
 
+def run_batch_stats() -> dict | None:
+    """Component row: the batch-statistics subsystem's cost and its
+    trigger behavior (tools/exp_stats_ab.py run_ab) — stats-on vs
+    stats-off rates on the identical workload (flux parity asserted
+    bitwise inside the tool), the fenced per-close cost of the lane
+    update and of the full close+trigger evaluation (one scalar D2H),
+    and the convergence trace (monotone relative-error decay, trigger
+    fire point, 1/sqrt(N) batches-remaining projection). The row's
+    ``compiles.timed == 0`` is the close-batch/trigger-eval
+    compiles-healthy contract (both entry points compile once, in the
+    warmup batches). Reduced shape (100k particles) like the other
+    component rows; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_stats_ab
+
+    return exp_stats_ab.run_ab(
+        n=min(N, 100_000), div=MESH_DIV, moves=2, batches=10
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -836,6 +858,12 @@ def _measure_and_report() -> None:
             frontier = run_frontier_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# frontier A/B failed: {e}", file=sys.stderr)
+    batch_stats = None
+    if os.environ.get("PUMIUMTALLY_BENCH_BATCH_STATS", "1") != "0":
+        try:
+            batch_stats = run_batch_stats()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# batch-stats A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -962,6 +990,11 @@ def _measure_and_report() -> None:
         # crossing-front sizes (speedup > 1 = the slab wins at that
         # front on this backend; honest in both regimes).
         "frontier_migrate": frontier,
+        # Batch-statistics subsystem cost + trigger behavior: stats-on
+        # vs stats-off rates (flux parity bitwise), fenced per-close
+        # lane-update/trigger ms, convergence trace, and the
+        # compiles-healthy contract (compiles.timed == 0).
+        "batch_stats": batch_stats,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

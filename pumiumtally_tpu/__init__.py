@@ -28,6 +28,7 @@ from pumiumtally_tpu.mesh.pincell import build_lattice, build_pincell
 from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
+from pumiumtally_tpu.stats import BatchStatistics, TriggerResult, TriggerSpec
 
 __version__ = "0.1.0"
 
@@ -42,4 +43,7 @@ __all__ = [
     "StreamingPartitionedTally",
     "StreamingTally",
     "TallyTimes",
+    "BatchStatistics",
+    "TriggerResult",
+    "TriggerSpec",
 ]

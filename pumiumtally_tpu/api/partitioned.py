@@ -129,6 +129,10 @@ class PartitionedPumiTally(PumiTally):
                 "flux": np.asarray(self.normalized_flux()),
                 "volume": np.asarray(self.mesh.volumes),
                 "owner": owner.astype(np.float64),
+                # Same optional statistics payload as the monolithic
+                # writer (flux_mean / rel_err), split per piece like
+                # every other cell array.
+                **self._stats_vtk_cell_data(),
             },
             nparts=int(self.device_mesh.devices.size),
         )

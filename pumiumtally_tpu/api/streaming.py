@@ -179,6 +179,7 @@ class StreamingTally(PumiTally):
     # -- the three-call protocol -----------------------------------------
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
         t0 = time.perf_counter()
+        self._stats_roll_batch()  # each sourcing opens a new batch
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
@@ -296,6 +297,7 @@ class StreamingTally(PumiTally):
                 dests_h, size, what=None)
             self._last_dests_dev = dest_chunks
         self.iter_count += 1
+        self._stats_note_move()
         self._after_chunk_dispatch()
         if self.config.check_found_all and not all(bool(o) for o in oks):
             print("ERROR: Not all particles are found. May need more loops in search")

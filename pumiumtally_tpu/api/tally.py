@@ -393,6 +393,15 @@ class PumiTally:
         self._last_weights_dev = None
         self.auto_continue_hits = 0  # diagnostic: moves that skipped the origin upload
         self._echo_misses = 0  # consecutive non-echo moves (see _origins_echo_raw)
+        # Batch statistics (TallyConfig.batch_stats): an accumulator
+        # over the caller-visible [E] flux, or None (default — then no
+        # stats code runs anywhere in the protocol path and the engine
+        # is bitwise identical to a stats-less build).
+        self._stats = None
+        if self.config.batch_stats:
+            from pumiumtally_tpu.stats import BatchAccumulator
+
+            self._stats = BatchAccumulator(mesh.nelems, self.dtype)
         return mesh
 
     def _cached_ones(self, kind: str) -> jnp.ndarray:
@@ -499,12 +508,88 @@ class PumiTally:
             return a
         return jnp.concatenate([a, fill[self.num_particles :]], axis=0)
 
+    # -- batch statistics (TallyConfig.batch_stats) ----------------------
+    def _stats_roll_batch(self) -> None:
+        """Batch boundary hook: every ``CopyInitialPosition`` closes
+        the open source batch (if any moves landed in it) and opens a
+        new one at the current flux. No-op with stats disabled."""
+        if self._stats is not None:
+            self._stats.close(self.flux, reopen=True)
+
+    def _stats_note_move(self) -> None:
+        if self._stats is not None:
+            self._stats.note_move()
+
+    def _require_stats(self):
+        if self._stats is None:
+            raise RuntimeError(
+                "batch statistics are disabled; construct the tally "
+                "with TallyConfig(batch_stats=True)"
+            )
+        return self._stats
+
+    def _stats_elapsed(self) -> Optional[float]:
+        """Transport seconds for the figure of merit (TallyTimes'
+        fenced accumulation); None before any move completes."""
+        t = self.tally_times.total_time_to_tally
+        return t if t > 0.0 else None
+
+    def close_batch(self, trigger=None):
+        """Close the open source batch into the statistics lanes and
+        open the next one (one jitted [E] lane update, no host sync).
+
+        When a ``stats.TriggerSpec`` is passed — or
+        ``TallyConfig.batch_stats_trigger`` is set — the trigger is
+        evaluated right after the close (one jitted reduction + a
+        single scalar D2H) and its ``TriggerResult`` returned: the
+        stop decision for a driver loop
+        (``if result.converged: break``), plus a 1/sqrt(N)-law
+        estimate of the batches remaining. Returns None when no
+        trigger spec is available. A batch with zero moves closes as
+        a no-op (an empty batch is not a sample)."""
+        stats = self._require_stats()
+        stats.close(self.flux, reopen=True)
+        spec = (
+            trigger if trigger is not None
+            else self.config.batch_stats_trigger
+        )
+        if spec is None:
+            return None
+        from pumiumtally_tpu.stats.triggers import evaluate_trigger
+
+        return evaluate_trigger(stats, spec)
+
+    def finalize(self):
+        """Close the open batch WITHOUT opening another and return the
+        final ``BatchStatistics``. Moves after ``finalize()`` are not
+        attributed to any batch until the next ``CopyInitialPosition``
+        (or ``close_batch``) opens one."""
+        stats = self._require_stats()
+        stats.close(self.flux, reopen=False)
+        return self.batch_statistics()
+
+    def batch_statistics(self):
+        """Current ``stats.BatchStatistics`` view (closed batches
+        only — an open batch contributes nothing until it closes).
+        Needs >= 1 closed batch for ``mean`` and >= 2 for the
+        variance-derived fields."""
+        from pumiumtally_tpu.stats import BatchStatistics
+
+        stats = self._require_stats()
+        return BatchStatistics(
+            flux_sum=stats.flux_sum,
+            flux_sq_sum=stats.flux_sq_sum,
+            num_batches=stats.num_batches,
+            elapsed_seconds=self._stats_elapsed(),
+        )
+
     # -- the three-call protocol ----------------------------------------
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
         """Localize particles to the host app's sampled source points
         (reference PumiTally.h:66-67; non-tallying initial search,
         PumiTallyImpl.cpp:54-64)."""
         t0 = time.perf_counter()
+        self._stats_roll_batch()  # each sourcing opens a new batch
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
@@ -706,6 +791,7 @@ class PumiTally:
             self._last_dests_host = dests_host
             self._last_dests_dev = dests
         self.iter_count += 1
+        self._stats_note_move()
         if self.config.check_found_all and not bool(found_all):
             print("ERROR: Not all particles are found. May need more loops in search")
         if self.config.fenced_timing:
@@ -754,9 +840,25 @@ class PumiTally:
         )
         return found_all
 
+    def _stats_vtk_cell_data(self) -> dict:
+        """Optional flux_mean/rel_err cell arrays for the VTK payload
+        (io.vtk.stats_cell_data): empty with stats disabled or no
+        closed batch, so the default file matches the reference's
+        flux+volume layout exactly."""
+        from pumiumtally_tpu.io.vtk import stats_cell_data
+
+        if self._stats is None or self._stats.num_batches < 1:
+            return {}
+        return stats_cell_data(
+            self.batch_statistics(), np.asarray(self.mesh.volumes)
+        )
+
     def WriteTallyResults(self, filename: Optional[str] = None) -> None:
         """Normalize flux by element volume and write VTK
-        (reference PumiTallyImpl.cpp:151-157, 382-416)."""
+        (reference PumiTallyImpl.cpp:151-157, 382-416). With batch
+        statistics enabled and >= 1 closed batch, ``flux_mean`` and
+        (from 2 batches) ``rel_err`` cell arrays ride beside the
+        reference's flux+volume payload."""
         t0 = time.perf_counter()
         out = filename or self.config.output_filename
         normalized = self.normalized_flux()
@@ -767,6 +869,7 @@ class PumiTally:
             cell_data={
                 "flux": np.asarray(normalized),
                 "volume": np.asarray(self.mesh.volumes),
+                **self._stats_vtk_cell_data(),
             },
         )
         self.tally_times.vtk_file_write_time += time.perf_counter() - t0

@@ -48,7 +48,10 @@ def default_float_dtype() -> Any:
 # - "cascade_phase"/"partition_locate" (parallel/partition.py): one
 #   jitted phase per (engine, config-key), summed under one name;
 #   measured max 4 — blocked-vs-unblocked multichip comparisons build
-#   four engine configs back to back.
+#   four engine configs back to back — raised to a measured max 6 in
+#   r7: the batch-statistics parity tests (tests/test_stats.py) drive
+#   a stats-on and a stats-off partitioned engine back to back (no
+#   shared jit cache across facades; 2 engines x 3 phase programs).
 # - "sharded_*" (parallel/sharded.py): measured max 2 (device-count +
 #   chunk-shape sweeps).
 RETRACE_BUDGETS: dict = {
@@ -57,7 +60,7 @@ RETRACE_BUDGETS: dict = {
     "locate": 2,
     "localize": 4,
     "partition_locate": 3,
-    "cascade_phase": 5,
+    "cascade_phase": 7,
     # Profiled-phase programs (parallel/partition.py component-budget
     # instrumentation): one jitted single-round program per
     # (engine, tally) — a profiled two-phase move drives both tally
@@ -71,6 +74,15 @@ RETRACE_BUDGETS: dict = {
     "sharded_walk_continue": 2,
     "sharded_locate": 2,
     "sharded_localize": 3,
+    # Batch-statistics entry points (pumiumtally_tpu/stats): one
+    # compile per (E, dtype) for the close-batch lane update and one
+    # per (E, dtype, metric, quantile) for the trigger reduction —
+    # num_batches is a TRACED scalar precisely so the per-batch count
+    # never enters the cache key. Measured tier-1 max 2 each (the
+    # cross-engine equivalence tests drive two mesh shapes; the
+    # trigger tests sweep two metric/quantile keys) + 1 headroom.
+    "close_batch": 3,
+    "trigger_eval": 3,
 }
 
 
@@ -297,6 +309,28 @@ class TallyConfig:
     #              supported, bitwise-comparable semantics to the
     #              unblocked partitioned walk.
     walk_block_kernel: str = "vmem"
+    # Batch statistics (pumiumtally_tpu/stats, docs/DESIGN.md "Batch
+    # statistics"): when True, every facade keeps two extra [E] device
+    # lanes (per-batch flux sum and sum of squares, original element
+    # order) updated at batch close, exposes per-element mean / sample
+    # std dev / relative error / figure of merit via
+    # ``batch_statistics()``, and evaluates ``batch_stats_trigger``
+    # (or a spec passed to ``close_batch``) at each batch close as one
+    # jitted reduction + a single scalar D2H. Batch boundaries: each
+    # ``CopyInitialPosition`` opens a new source batch (closing the
+    # previous one), and ``close_batch()`` / ``finalize()`` close one
+    # explicitly. Off (default): no lanes are allocated and every
+    # engine is bitwise identical to a stats-less build (pinned by
+    # tests/test_stats.py). Statistics lanes ride checkpoints
+    # (utils/checkpoint.py format v3), and ``WriteTallyResults`` adds
+    # cell arrays beside the flux+volume payload: ``flux_mean`` from
+    # 1 closed batch, ``rel_err`` from 2 (the sample variance needs
+    # them).
+    batch_stats: bool = False
+    # Default TriggerSpec (stats.triggers) that ``close_batch()``
+    # evaluates when the caller passes none; None = close_batch
+    # returns no verdict unless handed a spec.
+    batch_stats_trigger: Optional[Any] = None
     # Debug surface (reference getIntersectionPoints(),
     # PumiTallyImpl.h:177-178): when True the monolithic facade keeps
     # the staged inputs of the last move so
@@ -372,6 +406,19 @@ class TallyConfig:
                 "walk_block_kernel must be 'vmem' or 'gather', "
                 f"got {self.walk_block_kernel!r}"
             )
+        if self.batch_stats_trigger is not None:
+            from pumiumtally_tpu.stats.triggers import TriggerSpec
+
+            if not isinstance(self.batch_stats_trigger, TriggerSpec):
+                raise ValueError(
+                    "batch_stats_trigger must be a stats.TriggerSpec, "
+                    f"got {self.batch_stats_trigger!r}"
+                )
+            if not self.batch_stats:
+                raise ValueError(
+                    "batch_stats_trigger needs batch_stats=True (no "
+                    "lanes are accumulated otherwise)"
+                )
         if self.cap_frontier is not None and int(self.cap_frontier) < 0:
             raise ValueError(
                 f"cap_frontier must be >= 0 (0 = forced full-capacity "

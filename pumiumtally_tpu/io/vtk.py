@@ -50,6 +50,34 @@ def _check_len(name: str, arr: np.ndarray, n: int, kind: str) -> np.ndarray:
     return arr
 
 
+def stats_cell_data(stats, volumes: np.ndarray) -> Dict[str, np.ndarray]:
+    """Optional batch-statistics cell arrays for the tally writers
+    (``stats`` is a ``pumiumtally_tpu.stats.BatchStatistics``):
+
+    - ``flux_mean``: per-batch mean flux, volume-normalized exactly
+      like the ``flux`` array (so flux == flux_mean * num_batches for
+      a run whose batches all closed) — present from 1 closed batch;
+    - ``rel_err``: relative error of the mean (dimensionless;
+      volume normalization cancels) — present from 2 closed batches
+      (the sample variance needs them). Unscored elements (zero mean,
+      estimator ``inf``) write 0.0: the OpenMC statepoint convention,
+      and a file of infs breaks most readers' color mapping.
+
+    Returns {} when stats is None or has no closed batch, keeping the
+    default payload byte-identical to the reference's flux+volume
+    layout.
+    """
+    out: Dict[str, np.ndarray] = {}
+    if stats is None or stats.num_batches < 1:
+        return out
+    vol = np.asarray(volumes, dtype=np.float64)
+    out["flux_mean"] = np.asarray(stats.mean, dtype=np.float64) / vol
+    if stats.num_batches >= 2:
+        re = np.asarray(stats.rel_err, dtype=np.float64)
+        out["rel_err"] = np.where(np.isfinite(re), re, 0.0)
+    return out
+
+
 def write_vtk(
     path: str,
     coords: np.ndarray,

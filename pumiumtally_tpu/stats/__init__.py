@@ -1,0 +1,45 @@
+"""Batch statistics: on-device accumulators, estimators, triggers.
+
+The reference tallies a single mean-flux lane and stops there —
+``WriteTallyResults`` normalizes by element volume and writes one
+scalar field (reference PumiTallyImpl.cpp:411-416), so a user cannot
+tell a converged tally from noise. Production MC codes (OpenMC, the
+host app this library's protocol serves) treat per-batch sum /
+sum-of-squares accumulation, relative error, and trigger-based
+stopping as core tally capability. This package adds that layer ON TOP
+of every engine facade, without touching the transport hot path:
+
+- ``accumulators.BatchAccumulator`` — two extra ``[E]`` device lanes
+  (``flux_sum``, ``flux_sq_sum``) updated at batch close from the
+  engine's in-flight flux lane (one jitted elementwise update, entry
+  point ``close_batch``);
+- ``estimators`` — per-element mean, sample standard deviation,
+  relative error of the mean, figure of merit;
+- ``triggers`` — ``TriggerSpec`` evaluated at batch close as one
+  jitted reduction (entry point ``trigger_eval``) + a single scalar
+  D2H, returning converged/not plus a 1/sqrt(N)-law estimate of the
+  batches remaining.
+
+Batch boundaries: each ``CopyInitialPosition`` call opens a new source
+batch (closing the previous one, if any moves landed in it); the
+facade's ``close_batch()`` / ``finalize()`` close one explicitly.
+With statistics disabled (the default) the facades never construct any
+of this and every engine is bitwise identical to a stats-less build —
+pinned by tests/test_stats.py.
+"""
+
+from pumiumtally_tpu.stats.accumulators import BatchAccumulator
+from pumiumtally_tpu.stats.estimators import BatchStatistics
+from pumiumtally_tpu.stats.triggers import (
+    TriggerResult,
+    TriggerSpec,
+    evaluate_trigger,
+)
+
+__all__ = [
+    "BatchAccumulator",
+    "BatchStatistics",
+    "TriggerResult",
+    "TriggerSpec",
+    "evaluate_trigger",
+]

@@ -1,0 +1,117 @@
+"""On-device (sum, sum-of-squares) batch accumulation.
+
+One ``BatchAccumulator`` rides on each stats-enabled facade. It owns
+two extra ``[E]`` device lanes in original element order — the
+caller-visible layout every engine's ``flux`` property already
+produces, so the partitioned engines' block-local flux reduces through
+the exact scatter-order class already pinned for flux before it ever
+reaches these lanes — plus the host-side batch counter.
+
+A batch's contribution is the CHANGE in accumulated flux across the
+batch: ``open`` snapshots the engine flux, ``close`` computes
+``delta = flux_now - flux_open`` and folds ``(delta, delta^2)`` into
+the lanes with one jitted elementwise update (entry point
+``close_batch``: one compile per (E, dtype), retrace-budgeted like
+every engine entry point). No device->host transfer happens here at
+all — the only per-close D2H in the subsystem is the trigger
+evaluation's single scalar (see ``triggers``).
+
+An empty batch (zero moves since open) is NOT a sample: closing it
+leaves the lanes and counter untouched. Counting it would fold a
+structural zero into the variance and silently bias the relative
+error low.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+@jax.jit
+def _close_batch_update(flux_sum, flux_sq_sum, flux_now, flux_open):
+    delta = flux_now - flux_open
+    return flux_sum + delta, flux_sq_sum + delta * delta
+
+
+# Rebind, not a bare call: only calls through the counting wrapper are
+# counted (utils/profiling.register_entry_point).
+_close_batch_update = register_entry_point(
+    "close_batch", _close_batch_update
+)
+
+
+class BatchAccumulator:
+    """Streaming per-batch (sum, sum-of-squares) over the ``[E]`` flux.
+
+    Lifecycle: ``close(flux, reopen=True)`` at every batch boundary —
+    ``CopyInitialPosition`` and the facade's ``close_batch()`` both
+    roll batches through it; ``finalize`` passes ``reopen=False``. The
+    lanes live in the engine's working dtype (mixing dtypes would
+    force a cast per close; an f32 engine accepts the f32 rounding in
+    its statistics exactly as it does in its flux).
+    """
+
+    def __init__(self, nelems: int, dtype: Any):
+        self.nelems = int(nelems)
+        self.dtype = dtype
+        self.flux_sum = jnp.zeros((self.nelems,), dtype)
+        self.flux_sq_sum = jnp.zeros((self.nelems,), dtype)
+        self.num_batches = 0
+        self.moves_in_batch = 0
+        # Engine flux at batch open; None = no batch open (fresh
+        # accumulator, or after finalize).
+        self.open_flux: Optional[jnp.ndarray] = None
+
+    @property
+    def batch_open(self) -> bool:
+        return self.open_flux is not None
+
+    def note_move(self) -> None:
+        if self.open_flux is not None:
+            self.moves_in_batch += 1
+
+    def close(self, flux: jnp.ndarray, reopen: bool = True) -> None:
+        """Fold the open batch's flux delta into the lanes (no-op when
+        no batch is open or no move landed in it), then open the next
+        batch at ``flux`` (``reopen=True``) or leave none open."""
+        if self.open_flux is not None and self.moves_in_batch > 0:
+            self.flux_sum, self.flux_sq_sum = _close_batch_update(
+                self.flux_sum, self.flux_sq_sum, flux, self.open_flux
+            )
+            self.num_batches += 1
+        self.open_flux = flux if reopen else None
+        self.moves_in_batch = 0
+
+    # -- checkpoint surface (utils/checkpoint.py) ------------------------
+    def reset(self, open_flux: Optional[jnp.ndarray]) -> None:
+        """Zero the lanes and counters; open a batch at ``open_flux``
+        (the restored engine flux) so a resumed run's next close
+        measures the right delta. The pre-stats-checkpoint restore
+        path."""
+        self.flux_sum = jnp.zeros((self.nelems,), self.dtype)
+        self.flux_sq_sum = jnp.zeros((self.nelems,), self.dtype)
+        self.num_batches = 0
+        self.moves_in_batch = 0
+        self.open_flux = open_flux
+
+    def restore(
+        self,
+        flux_sum,
+        flux_sq_sum,
+        num_batches: int,
+        moves_in_batch: int,
+        open_flux,
+    ) -> None:
+        """Exact state restore (stats-carrying checkpoint)."""
+        self.flux_sum = jnp.asarray(flux_sum, self.dtype)
+        self.flux_sq_sum = jnp.asarray(flux_sq_sum, self.dtype)
+        self.num_batches = int(num_batches)
+        self.moves_in_batch = int(moves_in_batch)
+        self.open_flux = (
+            None if open_flux is None else jnp.asarray(open_flux, self.dtype)
+        )

@@ -1,0 +1,134 @@
+"""Derived per-element statistics from the (sum, sum-of-squares) lanes.
+
+The estimators follow the standard MC tally conventions (OpenMC's
+tally arithmetic, which feeds this library its particles): with x_i the
+per-element flux contribution of batch i and N closed batches,
+
+  mean       = (1/N) sum x_i
+  sample var = (sum x_i^2 / N - mean^2) * N / (N - 1)
+  rel_err    = sqrt(var / N) / |mean|      (std error of the mean,
+                                            relative)
+  FOM        = 1 / (rel_err^2 * t)         (figure of merit; t =
+                                            transport seconds)
+
+Elements with exactly-zero mean ("unscored": no track ever crossed
+them, or exact cancellation) have no defined relative error; these
+report ``inf`` so a threshold comparison can never mistake them for
+converged. Net-NEGATIVE elements (negative-weight workloads) are
+scored normally via |mean|. The VTK output path maps the infs to 0.0
+(a file full of infs renders as garbage).
+
+These functions run on the OUTPUT path (reading statistics, writing
+VTK), so they are plain eager jnp — no jit cache to manage. The hot
+per-batch-close update and the trigger reduction live in
+``accumulators`` / ``triggers`` as registered jit entry points.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def batch_mean(flux_sum: jnp.ndarray, num_batches: int) -> jnp.ndarray:
+    """Per-element mean of the per-batch flux contributions."""
+    if num_batches < 1:
+        raise ValueError("mean needs at least 1 closed batch")
+    return flux_sum / jnp.asarray(float(num_batches), flux_sum.dtype)
+
+
+def sample_variance(
+    flux_sum: jnp.ndarray, flux_sq_sum: jnp.ndarray, num_batches: int
+) -> jnp.ndarray:
+    """Unbiased per-element sample variance of the batch values.
+
+    Clamped at zero: the textbook ``sq_sum/N - mean^2`` form can go
+    epsilon-negative in floating point when the batch values are
+    (near-)identical, and a negative variance would NaN every
+    downstream sqrt.
+    """
+    if num_batches < 2:
+        raise ValueError("sample variance needs at least 2 closed batches")
+    n = jnp.asarray(float(num_batches), flux_sum.dtype)
+    mean = flux_sum / n
+    return jnp.maximum(flux_sq_sum / n - mean * mean, 0.0) * (n / (n - 1.0))
+
+
+def std_dev(
+    flux_sum: jnp.ndarray, flux_sq_sum: jnp.ndarray, num_batches: int
+) -> jnp.ndarray:
+    """Per-element sample standard deviation of the batch values."""
+    return jnp.sqrt(sample_variance(flux_sum, flux_sq_sum, num_batches))
+
+
+def rel_err(
+    flux_sum: jnp.ndarray, flux_sq_sum: jnp.ndarray, num_batches: int
+) -> jnp.ndarray:
+    """Relative error of the mean, sem/|mean|; ``inf`` where the mean
+    is exactly zero. |mean|, not mean: negative-weight (variance
+    reduction) workloads can leave net-negative elements, which are
+    still SCORED — only a zero mean has no defined relative error."""
+    n = jnp.asarray(float(num_batches), flux_sum.dtype)
+    sem = jnp.sqrt(
+        sample_variance(flux_sum, flux_sq_sum, num_batches) / n
+    )
+    scored = flux_sum != 0
+    return jnp.where(
+        scored, sem / jnp.where(scored, jnp.abs(flux_sum) / n, 1.0),
+        jnp.inf,
+    )
+
+
+def figure_of_merit(
+    rel_err_arr: jnp.ndarray, elapsed_seconds: float
+) -> jnp.ndarray:
+    """FOM = 1/(RE^2 * t): constant over a run for a healthy estimator
+    (RE^2 falls as 1/N while t grows as N), so a FALLING FOM flags an
+    estimator or implementation problem. ``inf``-RE (unscored)
+    elements report 0."""
+    if elapsed_seconds <= 0.0:
+        raise ValueError(
+            f"figure of merit needs elapsed_seconds > 0, got "
+            f"{elapsed_seconds!r}"
+        )
+    re2 = rel_err_arr * rel_err_arr
+    return jnp.where(
+        jnp.isfinite(re2) & (re2 > 0),
+        1.0 / (re2 * elapsed_seconds),
+        0.0,
+    )
+
+
+@dataclass(frozen=True)
+class BatchStatistics:
+    """Read-only view of one accumulator state (facade
+    ``batch_statistics()``): the raw lanes plus lazily computed
+    estimator fields. Device arrays — ``np.asarray`` them to fetch."""
+
+    flux_sum: jnp.ndarray
+    flux_sq_sum: jnp.ndarray
+    num_batches: int
+    elapsed_seconds: Optional[float] = None
+
+    @property
+    def mean(self) -> jnp.ndarray:
+        return batch_mean(self.flux_sum, self.num_batches)
+
+    @property
+    def std_dev(self) -> jnp.ndarray:
+        return std_dev(self.flux_sum, self.flux_sq_sum, self.num_batches)
+
+    @property
+    def rel_err(self) -> jnp.ndarray:
+        return rel_err(self.flux_sum, self.flux_sq_sum, self.num_batches)
+
+    @property
+    def figure_of_merit(self) -> jnp.ndarray:
+        if self.elapsed_seconds is None:
+            raise ValueError(
+                "figure of merit needs elapsed_seconds (the facade "
+                "passes its TallyTimes transport total)"
+            )
+        return figure_of_merit(self.rel_err, self.elapsed_seconds)

@@ -1,0 +1,174 @@
+"""Convergence triggers: threshold tests over the batch statistics.
+
+A ``TriggerSpec`` names a per-element metric ("rel_err" or "std_err"
+— both fall as 1/sqrt(N) for a healthy estimator, both measure the
+mean's uncertainty; "std_err" is the standard error of the mean, NOT
+``BatchStatistics.std_dev``, which is the sqrt(N)-larger sample std
+dev of the batch values), a threshold, and a quantile over the SCORED
+elements (mean != 0): ``quantile=1.0`` (the default) is the strictest
+form — the worst scored element must converge — matching OpenMC's
+default tally-trigger semantics; lower quantiles ignore the slowest
+tail (e.g. 0.95 converges when 95% of scored elements are under the
+threshold).
+
+Evaluation cost contract (the reason this lives in its own jitted
+reduction): one compile per (E, dtype, metric, quantile) — entry
+point ``trigger_eval``, retrace-budgeted — and exactly ONE scalar
+device->host transfer per evaluation. Everything else (threshold
+compare, the 1/sqrt(N) batches-remaining projection) is host
+arithmetic on that one scalar.
+
+Batches-remaining estimate: with value v at N batches and v ~ c/sqrt(N),
+reaching threshold T needs N* = N * (v/T)^2 total batches, i.e.
+``ceil(N * ((v/T)^2 - 1))`` more. It is a projection, not a promise —
+the facade re-evaluates at every close.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+_METRICS = ("rel_err", "std_err")
+
+
+@dataclass(frozen=True)
+class TriggerSpec:
+    """Convergence criterion evaluated at batch close.
+
+    Attributes:
+      threshold: converge when the metric's quantile is <= this.
+      metric: "rel_err" (relative error of the mean — dimensionless)
+        or "std_err" (standard error of the mean — absolute, in flux
+        units; deliberately NOT named "std_dev", which is the
+        estimator surface's sample standard deviation, sqrt(N)
+        larger).
+      quantile: which quantile of the per-element metric over SCORED
+        elements must pass; 1.0 = the maximum (every scored element).
+    """
+
+    threshold: float
+    metric: str = "rel_err"
+    quantile: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.metric not in _METRICS:
+            raise ValueError(
+                f"metric must be one of {_METRICS}, got {self.metric!r}"
+            )
+        if not (float(self.threshold) > 0.0):
+            raise ValueError(
+                f"threshold must be > 0, got {self.threshold!r}"
+            )
+        if not (0.0 < float(self.quantile) <= 1.0):
+            raise ValueError(
+                f"quantile must be in (0, 1], got {self.quantile!r}"
+            )
+
+
+@dataclass(frozen=True)
+class TriggerResult:
+    """One trigger evaluation: the fetched metric value, the verdict,
+    and the 1/sqrt(N) projection of additional batches needed
+    (0 when converged; None when no projection exists yet — fewer
+    than 2 closed batches, or a non-finite value)."""
+
+    converged: bool
+    value: float
+    threshold: float
+    metric: str
+    quantile: float
+    num_batches: int
+    batches_remaining: Optional[int]
+
+
+@partial(jax.jit, static_argnames=("metric", "quantile"))
+def _trigger_reduction(flux_sum, flux_sq_sum, num_batches, *, metric,
+                       quantile):
+    """[E] lanes -> ONE scalar: the requested quantile of the
+    per-element metric over scored elements (+inf when none are
+    scored, so an all-unscored tally can never read as converged).
+
+    ``num_batches`` is a TRACED scalar — it changes every close, and
+    baking it static would recompile per batch (jaxlint JL004's
+    runtime shadow, the exact failure the retrace tripwire exists
+    for)."""
+    n = jnp.asarray(num_batches, flux_sum.dtype)
+    mean = flux_sum / n
+    # Unbiased sample variance of the batch values, clamped (see
+    # estimators.sample_variance — duplicated here so the reduction
+    # stays one fused jit with no host-int N).
+    var = jnp.maximum(flux_sq_sum / n - mean * mean, 0.0) * (
+        n / jnp.maximum(n - 1.0, 1.0)
+    )
+    sem = jnp.sqrt(var / n)
+    # mean != 0, not > 0: net-negative elements (negative-weight
+    # workloads) are scored via |mean|, exactly like the estimator
+    # surface — only an exactly-zero mean is "unscored".
+    scored = flux_sum != 0
+    if metric == "rel_err":
+        vals = sem / jnp.where(scored, jnp.abs(mean), 1.0)
+    else:  # "std_err" — validated by TriggerSpec
+        vals = sem
+    vals = jnp.where(scored, vals, jnp.inf)
+    # Quantile over the scored subset with static shapes: unscored
+    # elements sort to the top as +inf, so the k scored values occupy
+    # the first k ascending slots and the q-quantile is rank
+    # ceil(q*k)-1.
+    k = jnp.sum(scored)
+    svals = jnp.sort(vals)
+    idx = jnp.clip(
+        jnp.ceil(quantile * k).astype(jnp.int32) - 1, 0, vals.shape[0] - 1
+    )
+    return svals[idx]
+
+
+_trigger_reduction = register_entry_point(
+    "trigger_eval", _trigger_reduction
+)
+
+
+def evaluate_trigger(accumulator, spec: TriggerSpec) -> TriggerResult:
+    """Evaluate ``spec`` against a ``BatchAccumulator``'s lanes.
+
+    With fewer than 2 closed batches the variance is undefined: the
+    result is unconverged with ``value=inf`` and no projection, and
+    NO device work or transfer happens.
+    """
+    nb = accumulator.num_batches
+    if nb < 2:
+        return TriggerResult(
+            converged=False, value=math.inf,
+            threshold=float(spec.threshold), metric=spec.metric,
+            quantile=float(spec.quantile), num_batches=nb,
+            batches_remaining=None,
+        )
+    # THE one scalar D2H of a batch close.
+    value = float(
+        _trigger_reduction(
+            accumulator.flux_sum, accumulator.flux_sq_sum, float(nb),
+            metric=spec.metric, quantile=float(spec.quantile),
+        )
+    )
+    threshold = float(spec.threshold)
+    converged = value <= threshold
+    if converged:
+        remaining: Optional[int] = 0
+    elif math.isfinite(value) and value > 0:
+        remaining = max(
+            1, math.ceil(nb * ((value / threshold) ** 2 - 1.0))
+        )
+    else:
+        remaining = None
+    return TriggerResult(
+        converged=converged, value=value, threshold=threshold,
+        metric=spec.metric, quantile=float(spec.quantile),
+        num_batches=nb, batches_remaining=remaining,
+    )

@@ -10,9 +10,18 @@ calls and resume exactly.
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
-_FORMAT_VERSION = 2
+# v3 (round 7) added the batch-statistics lanes (TallyConfig.
+# batch_stats): flux_sum / flux_sq_sum / batch counter / open-batch
+# snapshot. A checkpoint WITHOUT statistics still writes v2, so plain
+# tallies stay readable by older code; a stats-carrying checkpoint
+# writes v3 and an older reader refuses it up front with the
+# "format ... newer than" header error — never a shape error from
+# half-understood arrays.
+_FORMAT_VERSION = 3
 
 
 def _engine_kind(tally) -> str:
@@ -51,9 +60,32 @@ def save_tally_state(tally, path: str) -> None:
         # Canonical caller order; engines re-derive their layout.
         x = np.asarray(tally.positions)
         elem = np.asarray(tally.elem_ids)
+    extra = {}
+    stats = getattr(tally, "_stats", None)
+    if stats is not None:
+        # Batch-statistics lanes (canonical [E] original order — the
+        # layout they already live in) + counters + the open-batch
+        # flux snapshot, so a restarted run resumes its statistics
+        # EXACTLY: the next close_batch measures the same delta it
+        # would have un-restarted.
+        extra = {
+            "stats_flux_sum": np.asarray(stats.flux_sum),
+            "stats_flux_sq_sum": np.asarray(stats.flux_sq_sum),
+            "stats_num_batches": np.int64(stats.num_batches),
+            "stats_moves_in_batch": np.int64(stats.moves_in_batch),
+            "stats_batch_open": np.bool_(stats.open_flux is not None),
+            "stats_open_flux": (
+                np.zeros((stats.nelems,), np.float64)
+                if stats.open_flux is None
+                else np.asarray(stats.open_flux)
+            ),
+        }
     np.savez_compressed(
         path,
-        format_version=np.int64(_FORMAT_VERSION),
+        # Minimum version that can read the payload: plain tallies
+        # stay v2-compatible; only stats-carrying checkpoints demand
+        # the v3 reader (see _FORMAT_VERSION note).
+        format_version=np.int64(_FORMAT_VERSION if extra else 2),
         kind=np.str_(kind),
         flux=np.asarray(tally.flux),
         x=x,
@@ -63,6 +95,7 @@ def save_tally_state(tally, path: str) -> None:
         capacity=np.int64(x.shape[0]),
         nelems=np.int64(tally.mesh.nelems),
         is_initialized=np.bool_(tally.is_initialized),
+        **extra,
     )
 
 
@@ -118,8 +151,48 @@ def load_tally_state(tally, path: str) -> None:
                 tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
                 tally.iter_count = int(z["iter_count"])
                 tally.is_initialized = bool(z["is_initialized"])
+                _restore_stats(tally, z)
                 return
         _restore_canonical(tally, kind, x, elem, flux, z)
+        _restore_stats(tally, z)
+
+
+def _restore_stats(tally, z) -> None:
+    """Batch-statistics restore, covering the version skew both ways:
+
+    - stats-enabled target + stats-carrying (v3) checkpoint: exact
+      lane/counter/open-snapshot restore — a resumed run's statistics
+      continue bit-for-bit;
+    - stats-enabled target + pre-stats (v2) checkpoint: lanes
+      zero-initialized, batch counter 0, and a fresh batch opened at
+      the restored flux (forward compatibility — old campaigns gain
+      statistics from the restore point on);
+    - stats-disabled target + stats-carrying checkpoint: the lanes are
+      dropped with a warning (the flux itself restores unchanged).
+    A stats checkpoint read by a pre-v3 reader never reaches here: its
+    header check refuses "format 3 newer than 2" up front."""
+    stats = getattr(tally, "_stats", None)
+    has = "stats_flux_sum" in getattr(z, "files", ())
+    if stats is None:
+        if has:
+            warnings.warn(
+                "checkpoint carries batch statistics but the target "
+                "engine has batch_stats disabled; statistics lanes "
+                "dropped (flux restored unchanged)"
+            )
+        return
+    if not has:
+        import jax.numpy as jnp
+
+        stats.reset(open_flux=jnp.asarray(z["flux"], dtype=tally.dtype))
+        return
+    stats.restore(
+        z["stats_flux_sum"],
+        z["stats_flux_sq_sum"],
+        int(z["stats_num_batches"]),
+        int(z["stats_moves_in_batch"]),
+        z["stats_open_flux"] if bool(z["stats_batch_open"]) else None,
+    )
 
 
 def _restore_canonical(tally, kind, x, elem, flux, z) -> None:

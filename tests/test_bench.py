@@ -181,6 +181,35 @@ def test_blocked_profile_row(bench, monkeypatch):
     assert res["conservation_rel_err"] < bench.CONSERVATION_RTOL
 
 
+def test_batch_stats_row(bench):
+    """The batch-statistics component row: schema keys present, flux
+    parity between the arms asserted (the tool exits hard otherwise),
+    the trigger trace well-formed (monotone decay on its deterministic
+    alternating-weight workload), and the compiles-healthy contract —
+    ``compiles.timed == 0``: the close_batch/trigger_eval entry points
+    compile once each in the warmup batches, never inside the timed
+    window."""
+    res = bench.run_batch_stats()
+    for key in ("on_moves_per_sec", "off_moves_per_sec",
+                "close_overhead_pct", "close_lane_update_ms",
+                "close_trigger_eval_ms", "flux_parity_bitwise",
+                "trigger", "compiles", "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["on_moves_per_sec"] > 0 and res["off_moves_per_sec"] > 0
+    assert res["close_lane_update_ms"] > 0
+    assert res["close_trigger_eval_ms"] > 0
+    trig = res["trigger"]
+    assert trig["monotone_decay"] is True
+    assert trig["converged_at_batches"] is not None
+    assert len(trig["values"]) >= 2
+    # The healthy contract: zero compiles in the measured window, and
+    # exactly one compile for each stats entry point over the run.
+    assert res["compiles"]["timed"] == 0
+    assert res["compiles"]["close_batch"] == 1
+    assert res["compiles"]["trigger_eval"] == 1
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

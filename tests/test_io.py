@@ -279,6 +279,40 @@ def test_vtk_cell_data_round_trip(tmp_path, mode):
         assert ("ASCII" in head) == (mode == "ascii")
 
 
+def test_write_tally_results_normalization_contract(tmp_path):
+    """Pin the ``WriteTallyResults`` normalization: by element volume
+    ONLY — NOT per source particle (the reference README claims a
+    total-weight division its code never performs; the code is the
+    contract, api/tally.py docstring). Asserted against the
+    reference's 5-particle oracle (native/test_host.c move 1): raw
+    flux[2,3,4] = 1.5/0.5/2.5 on the 6-tet unit cube, every tet volume
+    1/6, so the WRITTEN field is 9/3/15 — and would be 9/5, 3/5, 15/5
+    under the per-source-particle normalization this test exists to
+    refuse."""
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    num = 5
+    t = PumiTally(build_box(1, 1, 1, 1, 1, 1), num)
+    t.CopyInitialPosition(
+        np.tile([0.1, 0.4, 0.5], num).astype(np.float64))
+    t.MoveToNextLocation(
+        np.tile([0.1, 0.4, 0.5], num).astype(np.float64),
+        np.tile([1.2, 0.4, 0.5], num).astype(np.float64),
+        np.ones(num, np.int8), np.ones(num),
+    )
+    out = str(tmp_path / "oracle.vtk")
+    t.WriteTallyResults(out)
+    got = read_vtk_cell_scalars(out, "flux")
+    raw = np.array([0.0, 0.0, 0.3 * num, 0.1 * num, 0.5 * num, 0.0])
+    vol = read_vtk_cell_scalars(out, "volume")
+    np.testing.assert_allclose(vol, np.full(6, 1.0 / 6.0), rtol=1e-12)
+    np.testing.assert_allclose(got, raw / vol, atol=1e-8)  # volume-only
+    # The per-source-particle variant differs by 5x on the scored
+    # elements — a normalization regression cannot pass both.
+    assert np.all(np.abs(got[2:5] - raw[2:5] / vol[2:5] / num) > 1.0)
+
+
 def test_vtk_binary_scales(tmp_path):
     """Binary output must be byte-bounded (~raw array size) regardless
     of the data values — the point of replacing savetxt for 1M-tet

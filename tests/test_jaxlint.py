@@ -508,3 +508,27 @@ def test_engine_is_jaxlint_clean():
 
     diags = lint_paths([os.path.join(REPO, "pumiumtally_tpu")])
     assert diags == [], "\n".join(d.render() for d in diags)
+
+
+def test_stats_subsystem_registered_and_pragma_free():
+    """The batch-statistics modules (r7) must be IN the self-check's
+    file set (a packaging slip that moved them out of the package tree
+    would silently drop their coverage) and hold the strongest form of
+    the clean contract: zero violations with zero pragmas — the stats
+    layer only ever reads engine arrays, so it has no excuse for even
+    a justified suppression."""
+    import glob
+
+    stats_dir = os.path.join(REPO, "pumiumtally_tpu", "stats")
+    files = sorted(glob.glob(os.path.join(stats_dir, "*.py")))
+    names = {os.path.basename(f) for f in files}
+    assert {"__init__.py", "accumulators.py", "estimators.py",
+            "triggers.py"} <= names
+    from pumiumtally_tpu.analysis import lint_paths
+
+    assert lint_paths(files) == []
+    for f in files:
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the stats modules ship pragma-free"
+            )

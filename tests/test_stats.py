@@ -1,0 +1,396 @@
+"""Batch-statistics subsystem (pumiumtally_tpu/stats): accumulator
+math vs a numpy reference, the stats-off bitwise-parity contract on
+every engine, cross-engine statistics equivalence, trigger-based early
+stop on the box workload, and the VTK statistics payload.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    PartitionedPumiTally,
+    PumiTally,
+    StreamingPartitionedTally,
+    StreamingTally,
+    TallyConfig,
+    TriggerSpec,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh
+
+N = 240
+MESH_ARGS = (1, 1, 1, 4, 4, 4)
+
+
+def _random_batches(rng, batches: int, moves: int):
+    """(src, [(dests, weights), ...]) per batch — fresh random samples
+    each batch, the statistics workload."""
+    out = []
+    for _ in range(batches):
+        src = rng.uniform(0.1, 0.9, (N, 3))
+        segs = [
+            (rng.uniform(0.1, 0.9, (N, 3)), rng.uniform(0.5, 1.5, N))
+            for _ in range(moves)
+        ]
+        out.append((src, segs))
+    return out
+
+
+def _drive(t, work, close_each=False, trigger=None):
+    results = []
+    for src, segs in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d, w in segs:
+            t.MoveToNextLocation(None, d.reshape(-1).copy(), None, w.copy())
+        if close_each:
+            results.append(t.close_batch(trigger))
+    return results
+
+
+ENGINE_NAMES = (
+    "monolithic", "sharded", "streaming", "partitioned",
+    "streaming_partitioned",
+)
+
+
+def _make_engine(name: str, stats: bool):
+    cfg = lambda **kw: TallyConfig(batch_stats=stats, **kw)
+    mesh = build_box(*MESH_ARGS)
+    if name == "monolithic":
+        return PumiTally(mesh, N, cfg())
+    if name == "sharded":
+        return PumiTally(mesh, N, cfg(device_mesh=make_device_mesh(2)))
+    if name == "streaming":
+        return StreamingTally(mesh, N, chunk_size=120, config=cfg())
+    if name == "partitioned":
+        return PartitionedPumiTally(
+            mesh, N,
+            cfg(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+        )
+    return StreamingPartitionedTally(
+        mesh, N, chunk_size=120,
+        config=cfg(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Accumulator math
+# ---------------------------------------------------------------------------
+
+def test_estimators_match_numpy_reference():
+    """mean / std dev / rel err from the on-device lanes must equal the
+    numpy statistics of the actual per-batch flux deltas."""
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(3)
+    work = _random_batches(rng, 5, 2)
+    deltas = []
+    prev = np.zeros(6 * 4**3)
+    for src, segs in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d, w in segs:
+            t.MoveToNextLocation(None, d.reshape(-1).copy(), None, w.copy())
+        now = np.asarray(t.flux, np.float64)
+        deltas.append(now - prev)
+        prev = now
+        t.close_batch()
+    st = t.finalize()
+    assert st.num_batches == 5
+    x = np.stack(deltas)  # [B, E]
+    np.testing.assert_allclose(np.asarray(st.mean), x.mean(0), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(st.std_dev), x.std(0, ddof=1), rtol=1e-9, atol=1e-13
+    )
+    re = np.asarray(st.rel_err)
+    scored = x.mean(0) > 0
+    expect = x.std(0, ddof=1)[scored] / np.sqrt(5) / x.mean(0)[scored]
+    np.testing.assert_allclose(re[scored], expect, rtol=1e-9, atol=1e-13)
+    assert np.all(np.isinf(re[~scored]))
+    # FOM: finite and positive exactly where RE is finite and nonzero.
+    fom = np.asarray(st.figure_of_merit)
+    assert np.all(fom[scored][expect > 0] > 0)
+    assert np.all(fom[~scored] == 0.0)
+
+
+def test_empty_batch_is_not_a_sample():
+    """A close with zero moves since open must leave the lanes and the
+    batch counter untouched (a structural zero would bias RE low)."""
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(4)
+    _drive(t, _random_batches(rng, 2, 1), close_each=True)
+    assert t._stats.num_batches == 2
+    before = np.asarray(t._stats.flux_sum).copy()
+    t.close_batch()  # nothing moved since the last close
+    t.close_batch()
+    assert t._stats.num_batches == 2
+    np.testing.assert_array_equal(np.asarray(t._stats.flux_sum), before)
+    # CopyInitialPosition with no subsequent move also closes as no-op.
+    t.CopyInitialPosition(
+        rng.uniform(0.1, 0.9, (N, 3)).reshape(-1).copy()
+    )
+    t.CopyInitialPosition(
+        rng.uniform(0.1, 0.9, (N, 3)).reshape(-1).copy()
+    )
+    assert t._stats.num_batches == 2
+
+
+def test_copy_initial_position_rolls_batches():
+    """Batch boundaries WITHOUT explicit close_batch calls: each
+    CopyInitialPosition closes the previous source batch; finalize
+    closes the last. 3 sourcings + finalize == 3 batches."""
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(5)
+    _drive(t, _random_batches(rng, 3, 2), close_each=False)
+    assert t._stats.num_batches == 2  # first two closed by re-sourcing
+    st = t.finalize()
+    assert st.num_batches == 3
+    # finalize left no batch open: further moves are unattributed.
+    assert not t._stats.batch_open
+
+
+def test_stats_disabled_surface_raises():
+    t = PumiTally(build_box(*MESH_ARGS), N)
+    with pytest.raises(RuntimeError, match="batch_stats=True"):
+        t.close_batch()
+    with pytest.raises(RuntimeError, match="batch_stats=True"):
+        t.batch_statistics()
+    with pytest.raises(RuntimeError, match="batch_stats=True"):
+        t.finalize()
+
+
+def test_trigger_spec_validation():
+    with pytest.raises(ValueError, match="metric"):
+        TriggerSpec(threshold=0.1, metric="variance")
+    with pytest.raises(ValueError, match="threshold"):
+        TriggerSpec(threshold=0.0)
+    with pytest.raises(ValueError, match="quantile"):
+        TriggerSpec(threshold=0.1, quantile=0.0)
+    with pytest.raises(ValueError, match="TriggerSpec"):
+        TallyConfig(batch_stats=True, batch_stats_trigger=0.1)
+    with pytest.raises(ValueError, match="batch_stats=True"):
+        TallyConfig(batch_stats_trigger=TriggerSpec(threshold=0.1))
+
+
+# ---------------------------------------------------------------------------
+# The parity contract: stats-off == stats-on engine state, bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ENGINE_NAMES)
+def test_stats_never_perturb_engine_state(name):
+    """The acceptance contract on every engine: enabling batch_stats
+    (accumulating + closing batches throughout) leaves flux, positions
+    and element ids BITWISE identical to the stats-less run — the
+    subsystem only ever reads the engine."""
+    rng = np.random.default_rng(11)
+    work = _random_batches(rng, 2, 2)
+    t_off = _make_engine(name, False)
+    t_on = _make_engine(name, True)
+    _drive(t_off, work, close_each=False)
+    _drive(t_on, work, close_each=True,
+           trigger=TriggerSpec(threshold=0.5))
+    np.testing.assert_array_equal(
+        np.asarray(t_on.flux), np.asarray(t_off.flux)
+    )
+    np.testing.assert_array_equal(t_on.positions, t_off.positions)
+    np.testing.assert_array_equal(t_on.elem_ids, t_off.elem_ids)
+
+
+@pytest.mark.parametrize("name", [n for n in ENGINE_NAMES
+                                  if n != "monolithic"])
+def test_cross_engine_statistics_agree(name):
+    """The same batches through different engines yield the same
+    statistics (engines agree on flux to rounding; the lanes are
+    derived from flux alone). One engine per test, each against the
+    monolithic reference — building every engine in one test would
+    blow the per-test retrace budgets for the ENGINE entry points
+    (five partitioned phase program sets), which budget the statistics
+    tests like any other."""
+    rng = np.random.default_rng(12)
+    work = _random_batches(rng, 3, 2)
+    base_t = _make_engine("monolithic", True)
+    _drive(base_t, work, close_each=True)
+    base = base_t.finalize()
+    t = _make_engine(name, True)
+    _drive(t, work, close_each=True)
+    st = t.finalize()
+    base_re = np.asarray(base.rel_err)
+    finite = np.isfinite(base_re)
+    assert st.num_batches == base.num_batches
+    np.testing.assert_allclose(
+        np.asarray(st.mean), np.asarray(base.mean),
+        rtol=1e-11, atol=1e-13,
+    )
+    re = np.asarray(st.rel_err)
+    np.testing.assert_array_equal(np.isfinite(re), finite)
+    np.testing.assert_allclose(
+        re[finite], base_re[finite], rtol=1e-6, atol=1e-10
+    )
+
+
+# ---------------------------------------------------------------------------
+# Trigger-based early stop (acceptance: the box workload)
+# ---------------------------------------------------------------------------
+
+def test_trigger_early_stop_on_box_workload():
+    """Monotone relative-error decay, stop at the threshold, and the
+    1/sqrt(N)-law batches-remaining projection within 2x of what
+    actually happened. Deterministic alternating-weight batches
+    (identical geometry, weights 1.0/1.2) make the decay exactly
+    monotone: RE ~ (0.1/1.1)/sqrt(N-1)."""
+    t = PumiTally(
+        build_box(*MESH_ARGS), N,
+        TallyConfig(batch_stats=True,
+                    batch_stats_trigger=TriggerSpec(threshold=0.035)),
+    )
+    rng = np.random.default_rng(13)
+    src = rng.uniform(0.1, 0.9, (N, 3))
+    dst = rng.uniform(0.1, 0.9, (N, 3))
+    values, projection, actual = [], None, None
+    for b in range(40):
+        w = np.full(N, 1.0 if b % 2 == 0 else 1.2)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, dst.reshape(-1).copy(), None, w)
+        res = t.close_batch()  # config trigger applies
+        assert res.num_batches == b + 1
+        if np.isfinite(res.value):
+            values.append(res.value)
+        if projection is None and res.batches_remaining not in (None, 0):
+            projection = res.num_batches + res.batches_remaining
+        if res.converged:
+            assert res.batches_remaining == 0
+            actual = res.num_batches
+            break
+    assert actual is not None, "trigger never fired in 40 batches"
+    assert values[-1] <= 0.035
+    # Monotone decay of the relative error across closes.
+    assert all(b < a for a, b in zip(values, values[1:])), values
+    # The first projection's implied total within 2x of the actual.
+    assert projection is not None
+    assert actual / 2 <= projection <= actual * 2, (projection, actual)
+
+
+def test_trigger_quantile_and_std_err_metrics():
+    """quantile < 1 can only LOOSEN the criterion (a lower quantile of
+    the per-element metric), and the std_err metric evaluates the
+    STANDARD ERROR of the mean in flux units (sample std dev /
+    sqrt(N) — deliberately not named after the estimator surface's
+    std_dev); both share the evaluation machinery."""
+    from pumiumtally_tpu.stats.triggers import evaluate_trigger
+
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(14)
+    _drive(t, _random_batches(rng, 4, 2), close_each=True)
+    stats = t._stats
+    v_max = evaluate_trigger(stats, TriggerSpec(threshold=1e-9)).value
+    v_med = evaluate_trigger(
+        stats, TriggerSpec(threshold=1e-9, quantile=0.5)
+    ).value
+    assert np.isfinite(v_max) and np.isfinite(v_med)
+    assert v_med <= v_max
+    # Quantiles of the fetched per-element estimator agree with numpy.
+    re = np.asarray(t.batch_statistics().rel_err)
+    scored = np.sort(re[np.isfinite(re)])
+    np.testing.assert_allclose(v_max, scored[-1], rtol=1e-12)
+    np.testing.assert_allclose(
+        v_med, scored[int(np.ceil(0.5 * scored.size)) - 1], rtol=1e-12
+    )
+    sd = evaluate_trigger(
+        stats, TriggerSpec(threshold=1e-9, metric="std_err")
+    ).value
+    sem = np.asarray(t.batch_statistics().std_dev) / np.sqrt(4)
+    np.testing.assert_allclose(
+        sd, np.max(sem[np.isfinite(re)]), rtol=1e-12
+    )
+
+
+def test_negative_flux_elements_stay_scored():
+    """Negative-weight (variance reduction) workloads can leave
+    net-negative elements; those are SCORED — rel_err = sem/|mean| is
+    finite and the trigger's quantile includes them. Only an
+    exactly-zero mean is unscored."""
+    from pumiumtally_tpu.stats.triggers import evaluate_trigger
+
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(18)
+    for b in range(3):
+        src = rng.uniform(0.1, 0.9, (N, 3))
+        dst = rng.uniform(0.1, 0.9, (N, 3))
+        w = np.full(N, -1.0 - 0.1 * b)  # all-negative weights
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, dst.reshape(-1).copy(), None, w)
+        t.close_batch()
+    st = t.batch_statistics()
+    mean = np.asarray(st.mean)
+    re = np.asarray(st.rel_err)
+    neg = mean < 0
+    assert neg.any()  # the workload actually produced negative flux
+    assert np.all(np.isfinite(re[neg]))  # scored, not inf
+    np.testing.assert_array_equal(np.isinf(re), mean == 0.0)
+    # And the trigger's max-quantile reflects them too.
+    res = evaluate_trigger(t._stats, TriggerSpec(threshold=1e-9))
+    np.testing.assert_allclose(
+        res.value, np.max(re[np.isfinite(re)]), rtol=1e-12
+    )
+
+
+def test_trigger_needs_two_batches():
+    """Fewer than 2 closed batches: unconverged, value inf, no
+    projection — and no device work at all."""
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(15)
+    res = t.close_batch(TriggerSpec(threshold=0.1))
+    assert not res.converged and np.isinf(res.value)
+    assert res.batches_remaining is None and res.num_batches == 0
+    _drive(t, _random_batches(rng, 1, 1), close_each=False)
+    res = t.close_batch(TriggerSpec(threshold=0.1))
+    assert not res.converged and res.num_batches == 1
+    assert res.batches_remaining is None
+
+
+# ---------------------------------------------------------------------------
+# VTK payload
+# ---------------------------------------------------------------------------
+
+def test_write_tally_results_stats_arrays(tmp_path):
+    """With >= 2 closed batches the written file carries flux_mean and
+    rel_err cell arrays beside flux+volume; flux_mean is
+    volume-normalized like flux, and unscored elements write rel_err
+    0.0 (not inf)."""
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    t = PumiTally(build_box(*MESH_ARGS), N, TallyConfig(batch_stats=True))
+    rng = np.random.default_rng(16)
+    _drive(t, _random_batches(rng, 3, 2), close_each=True)
+    out = str(tmp_path / "stats.vtk")
+    t.WriteTallyResults(out)
+    st = t.batch_statistics()
+    vol = np.asarray(t.mesh.volumes)
+    np.testing.assert_allclose(
+        read_vtk_cell_scalars(out, "flux_mean"),
+        np.asarray(st.mean) / vol, rtol=1e-12,
+    )
+    re = np.asarray(st.rel_err)
+    expect = np.where(np.isfinite(re), re, 0.0)
+    np.testing.assert_allclose(
+        read_vtk_cell_scalars(out, "rel_err"), expect, rtol=1e-12
+    )
+    # The reference payload is still there, unchanged.
+    np.testing.assert_allclose(
+        read_vtk_cell_scalars(out, "flux"),
+        np.asarray(t.flux) / vol, rtol=1e-12,
+    )
+
+
+def test_write_tally_results_default_payload_unchanged(tmp_path):
+    """Stats disabled (and stats enabled with zero closed batches):
+    the file carries exactly the reference's flux+volume arrays."""
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    for cfg in (TallyConfig(), TallyConfig(batch_stats=True)):
+        t = PumiTally(build_box(*MESH_ARGS), N, cfg)
+        rng = np.random.default_rng(17)
+        _drive(t, _random_batches(rng, 1, 1), close_each=False)
+        out = str(tmp_path / f"plain_{cfg.batch_stats}.vtk")
+        t.WriteTallyResults(out)
+        assert read_vtk_cell_scalars(out, "flux").size
+        with pytest.raises(KeyError):
+            read_vtk_cell_scalars(out, "flux_mean")

@@ -1,0 +1,217 @@
+"""Batch-statistics overhead A/B + trigger-convergence capture (r7).
+
+Two arms over the IDENTICAL box workload (same mesh, same seeds, same
+per-batch protocol: one CopyInitialPosition + ``moves`` continue-mode
+moves per source batch):
+
+- ``off``: the default engine (TallyConfig() — no stats code runs);
+- ``on``:  ``batch_stats=True`` with a ``close_batch()`` at every
+  batch boundary.
+
+Reported, non-interactively (one JSON line — the r7 suite's stats_ab
+stage and bench.py's batch_stats row both consume it):
+
+- both arms' moves/s and the relative close-batch overhead;
+- the fenced per-close cost of the lane update alone and of the full
+  close+trigger evaluation (the trigger's single-scalar D2H is the
+  sync, so this is an honest wall number);
+- the trigger convergence trace on a deterministic alternating-weight
+  workload (batch weights 1.0/1.2 -> per-element relative error
+  EXACTLY (0.1/1.1)/sqrt(N-1)-shaped): monotone relative-error decay,
+  the batch count at which the threshold trigger fired, and the
+  1/sqrt(N)-law batches-remaining projection vs what actually
+  happened;
+- the compiles-healthy contract: jit compiles inside the measured
+  window (``compiles.timed``; 0 == every timed batch hit the cache).
+
+Flux parity between the arms is asserted bitwise before any number is
+reported — the stats-off-is-identical contract, enforced where the
+measurement happens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _drive_batches(t, pts_by_batch, close_each: bool, trigger=None):
+    """Run every (src, dests...) batch through the three-call
+    protocol; returns the trigger results of the closes (empty when
+    close_each is False)."""
+    results = []
+    for src, dests in pts_by_batch:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d, w in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy(), None, w)
+        if close_each:
+            results.append(t.close_batch(trigger))
+    return results
+
+
+def _make_batches(rng, n: int, batches: int, moves: int):
+    """Deterministic alternating-weight batches: identical geometry
+    every batch, weights 1.0 / 1.2 by batch parity — the per-batch
+    flux is w_b * (a fixed pattern), so the expected relative error is
+    exactly computable and exactly monotone."""
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    segs = [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)]
+    out = []
+    for b in range(batches):
+        w = np.full(n, 1.0 if b % 2 == 0 else 1.2)
+        out.append((src, [(d, w) for d in segs]))
+    return out
+
+
+def run_ab(
+    n: int = 100_000,
+    div: int = 20,
+    moves: int = 2,
+    batches: int = 12,
+    threshold: float = 0.04,
+) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, TriggerSpec, build_box
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(7)
+    work = _make_batches(rng, n, batches, moves)
+    spec = TriggerSpec(threshold=threshold)
+
+    def build(stats: bool) -> PumiTally:
+        return PumiTally(
+            mesh, n,
+            TallyConfig(batch_stats=stats, check_found_all=False,
+                        fenced_timing=False),
+        )
+
+    # Warmup = the first TWO batches: the close-batch lane update
+    # compiles at close #1, but the trigger reduction first runs at
+    # close #2 (one closed batch has no variance — evaluation
+    # short-circuits on the host), so a one-batch warmup would leak
+    # its compile into the timed window.
+    t_on = build(True)
+    with retrace_guard(raise_on_exceed=False) as guard:
+        trig_warm = _drive_batches(t_on, work[:2], close_each=True,
+                                   trigger=spec)
+        jax.block_until_ready(t_on.flux)
+        # -- timed window: stats-ON batches 3..B -------------------------
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            t0 = time.perf_counter()
+            trig = _drive_batches(t_on, work[2:], close_each=True,
+                                  trigger=spec)
+            jax.block_until_ready(
+                (t_on.flux, t_on._stats.flux_sum, t_on._stats.flux_sq_sum)
+            )
+            on_s = time.perf_counter() - t0
+    trig = trig_warm + trig
+
+    t_off = build(False)
+    _drive_batches(t_off, work[:2], close_each=False)
+    jax.block_until_ready(t_off.flux)
+    t0 = time.perf_counter()
+    _drive_batches(t_off, work[2:], close_each=False)
+    jax.block_until_ready(t_off.flux)
+    off_s = time.perf_counter() - t0
+
+    # Parity gate: stats-on flux must be BITWISE the stats-off flux —
+    # the accumulator only ever reads it. RuntimeError, not
+    # sys.exit(): bench.py wraps this row in a best-effort
+    # `except Exception`, and a SystemExit would escape it and kill
+    # the whole bench (headline included); the CLI main() below still
+    # exits nonzero on the unhandled raise.
+    if not bool(jnp.all(t_on.flux == t_off.flux)):
+        raise RuntimeError(
+            "stats-on flux diverged bitwise from stats-off flux"
+        )
+
+    # Fenced per-close microcosts on the final accumulated state: the
+    # bare lane update (no D2H at all) and the full close+trigger (its
+    # scalar fetch is the sync).
+    stats = t_on._stats
+    from pumiumtally_tpu.stats.accumulators import _close_batch_update
+    from pumiumtally_tpu.stats.triggers import evaluate_trigger
+
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        s1, s2 = _close_batch_update(
+            stats.flux_sum, stats.flux_sq_sum, t_on.flux, stats.open_flux
+        )
+        jax.block_until_ready((s1, s2))
+    lane_ms = (time.perf_counter() - t0) / reps * 1e3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        evaluate_trigger(stats, spec)  # scalar fetch synchronizes
+    trigger_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    # Convergence trace over the timed closes (close #1 happened in
+    # the warmup batch): values are inf until 2 batches closed.
+    values = [r.value for r in trig]
+    finite = [v for v in values if np.isfinite(v)]
+    converged_at = next(
+        (r.num_batches for r in trig if r.converged), None
+    )
+    # Projection accuracy: the first finite estimate's implied total
+    # vs the actual batch count at convergence.
+    first_proj = next(
+        (r for r in trig if r.batches_remaining not in (None, 0)), None
+    )
+    projected_total = (
+        None if first_proj is None
+        else first_proj.num_batches + first_proj.batches_remaining
+    )
+    moves_total = n * moves * (batches - 2)
+    return {
+        "row": "batch_stats",
+        "on_moves_per_sec": moves_total / on_s,
+        "off_moves_per_sec": moves_total / off_s,
+        "close_overhead_pct": (on_s - off_s) / off_s * 100.0,
+        "close_lane_update_ms": lane_ms,
+        "close_trigger_eval_ms": trigger_ms,
+        "flux_parity_bitwise": True,
+        "trigger": {
+            "metric": spec.metric,
+            "threshold": threshold,
+            "values": finite,
+            "monotone_decay": bool(
+                all(b < a for a, b in zip(finite, finite[1:]))
+            ),
+            "converged_at_batches": converged_at,
+            "projected_total_batches": projected_total,
+        },
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 12))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves, batches=batches),
+                     default=float))
+
+
+if __name__ == "__main__":
+    main()

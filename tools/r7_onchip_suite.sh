@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-7 on-chip suite: fired by a probe loop (tools/r5_probe_loop.sh
+# pattern) the moment the TPU tunnel answers. ORDER MATTERS (r4
+# lesson): a QUICK headline bench first (a short window must still
+# yield a fresh cached measurement), then the full bench (whose row
+# set now includes the batch_stats component row in-process), then
+# THIS round's measurement — the batch-statistics overhead + trigger
+# convergence A/B at full bench scale — then the inherited engine
+# experiments; chipless AOT compiles go last (the remote compile
+# helper remains the prime wedge suspect).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r7_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r7 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-7 measurement: the batch-statistics subsystem at the FULL
+# headline shape (500k particles, 48k tets; the in-bench row runs
+# 100k to bound its budget) — close-batch overhead vs the stats-off
+# arm (flux parity asserted bitwise inside the tool), fenced per-close
+# lane-update/trigger costs (the trigger's single scalar D2H is the
+# sync), and the trigger convergence trace (monotone RE decay,
+# threshold fire point, 1/sqrt(N) batches-remaining projection) —
+# captured non-interactively as one JSON line.
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+# Inherited engine experiments (r5/r6 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects).
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
