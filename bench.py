@@ -459,6 +459,27 @@ def run_batch_stats() -> dict | None:
     )
 
 
+def run_resilience_ab() -> dict | None:
+    """Component row: the fault-tolerance subsystem's cost
+    (tools/exp_resilience_ab.py run_ab) — autosave-on (one atomic
+    digest-sealed generation per batch close) vs autosave-off rates on
+    the identical workload (flux parity asserted bitwise inside the
+    tool: autosave only reads engine state), the fenced per-save cost
+    (fetch + compress + sha256 + atomic rename) and on-disk generation
+    size, and the host-side-only contract — ``compiles.timed == 0``:
+    the resilience layer adds no jitted entry points, so autosave must
+    never touch the jit cache. Reduced shape (100k particles) like the
+    other component rows; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_resilience_ab
+
+    return exp_resilience_ab.run_ab(
+        n=min(N, 100_000), div=MESH_DIV, moves=2, batches=8
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -864,6 +885,12 @@ def _measure_and_report() -> None:
             batch_stats = run_batch_stats()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# batch-stats A/B failed: {e}", file=sys.stderr)
+    resilience = None
+    if os.environ.get("PUMIUMTALLY_BENCH_RESILIENCE", "1") != "0":
+        try:
+            resilience = run_resilience_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# resilience A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -995,6 +1022,12 @@ def _measure_and_report() -> None:
         # lane-update/trigger ms, convergence trace, and the
         # compiles-healthy contract (compiles.timed == 0).
         "batch_stats": batch_stats,
+        # Fault-tolerance subsystem cost: autosave-on vs autosave-off
+        # rates (flux parity bitwise — autosave only reads state), the
+        # fenced per-generation save cost and on-disk size, and the
+        # host-side-only contract (compiles.timed == 0: resilience
+        # never touches the jit cache).
+        "resilience": resilience,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

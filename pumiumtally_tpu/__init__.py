@@ -29,6 +29,7 @@ from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
 from pumiumtally_tpu.stats import BatchStatistics, TriggerResult, TriggerSpec
+from pumiumtally_tpu.resilience import CheckpointPolicy, resume_latest
 
 __version__ = "0.1.0"
 
@@ -46,4 +47,6 @@ __all__ = [
     "BatchStatistics",
     "TriggerResult",
     "TriggerSpec",
+    "CheckpointPolicy",
+    "resume_latest",
 ]

@@ -99,6 +99,11 @@ class PartitionedPumiTally(PumiTally):
     def _dispatch_localize(self, dest: jnp.ndarray):
         return self.engine.localize(dest)  # (found_all, n_exited)
 
+    def _current_lost(self) -> int:
+        """The engine's still-lost particle count (lazy device scalar,
+        cached as a host int after the first fetch)."""
+        return self.engine._n_lost
+
     def _dispatch_move(self, origins, dests, fly, w):
         # auto_continue applies here too: when the base class detects an
         # origin echo it hands back the device array that staged last
@@ -134,6 +139,9 @@ class PartitionedPumiTally(PumiTally):
                 # every other cell array.
                 **self._stats_vtk_cell_data(),
             },
+            # Campaign-level leakage accounting, replicated into every
+            # piece (field data is global, not per-cell).
+            field_data=self._vtk_field_data(),
             nparts=int(self.device_mesh.devices.size),
         )
         self.tally_times.vtk_file_write_time += time.perf_counter() - t0

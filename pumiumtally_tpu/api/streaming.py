@@ -180,6 +180,8 @@ class StreamingTally(PumiTally):
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
         t0 = time.perf_counter()
         self._stats_roll_batch()  # each sourcing opens a new batch
+        self._resilience_roll_batch()  # autosave/drain at batch close
+        self._roll_lost()  # fold the closed batch's leakage
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
@@ -304,6 +306,7 @@ class StreamingTally(PumiTally):
         if self.config.fenced_timing:
             jax.block_until_ready(self._flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
+        self._resilience_note_move()  # drain/timer-cadence safe point
 
     def _after_chunk_dispatch(self) -> None:
         """Hook: deferred per-chunk error checks (partitioned mode)."""
@@ -605,6 +608,12 @@ class StreamingPartitionedTally(StreamingTally):
     @property
     def elem_ids(self) -> np.ndarray:
         return self.elem
+
+    def _current_lost(self) -> int:
+        """Still-lost particles across the chunk engines (each count is
+        an int cached at the batch sync point, _after_chunk_dispatch —
+        no extra device fetch here)."""
+        return sum(e._n_lost for e in self.engines)
 
     @property
     def flux(self) -> jnp.ndarray:

@@ -402,6 +402,18 @@ class PumiTally:
             from pumiumtally_tpu.stats import BatchAccumulator
 
             self._stats = BatchAccumulator(mesh.nelems, self.dtype)
+        # Cumulative leakage counter (the rolled part of
+        # ``lost_particles``; partitioned facades add the open batch's
+        # current lost count on read).
+        self._lost_total = 0
+        # Fault tolerance (TallyConfig.checkpoint): the autosave/drain
+        # runner, or None (default — no resilience code runs anywhere
+        # in the protocol path, no signal handlers are installed).
+        self._resilience = None
+        if self.config.checkpoint is not None:
+            from pumiumtally_tpu.resilience import AutosaveRunner
+
+            self._resilience = AutosaveRunner(self.config.checkpoint)
         return mesh
 
     def _cached_ones(self, kind: str) -> jnp.ndarray:
@@ -508,6 +520,83 @@ class PumiTally:
             return a
         return jnp.concatenate([a, fill[self.num_particles :]], axis=0)
 
+    # -- fault tolerance (TallyConfig.checkpoint) ------------------------
+    def _resilience_roll_batch(self) -> None:
+        """Batch-close hook for the autosave runner: fires on every
+        ``CopyInitialPosition`` that closes a non-empty source batch
+        (and on ``close_batch``/``finalize``). Placed BEFORE the lost
+        counter rolls and before new sources rewrite the state, so the
+        saved generation is exactly the closed batch's end state. No-op
+        without a checkpoint policy."""
+        if self._resilience is not None:
+            self._resilience.on_batch_close(self)
+
+    def _resilience_note_move(self) -> None:
+        """Move-end hook: the preemption-safe drain point and the
+        ``every_seconds`` cadence check. No-op without a policy."""
+        if self._resilience is not None:
+            self._resilience.on_move(self)
+
+    def checkpoint_now(self, **meta):
+        """Write one checkpoint generation immediately through the
+        configured ``TallyConfig.checkpoint`` policy (e.g. the final
+        save after a campaign's last batch, which no re-sourcing will
+        ever close). Returns (generation, path). Keyword arguments ride
+        along in the generation's metadata (the runner's own
+        reason/iter_count/batches_closed keys win on collision).
+
+        A pending drain request (SIGTERM during the final batch, whose
+        close this call stands in for) exits cleanly here after the
+        save — otherwise a preemption notice received near the end of
+        a campaign would be silently absorbed by a runner whose
+        batch-close hooks never fire again."""
+        if self._resilience is None:
+            raise RuntimeError(
+                "checkpoint_now() needs TallyConfig(checkpoint="
+                "resilience.CheckpointPolicy(...)); for one-off manual "
+                "saves use utils.save_tally_state"
+            )
+        out = self._resilience.save(self, reason="manual", meta=meta)
+        if self._resilience.drain_requested:
+            self._resilience.close()  # hand the signals back
+            raise SystemExit(0)
+        return out
+
+    def resume_latest(self):
+        """Restore the newest intact checkpoint generation from the
+        configured policy's directory into this tally (corruption
+        fallback included); returns the ``resilience.ResumeInfo`` or
+        None when no generation exists yet."""
+        from pumiumtally_tpu.resilience import resume_latest
+
+        return resume_latest(self)
+
+    # -- leakage accounting ----------------------------------------------
+    def _current_lost(self) -> int:
+        """Particles currently excluded from transport (source in no
+        mesh element). Non-partitioned engines clamp out-of-hull
+        sources to the boundary instead of dropping them, so only the
+        partitioned facades override this."""
+        return 0
+
+    def _roll_lost(self) -> None:
+        """Fold the closing batch's still-lost particles into the
+        cumulative counter (called at each re-sourcing, BEFORE the new
+        localization resets the engine's lost flags; revived particles
+        rejoined transport and are correctly not counted)."""
+        self._lost_total += self._current_lost()
+
+    @property
+    def lost_particles(self) -> int:
+        """Cumulative count of particles dropped from transport over
+        the whole campaign (every facade; written into the VTK output's
+        field data so campaigns can account for leakage). Monolithic /
+        sharded / plain-streaming engines clamp out-of-domain sources
+        rather than dropping them, so this is nonzero only for the
+        partitioned engines' lost-particle path (api/streaming.py
+        warn-and-drop)."""
+        return self._lost_total + self._current_lost()
+
     # -- batch statistics (TallyConfig.batch_stats) ----------------------
     def _stats_roll_batch(self) -> None:
         """Batch boundary hook: every ``CopyInitialPosition`` closes
@@ -549,6 +638,7 @@ class PumiTally:
         a no-op (an empty batch is not a sample)."""
         stats = self._require_stats()
         stats.close(self.flux, reopen=True)
+        self._resilience_roll_batch()  # explicit close = batch close
         spec = (
             trigger if trigger is not None
             else self.config.batch_stats_trigger
@@ -566,6 +656,7 @@ class PumiTally:
         (or ``close_batch``) opens one."""
         stats = self._require_stats()
         stats.close(self.flux, reopen=False)
+        self._resilience_roll_batch()  # final close = batch close
         return self.batch_statistics()
 
     def batch_statistics(self):
@@ -590,6 +681,8 @@ class PumiTally:
         PumiTallyImpl.cpp:54-64)."""
         t0 = time.perf_counter()
         self._stats_roll_batch()  # each sourcing opens a new batch
+        self._resilience_roll_batch()  # autosave/drain at batch close
+        self._roll_lost()  # fold the closed batch's leakage
         self._last_dests_host = None  # localization rewrites the state
         self._last_dests_dev = None
         self._echo_misses = 0  # new batch: re-arm the echo detector
@@ -797,6 +890,7 @@ class PumiTally:
         if self.config.fenced_timing:
             jax.block_until_ready(self.flux)
         self.tally_times.total_time_to_tally += time.perf_counter() - t0
+        self._resilience_note_move()  # drain/timer-cadence safe point
 
     def _dispatch_move(self, origins, dests, fly, w):
         """Run one tallied move from [n]-shaped staged inputs
@@ -871,9 +965,20 @@ class PumiTally:
                 "volume": np.asarray(self.mesh.volumes),
                 **self._stats_vtk_cell_data(),
             },
+            field_data=self._vtk_field_data(),
         )
         self.tally_times.vtk_file_write_time += time.perf_counter() - t0
         self.tally_times.print_times()
+
+    def _vtk_field_data(self) -> dict:
+        """Campaign-level (non-per-cell) payload for the VTK writers:
+        the cumulative lost-particle counter, so a result file accounts
+        for its own leakage."""
+        return {
+            "lost_particles": np.asarray(
+                [float(self.lost_particles)], np.float64
+            ),
+        }
 
     # -- inspection (white-box surface used by the parity suite) ---------
     def normalized_flux(self) -> jnp.ndarray:

@@ -173,6 +173,26 @@ def cmd_autotune(args) -> None:
               f"TallyConfig({settings})")
 
 
+def _subproc_timeout() -> float:
+    """Helper-subprocess timeout in seconds (default 1800). Deployments
+    with slow toolchains raise it via PUMIUMTALLY_SUBPROC_TIMEOUT; the
+    expiry message names the env var so the fix is discoverable from
+    the failure itself."""
+    raw = os.environ.get("PUMIUMTALLY_SUBPROC_TIMEOUT")
+    if raw is None:
+        return 1800.0
+    try:
+        t = float(raw)
+        if t <= 0:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"PUMIUMTALLY_SUBPROC_TIMEOUT={raw!r} is not a positive "
+            "number of seconds"
+        ) from None
+    return t
+
+
 def cmd_aot_check(args) -> None:
     """Certify that the Pallas walk kernel (and optionally the full
     multi-chip programs) compile for a real TPU target WITHOUT a
@@ -204,10 +224,11 @@ def cmd_aot_check(args) -> None:
                       os.path.join(tools, "aot_multichip_compile.py"),
                       "2048"]))
     rc = 0
+    tmo = _subproc_timeout()
     for label, cmd in jobs:
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=1800, env=env)
+                               timeout=tmo, env=env)
             job_rc, text = r.returncode, (r.stdout + r.stderr)
         except subprocess.TimeoutExpired as e:
             # A hung compile is a result too (the harness exists
@@ -217,7 +238,10 @@ def cmd_aot_check(args) -> None:
             text = "".join(
                 s if isinstance(s, str) else s.decode("utf-8", "replace")
                 for s in (e.stdout, e.stderr) if s
-            ) + "\n(compile timed out after 1800s)"
+            ) + (
+                f"\n(compile timed out after {tmo:g}s; set "
+                "PUMIUMTALLY_SUBPROC_TIMEOUT to extend)"
+            )
         lines = text.strip().splitlines()
         # Success: a terse tail. Failure: the whole child output, so
         # the root cause (e.g. a libtpu-missing error above jax's

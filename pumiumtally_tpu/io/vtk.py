@@ -86,6 +86,7 @@ def write_vtk(
     point_data: Optional[Dict[str, np.ndarray]] = None,
     title: str = "pumiumtally_tpu flux result",
     ascii: bool = False,  # noqa: A002 — matches the VTK keyword
+    field_data: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
     """Write a legacy ``.vtk`` unstructured grid. Dispatches to the XML
     ``.vtu`` writer when ``path`` ends in ``.vtu``.
@@ -93,6 +94,12 @@ def write_vtk(
     Binary mode (default) emits the legacy BINARY encoding: the usual
     ASCII headers with big-endian raw payloads — seconds for a 1M-tet
     mesh. ``ascii=True`` restores the all-text variant.
+
+    ``field_data`` holds DATASET-level scalar arrays (campaign
+    metadata such as ``lost_particles`` — arbitrary length, not tied
+    to cell/point counts); written as a leading ``FIELD FieldData``
+    block in the legacy format and a ``<FieldData>`` element in
+    ``.vtu``.
     """
     if path.endswith(".pvtu"):
         raise ValueError(
@@ -107,7 +114,7 @@ def write_vtk(
                 "path for the ASCII legacy format"
             )
         write_vtu(path, coords, tet2vert, cell_data, point_data,
-                  title=title)
+                  title=title, field_data=field_data)
         return
     coords, tet2vert = _prep(path, coords, tet2vert)
     nv, ne = coords.shape[0], tet2vert.shape[0]
@@ -118,7 +125,20 @@ def write_vtk(
 
         w("# vtk DataFile Version 3.0\n")
         w(title + "\n")
-        w(("ASCII" if ascii else "BINARY") + "\nDATASET UNSTRUCTURED_GRID\n")
+        w(("ASCII" if ascii else "BINARY") + "\n")
+        if field_data:
+            # Dataset field data leads the geometry (the placement
+            # vtkDataReader attaches to the dataset itself).
+            w(f"FIELD FieldData {len(field_data)}\n")
+            for name, arr in field_data.items():
+                arr = np.asarray(arr, dtype=np.float64).reshape(-1)
+                w(f"{name} 1 {arr.shape[0]} double\n")
+                if ascii:
+                    np.savetxt(f, arr, fmt="%.17g")
+                else:
+                    f.write(arr.astype(">f8").tobytes())
+                    w("\n")
+        w("DATASET UNSTRUCTURED_GRID\n")
         w(f"POINTS {nv} double\n")
         if ascii:
             np.savetxt(f, coords, fmt="%.17g")
@@ -160,6 +180,7 @@ def write_vtu(
     cell_data: Optional[Dict[str, np.ndarray]] = None,
     point_data: Optional[Dict[str, np.ndarray]] = None,
     title: str = "pumiumtally_tpu flux result",
+    field_data: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
     """Write an XML ``.vtu`` UnstructuredGrid with raw appended binary
     data (the same file family Omega_h's vtk::write_parallel emits as
@@ -178,7 +199,7 @@ def write_vtu(
     add("connectivity", tet2vert.astype("<i8").reshape(-1), "Int64", 1)
     add("offsets", (4 * np.arange(1, ne + 1, dtype="<i8")), "Int64", 1)
     add("types", np.full(ne, 10, dtype="<u1"), "UInt8", 1)
-    cell_names, point_names = [], []
+    cell_names, point_names, field_names = [], [], []
     for name, arr in (cell_data or {}).items():
         cell_names.append(name)
         add(name, _check_len(name, arr, ne, "cell").astype("<f8"),
@@ -186,6 +207,11 @@ def write_vtu(
     for name, arr in (point_data or {}).items():
         point_names.append(name)
         add(name, _check_len(name, arr, nv, "point").astype("<f8"),
+            "Float64", 1)
+    for name, arr in (field_data or {}).items():
+        field_names.append(name)
+        add(name,
+            np.asarray(arr, dtype=np.float64).reshape(-1).astype("<f8"),
             "Float64", 1)
 
     offsets = []
@@ -213,6 +239,16 @@ def write_vtu(
         'byte_order="LittleEndian" header_type="UInt64">'
     )
     xml.append("<UnstructuredGrid>")
+    if field_names:
+        # Dataset-level field data (campaign metadata): lives on the
+        # grid, outside any piece.
+        xml.append("<FieldData>")
+        nfield = 4 + len(cell_names) + len(point_names)
+        for j, name in enumerate(field_names):
+            i = nfield + j
+            ntup = len(blocks[i][3]) // 8
+            xml.append(da(i, extra=f' NumberOfTuples="{ntup}"'))
+        xml.append("</FieldData>")
     xml.append(f'<Piece NumberOfPoints="{nv}" NumberOfCells="{ne}">')
     xml.append("<Points>")
     xml.append(da(0))
@@ -253,6 +289,7 @@ def write_pvtu(
     cell_data: Optional[Dict[str, np.ndarray]] = None,
     title: str = "pumiumtally_tpu flux result",
     nparts: Optional[int] = None,
+    field_data: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
     """Parallel multi-piece output: one raw-appended ``.vtu`` per owner
     rank plus a ``.pvtu`` index referencing them — the TPU-native
@@ -308,6 +345,10 @@ def write_pvtu(
             local[tets_r],
             cell_data={k: v[sel] for k, v in cell_data.items()},
             title=f"{title} (piece {r}/{nparts})",
+            # Field data is dataset-global (not per-cell): replicated
+            # into every piece so any single piece accounts for the
+            # whole campaign.
+            field_data=field_data,
         )
 
     xml = ['<?xml version="1.0"?>']
@@ -364,6 +405,61 @@ def _clean_errors(fn):
             raise ValueError(f"malformed VTK stream: {e!r}") from e
 
     return wrapped
+
+
+def read_vtk_field_scalars(path: str, name: str) -> np.ndarray:
+    """Pull one dataset-level FIELD array (see ``write_vtk``'s
+    ``field_data``) from a legacy ``.vtk`` (ASCII or BINARY) or
+    ``.vtu`` file written by this module."""
+    if path.endswith(".vtu"):
+        return _read_vtu_array(path, name)
+    with open(path, "rb") as f:
+        data = f.read()
+    header_end = data.find(b"\n", data.find(b"\n") + 1)
+    mode_line = data[header_end + 1: data.find(b"\n", header_end + 1)]
+    return _read_vtk_field(data, name, ascii=mode_line.strip() == b"ASCII")
+
+
+@_clean_errors
+def _read_vtk_field(data: bytes, name: str, ascii: bool) -> np.ndarray:  # noqa: A002
+    """Sequentially parse the leading ``FIELD FieldData`` block (each
+    array must be walked to find the next one's header)."""
+    marker = b"FIELD FieldData "
+    p = data.find(marker)
+    if p < 0:
+        raise KeyError(f"field array {name!r} not found (no FIELD block)")
+    eol = data.find(b"\n", p)
+    narrays = int(data[p + len(marker): eol])
+    pos = eol + 1
+    for _ in range(narrays):
+        eol = data.find(b"\n", pos)
+        if eol < 0:
+            raise ValueError("truncated FIELD array header")
+        aname, ncomp, ntup, _dtype = data[pos:eol].decode("ascii").split()
+        count = int(ncomp) * int(ntup)
+        pos = eol + 1
+        if ascii:
+            vals: list = []
+            while len(vals) < count:
+                eol = data.find(b"\n", pos)
+                if eol < 0:
+                    raise ValueError("truncated FIELD ASCII values")
+                vals.extend(float(v) for v in data[pos:eol].split())
+                pos = eol + 1
+            if aname == name:
+                return np.array(vals[:count])
+        else:
+            payload = data[pos: pos + 8 * count]
+            if len(payload) != 8 * count:
+                raise ValueError(
+                    f"truncated FIELD binary values for {aname!r}"
+                )
+            pos += 8 * count + 1  # trailing newline after the payload
+            if aname == name:
+                return np.frombuffer(payload, dtype=">f8").astype(
+                    np.float64
+                )
+    raise KeyError(f"field array {name!r} not found")
 
 
 @_clean_errors

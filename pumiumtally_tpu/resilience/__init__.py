@@ -1,0 +1,40 @@
+"""Fault-tolerant campaigns (round 8, docs/DESIGN.md "Fault tolerance").
+
+Production TPU fleets get preempted; a long Monte Carlo campaign must
+survive that bitwise. This package layers three mechanisms over the
+checkpoint format (utils/checkpoint.py):
+
+- ``GenerationStore`` / ``resume_latest`` — atomic, sha256-sealed,
+  keep-last-K checkpoint generations with corruption fallback
+  (generations.py);
+- ``CheckpointPolicy`` / ``AutosaveRunner`` — autosave cadence hooked
+  into every engine facade at batch close, plus the SIGTERM/SIGINT
+  graceful-drain handler (policy.py);
+- ``faults`` — the deterministic fault-injection harness
+  (``PUMIUMTALLY_FAULT``) that proves the first two under process
+  kill, truncation, bit flips, and NaN payloads (faults.py).
+
+Everything here is host-side Python over numpy buffers — no jitted
+code, no new trace entry points (config.RETRACE_BUDGETS unchanged).
+"""
+
+from pumiumtally_tpu.resilience.faults import FAULT_ENV, FaultSpec, parse_fault
+from pumiumtally_tpu.resilience.generations import (
+    GenerationStore,
+    ResumeInfo,
+    resume_latest,
+)
+from pumiumtally_tpu.resilience.policy import AutosaveRunner, CheckpointPolicy
+from pumiumtally_tpu.utils.checkpoint import CorruptCheckpointError
+
+__all__ = [
+    "AutosaveRunner",
+    "CheckpointPolicy",
+    "CorruptCheckpointError",
+    "FAULT_ENV",
+    "FaultSpec",
+    "GenerationStore",
+    "ResumeInfo",
+    "parse_fault",
+    "resume_latest",
+]

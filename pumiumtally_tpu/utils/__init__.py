@@ -4,7 +4,11 @@
 from pumiumtally_tpu.utils.autotune import autotune_walk
 from pumiumtally_tpu.utils.logging import get_logger, set_verbosity
 from pumiumtally_tpu.utils.profiling import phase_timer, trace
-from pumiumtally_tpu.utils.checkpoint import load_tally_state, save_tally_state
+from pumiumtally_tpu.utils.checkpoint import (
+    CorruptCheckpointError,
+    load_tally_state,
+    save_tally_state,
+)
 
 __all__ = [
     "autotune_walk",
@@ -14,4 +18,5 @@ __all__ = [
     "trace",
     "save_tally_state",
     "load_tally_state",
+    "CorruptCheckpointError",
 ]

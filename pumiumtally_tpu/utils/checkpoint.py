@@ -6,11 +6,34 @@ run loses the whole tally. Here the complete engine state (flux,
 committed positions, element ids, move counter) round-trips through one
 ``.npz`` file; long campaigns checkpoint between MoveToNextLocation
 calls and resume exactly.
+
+Failure-mode contract (round 8, docs/DESIGN.md "Fault tolerance"):
+
+- ``save_tally_state`` is ATOMIC: the payload is written to a temp file
+  in the target directory, flushed, fsync'd, and ``os.replace``d over
+  the destination — a crash mid-save leaves either the old checkpoint
+  or the new one on disk, never a truncated hybrid.
+- ``load_tally_state`` raises ``CorruptCheckpointError`` (a ValueError)
+  on a truncated/bit-flipped/garbage file instead of leaking raw
+  ``zipfile``/``numpy`` internals; header MISMATCHES (wrong mesh,
+  wrong particle count, too-new format) stay plain ValueError — they
+  mean a mis-configured target, not a damaged file.
+- Besides the canonical cross-engine payload, a checkpoint carries the
+  saving engine's exact slot LAYOUT (partitioned state rows, per-chunk
+  flux): restored into an identically configured engine, transport
+  continues bit-for-bit — the resilience layer's kill-and-resume
+  guarantee. A differently configured target silently falls back to
+  the canonical restore (still exact state, scatter-order flux class).
 """
 
 from __future__ import annotations
 
+import io
+import os
 import warnings
+import zipfile
+import zlib
+from typing import Union
 
 import numpy as np
 
@@ -20,8 +43,28 @@ import numpy as np
 # tallies stay readable by older code; a stats-carrying checkpoint
 # writes v3 and an older reader refuses it up front with the
 # "format ... newer than" header error — never a shape error from
-# half-understood arrays.
+# half-understood arrays. The round-8 layout extras (``eng*_*`` /
+# ``chunk_flux`` / ``lost_total``) do NOT bump the version: old readers
+# ignore unknown keys and restore canonically, which is still a valid
+# (just not bitwise-layout-exact) state.
 _FORMAT_VERSION = 3
+
+# Slot-state rows saved verbatim for layout-exact partitioned restore;
+# must stay in sync with PartitionedEngine.state (a missing key makes
+# the loader fall back to the canonical restore, so drift degrades
+# gracefully).
+_ENGINE_STATE_KEYS = (
+    "x", "lelem", "pending", "pid", "alive", "done", "exited", "lost",
+    "dest", "fly", "w",
+)
+
+
+class CorruptCheckpointError(ValueError):
+    """The checkpoint file itself is damaged (truncated, bit-flipped,
+    or not a checkpoint at all) — as opposed to a well-formed
+    checkpoint that does not fit the target engine (plain ValueError).
+    The generational store (pumiumtally_tpu.resilience) catches this to
+    fall back to an earlier generation."""
 
 
 def _engine_kind(tally) -> str:
@@ -42,16 +85,23 @@ def _engine_kind(tally) -> str:
     return "monolithic"
 
 
-def save_tally_state(tally, path: str) -> None:
-    """Write the full engine state of any tally facade to ``path``.
+def _engine_layout_arrays(eng, prefix: str) -> dict:
+    """One PartitionedEngine's exact slot state, key-prefixed for the
+    checkpoint payload (layout-exact restore; module docstring)."""
+    out = {prefix + k: np.asarray(eng.state[k]) for k in _ENGINE_STATE_KEYS}
+    out[prefix + "flux_padded"] = np.asarray(eng.flux_padded)
+    out[prefix + "cap"] = np.int64(eng.cap)
+    out[prefix + "nparts"] = np.int64(eng.nparts)
+    out[prefix + "L"] = np.int64(eng.part.L)
+    out[prefix + "n"] = np.int64(eng.n)
+    return out
 
-    Monolithic, streaming, and partitioned engines are all supported;
-    the caller-visible canonical form (positions/element ids in particle
-    order, flux in original element order) is what is stored, so a
-    checkpoint can be restored into a DIFFERENT engine configuration
-    over the same mesh (e.g. saved partitioned, resumed monolithic) —
-    the reference has no checkpointing at all (SURVEY.md §5).
-    """
+
+def collect_tally_state(tally) -> dict:
+    """The full checkpoint payload of any facade as a name→array dict
+    (the serialization half of ``save_tally_state``; the resilience
+    generation store serializes the same dict through its digest
+    wrapper)."""
     kind = _engine_kind(tally)
     if kind == "monolithic":
         x = np.asarray(tally.x)
@@ -80,26 +130,140 @@ def save_tally_state(tally, path: str) -> None:
                 else np.asarray(stats.open_flux)
             ),
         }
-    np.savez_compressed(
-        path,
+    # Layout-exact extras (round 8): the saving engine's own slot/chunk
+    # arrangement, so a same-configured target resumes bit-for-bit.
+    # The monolithic/sharded facade's canonical arrays ARE its layout.
+    if kind == "streaming":
+        extra["chunk_flux"] = np.stack(
+            [np.asarray(f) for f in tally._flux]
+        )
+        extra["chunk_size"] = np.int64(tally.chunk_size)
+    elif kind == "partitioned":
+        extra["eng_count"] = np.int64(1)
+        extra.update(_engine_layout_arrays(tally.engine, "eng0_"))
+    elif kind == "streaming_partitioned":
+        extra["eng_count"] = np.int64(len(tally.engines))
+        extra["chunk_size"] = np.int64(tally.chunk_size)
+        for k, eng in enumerate(tally.engines):
+            extra.update(_engine_layout_arrays(eng, f"eng{k}_"))
+    return {
         # Minimum version that can read the payload: plain tallies
         # stay v2-compatible; only stats-carrying checkpoints demand
         # the v3 reader (see _FORMAT_VERSION note).
-        format_version=np.int64(_FORMAT_VERSION if extra else 2),
-        kind=np.str_(kind),
-        flux=np.asarray(tally.flux),
-        x=x,
-        elem=elem,
-        iter_count=np.int64(tally.iter_count),
-        num_particles=np.int64(tally.num_particles),
-        capacity=np.int64(x.shape[0]),
-        nelems=np.int64(tally.mesh.nelems),
-        is_initialized=np.bool_(tally.is_initialized),
+        "format_version": np.int64(
+            _FORMAT_VERSION if stats is not None else 2
+        ),
+        "kind": np.str_(kind),
+        "flux": np.asarray(tally.flux),
+        "x": x,
+        "elem": elem,
+        "iter_count": np.int64(tally.iter_count),
+        "num_particles": np.int64(tally.num_particles),
+        "capacity": np.int64(x.shape[0]),
+        "nelems": np.int64(tally.mesh.nelems),
+        "is_initialized": np.bool_(tally.is_initialized),
+        # Cumulative leakage counter (facade ``lost_particles``, the
+        # rolled part only — the open batch's lost particles ride in
+        # the state itself and re-derive on restore).
+        "lost_total": np.int64(getattr(tally, "_lost_total", 0)),
         **extra,
-    )
+    }
+
+
+def save_tally_state(tally, path: str) -> None:
+    """Write the full engine state of any tally facade to ``path``,
+    ATOMICALLY (temp file + fsync + ``os.replace`` — a crash mid-save
+    never corrupts an existing checkpoint at ``path``).
+
+    Monolithic, streaming, and partitioned engines are all supported;
+    the caller-visible canonical form (positions/element ids in particle
+    order, flux in original element order) is what is stored, so a
+    checkpoint can be restored into a DIFFERENT engine configuration
+    over the same mesh (e.g. saved partitioned, resumed monolithic) —
+    the reference has no checkpointing at all (SURVEY.md §5). The
+    saving engine's exact layout rides along so a SAME-configured
+    engine resumes bit-for-bit (module docstring).
+    """
+    if not path.endswith(".npz"):
+        path += ".npz"  # np.savez's own path convention, kept
+    arrays = collect_tally_state(tally)
+    atomic_write(path, lambda f: np.savez_compressed(f, **arrays))
+
+
+def atomic_write(path: str, write_payload, tmp_path: str = None,
+                 pre_replace=None) -> None:
+    """THE atomic-durability sequence, shared by every checkpoint
+    writer (this module and the resilience generation store): payload →
+    temp file (same directory, so the rename cannot cross filesystems)
+    → flush → fsync → ``os.replace`` → directory fsync. A crash at any
+    instant leaves either the old file or the new one, never a
+    truncated hybrid. ``pre_replace`` runs between the fsync and the
+    rename — the fault harness's kill-mid-save injection point."""
+    tmp = tmp_path or f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_payload(f)
+            f.flush()
+            os.fsync(f.fileno())
+        if pre_replace is not None:
+            pre_replace()
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(d: str) -> None:
+    """Best-effort directory fsync so the rename itself is durable
+    (not just the file bytes) — preemption-safe autosave must survive
+    power loss at any instant."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def read_checkpoint_arrays(path: Union[str, io.IOBase]) -> dict:
+    """Load a checkpoint ``.npz`` (path or file-like object) eagerly
+    into a plain name→array dict.
+
+    Every decompression happens HERE, so damage anywhere in the file
+    surfaces as one ``CorruptCheckpointError`` up front — the caller
+    never has a half-restored tally on its hands. A missing file stays
+    ``FileNotFoundError`` (absence is not corruption)."""
+    label = path if isinstance(path, str) else "<buffer>"
+    try:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+            KeyError, ValueError) as e:
+        raise CorruptCheckpointError(
+            f"corrupt checkpoint {label!r}: not a readable checkpoint "
+            f"archive ({type(e).__name__}: {e}). The file is truncated, "
+            "bit-flipped, or not a checkpoint; restore from an earlier "
+            "generation (pumiumtally_tpu.resilience keeps several)"
+        ) from e
 
 
 def _check_header(z, tally) -> None:
+    for key in ("format_version", "nelems", "num_particles", "flux",
+                "x", "elem", "iter_count", "is_initialized"):
+        if key not in z:
+            raise CorruptCheckpointError(
+                f"corrupt checkpoint: required array {key!r} missing"
+            )
     if int(z["format_version"]) > _FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {int(z['format_version'])} newer than "
@@ -117,15 +281,28 @@ def _check_header(z, tally) -> None:
         )
 
 
-def load_tally_state(tally, path: str) -> None:
+def load_tally_state(tally, path: Union[str, io.IOBase]) -> None:
     """Restore state saved by ``save_tally_state`` into ``tally``.
 
     The target must be built over the same mesh and particle count;
     mismatches raise rather than silently corrupt the tally. The saved
     state is canonical (caller particle order, original element order),
-    so the target's engine kind need not match the saver's.
-    """
+    so the target's engine kind need not match the saver's; when it
+    DOES match — same kind, same layout geometry — the saved layout
+    extras restore the engine bit-for-bit instead. A damaged file
+    raises ``CorruptCheckpointError`` before the tally is touched.
+    ``path`` may be a file-like object (the resilience generation
+    store's verified payloads load through a BytesIO)."""
+    z = read_checkpoint_arrays(path)
+    apply_tally_state(tally, z)
+
+
+def apply_tally_state(tally, z: dict) -> None:
+    """Restore an already-loaded checkpoint dict (see
+    ``read_checkpoint_arrays``) into ``tally``."""
     import jax.numpy as jnp
+
+    _check_header(z, tally)
 
     # Restoring rewrites committed positions out from under the
     # auto-continue echo check — invalidate its bookkeeping.
@@ -133,28 +310,33 @@ def load_tally_state(tally, path: str) -> None:
         tally._last_dests_host = None
         tally._last_dests_dev = None
         tally._echo_misses = 0
+    if hasattr(tally, "_lost_total"):
+        tally._lost_total = int(z.get("lost_total", 0))
 
     kind = _engine_kind(tally)
-    with np.load(path) as z:
-        _check_header(z, tally)
-        n = tally.num_particles
-        flux = np.asarray(z["flux"], dtype=np.float64)
-        x = np.asarray(z["x"], dtype=np.float64)[:n]
-        elem = np.asarray(z["elem"], dtype=np.int32)[:n]
-        saved_kind = str(z["kind"]) if "kind" in z else "monolithic"
-        if saved_kind == "monolithic" and kind == "monolithic":
-            # v1-compatible direct restore (capacity layout preserved
-            # only when both sides are monolithic with equal capacity).
-            if int(z["capacity"]) == tally._cap:
-                tally.flux = jnp.asarray(z["flux"], dtype=tally.dtype)
-                tally.x = jnp.asarray(z["x"], dtype=tally.dtype)
-                tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
-                tally.iter_count = int(z["iter_count"])
-                tally.is_initialized = bool(z["is_initialized"])
-                _restore_stats(tally, z)
-                return
-        _restore_canonical(tally, kind, x, elem, flux, z)
+    n = tally.num_particles
+    flux = np.asarray(z["flux"], dtype=np.float64)
+    x = np.asarray(z["x"], dtype=np.float64)[:n]
+    elem = np.asarray(z["elem"], dtype=np.int32)[:n]
+    saved_kind = str(z["kind"]) if "kind" in z else "monolithic"
+    if saved_kind == "monolithic" and kind == "monolithic":
+        # v1-compatible direct restore (capacity layout preserved
+        # only when both sides are monolithic with equal capacity).
+        if int(z["capacity"]) == tally._cap:
+            tally.flux = jnp.asarray(z["flux"], dtype=tally.dtype)
+            tally.x = jnp.asarray(z["x"], dtype=tally.dtype)
+            tally.elem = jnp.asarray(z["elem"], dtype=jnp.int32)
+            tally.iter_count = int(z["iter_count"])
+            tally.is_initialized = bool(z["is_initialized"])
+            _restore_stats(tally, z)
+            return
+    if saved_kind == kind and _restore_layout_exact(tally, kind, z):
+        tally.iter_count = int(z["iter_count"])
+        tally.is_initialized = bool(z["is_initialized"])
         _restore_stats(tally, z)
+        return
+    _restore_canonical(tally, kind, x, elem, flux, z)
+    _restore_stats(tally, z)
 
 
 def _restore_stats(tally, z) -> None:
@@ -172,7 +354,7 @@ def _restore_stats(tally, z) -> None:
     A stats checkpoint read by a pre-v3 reader never reaches here: its
     header check refuses "format 3 newer than 2" up front."""
     stats = getattr(tally, "_stats", None)
-    has = "stats_flux_sum" in getattr(z, "files", ())
+    has = "stats_flux_sum" in z
     if stats is None:
         if has:
             warnings.warn(
@@ -193,6 +375,93 @@ def _restore_stats(tally, z) -> None:
         int(z["stats_moves_in_batch"]),
         z["stats_open_flux"] if bool(z["stats_batch_open"]) else None,
     )
+
+
+def _engine_layout_matches(eng, z, prefix: str) -> bool:
+    """The saved layout fits this engine verbatim: same slot geometry
+    and every state row present."""
+    for key, want in (
+        ("cap", eng.cap), ("nparts", eng.nparts),
+        ("L", eng.part.L), ("n", eng.n),
+    ):
+        if prefix + key not in z or int(z[prefix + key]) != int(want):
+            return False
+    return all(prefix + k in z for k in _ENGINE_STATE_KEYS) and (
+        prefix + "flux_padded" in z
+    )
+
+
+def _restore_engine_layout(eng, z, prefix: str) -> None:
+    import jax.numpy as jnp
+
+    eng.state = {
+        k: jnp.asarray(z[prefix + k], dtype=eng.state[k].dtype)
+        for k in _ENGINE_STATE_KEYS
+    }
+    eng.flux_padded = jnp.asarray(
+        z[prefix + "flux_padded"], dtype=eng.flux_padded.dtype
+    )
+    eng._n_lost_dev = jnp.sum(eng.state["lost"])
+    eng._n_lost_cache = None
+
+
+def _restore_layout_exact(tally, kind, z) -> bool:
+    """Try the layout-exact restore path (module docstring). Returns
+    False — leaving the tally untouched — whenever the saved layout
+    does not fit this target exactly; the caller then falls back to
+    the canonical restore."""
+    import jax.numpy as jnp
+
+    if kind == "streaming":
+        cf = z.get("chunk_flux")
+        if (
+            cf is None
+            or "chunk_size" not in z
+            or int(z["chunk_size"]) != tally.chunk_size
+            or cf.shape[0] != tally.nchunks
+        ):
+            return False
+        # Positions/elements restore through the canonical staging
+        # (exact: the canonical arrays are bit-copies of the chunk
+        # state), then the per-chunk flux split replaces the
+        # all-in-chunk-0 canonical layout so the flux SUM reproduces
+        # the saving engine's addition order bit-for-bit.
+        n = tally.num_particles
+        _restore_canonical(
+            tally, kind,
+            np.asarray(z["x"], dtype=np.float64)[:n],
+            np.asarray(z["elem"], dtype=np.int32)[:n],
+            np.asarray(z["flux"], dtype=np.float64), z,
+        )
+        tally._flux = [
+            jnp.asarray(cf[k], dtype=tally.dtype)
+            for k in range(tally.nchunks)
+        ]
+        return True
+    if kind == "partitioned":
+        eng = tally.engine
+        if int(z.get("eng_count", 0)) != 1 or not _engine_layout_matches(
+            eng, z, "eng0_"
+        ):
+            return False
+        _restore_engine_layout(eng, z, "eng0_")
+        return True
+    if kind == "streaming_partitioned":
+        engines = tally.engines
+        if (
+            int(z.get("eng_count", 0)) != len(engines)
+            or "chunk_size" not in z
+            or int(z["chunk_size"]) != tally.chunk_size
+            or not all(
+                _engine_layout_matches(eng, z, f"eng{k}_")
+                for k, eng in enumerate(engines)
+            )
+        ):
+            return False
+        for k, eng in enumerate(engines):
+            _restore_engine_layout(eng, z, f"eng{k}_")
+        return True
+    return False
 
 
 def _restore_canonical(tally, kind, x, elem, flux, z) -> None:

@@ -1,0 +1,137 @@
+"""Subprocess campaign driver for the kill-and-resume tests.
+
+Runs one deterministic multi-batch campaign on a chosen engine facade
+with autosave armed, so tests/test_resilience.py can kill it
+(injected SIGTERM drain, or hard SIGKILL mid-save via
+PUMIUMTALLY_FAULT) and relaunch it with ``--resume``:
+
+    python tests/_resilience_driver.py --facade part \
+        --ckpt-dir /tmp/ck --out /tmp/flux.npy [--resume]
+
+The campaign is B source batches x M continue-mode moves, all inputs
+derived from one seeded rng — every process (fresh, killed, resumed)
+computes the identical trajectory and indexes into it by the restored
+``iter_count``, so a resumed run re-drives exactly the batches the
+dead one had not finished. Not collected by pytest (no ``test_``
+prefix); runnable standalone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCHES = 4
+MOVES = 2
+N = 96
+MESH_ARGS = (1, 1, 1, 3, 3, 3)
+SEED = 1234
+
+
+def build(facade, ckpt_dir):
+    from pumiumtally_tpu import (
+        CheckpointPolicy,
+        PartitionedPumiTally,
+        PumiTally,
+        StreamingPartitionedTally,
+        StreamingTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    policy = CheckpointPolicy(dir=ckpt_dir, every_n_batches=1, keep=3)
+    mesh = build_box(*MESH_ARGS)
+    if facade == "mono":
+        return PumiTally(
+            mesh, N, TallyConfig(checkpoint=policy, check_found_all=False)
+        )
+    if facade == "sharded":
+        return PumiTally(
+            mesh, N,
+            TallyConfig(checkpoint=policy, check_found_all=False,
+                        device_mesh=make_device_mesh(4)),
+        )
+    if facade == "stream":
+        return StreamingTally(
+            mesh, N, chunk_size=40,
+            config=TallyConfig(checkpoint=policy, check_found_all=False),
+        )
+    if facade == "part":
+        return PartitionedPumiTally(
+            mesh, N,
+            TallyConfig(checkpoint=policy, check_found_all=False,
+                        capacity_factor=4.0),
+        )
+    if facade == "stream_part":
+        return StreamingPartitionedTally(
+            mesh, N, chunk_size=40,
+            config=TallyConfig(checkpoint=policy, check_found_all=False,
+                               device_mesh=make_device_mesh(4),
+                               capacity_factor=6.0),
+        )
+    raise SystemExit(f"unknown facade {facade!r}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--facade", required=True,
+                   choices=["mono", "sharded", "stream", "part",
+                            "stream_part"])
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--resume", action="store_true")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_ENABLE_X64", "true")
+    if args.facade in ("sharded", "stream_part"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+
+    import numpy as np
+
+    rng = np.random.default_rng(SEED)
+    src = rng.uniform(0.1, 0.9, (BATCHES, N, 3))
+    dst = rng.uniform(0.1, 0.9, (BATCHES, MOVES, N, 3))
+
+    t = build(args.facade, args.ckpt_dir)
+    start_batch, done_moves = 0, 0
+    if args.resume:
+        from pumiumtally_tpu import resume_latest
+
+        info = resume_latest(t)
+        if info is not None:
+            # Move-granular resume: a graceful drain leaves a
+            # batch-aligned newest generation (done_moves == 0), but a
+            # drain SAFETY save survived by a hard kill — or an
+            # every_seconds save — can land mid-batch; then the
+            # restored state already contains that batch's sources and
+            # first done_moves moves, so re-drive only the remainder.
+            start_batch, done_moves = divmod(t.iter_count, MOVES)
+            print(f"resumed generation {info.generation} at batch "
+                  f"{start_batch} (iter_count {t.iter_count})")
+    for b in range(start_batch, BATCHES):
+        skip = done_moves if b == start_batch else 0
+        if skip == 0:
+            # A mid-batch restore already localized this batch's
+            # sources; re-sourcing would rewind committed positions.
+            t.CopyInitialPosition(src[b].reshape(-1).copy())
+        for m in range(skip, MOVES):
+            t.MoveToNextLocation(None, dst[b, m].reshape(-1).copy())
+    # The final batch never closes via re-sourcing; seal the campaign
+    # with an explicit generation so a corrupted-latest test can fall
+    # back past it.
+    t.checkpoint_now(final=True)
+    np.save(args.out, np.asarray(t.flux, np.float64))
+
+
+if __name__ == "__main__":
+    main()

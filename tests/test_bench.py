@@ -210,6 +210,28 @@ def test_batch_stats_row(bench):
     assert res["compiles"]["trigger_eval"] == 1
 
 
+def test_resilience_row(bench):
+    """The fault-tolerance component row: schema keys present, bitwise
+    flux parity between the autosave-on/off arms asserted (the tool
+    raises otherwise), a positive fenced per-save cost and on-disk
+    generation size, the live keep-K prune, and the host-side-only
+    contract — zero compiles attributable to the resilience layer
+    (``timed == 0`` and the totals are the engine's own warmup)."""
+    res = bench.run_resilience_ab()
+    for key in ("on_moves_per_sec", "off_moves_per_sec",
+                "autosave_overhead_pct", "save_ms", "ckpt_bytes",
+                "generations_written", "generations_retained",
+                "flux_parity_bitwise", "compiles", "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["on_moves_per_sec"] > 0 and res["off_moves_per_sec"] > 0
+    assert res["save_ms"] > 0 and res["ckpt_bytes"] > 0
+    # 6 batch-close autosaves + 5 manual microcost saves; keep=2.
+    assert res["generations_written"] >= 8
+    assert res["generations_retained"] == res["keep"] == 2
+    assert res["compiles"]["timed"] == 0
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

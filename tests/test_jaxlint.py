@@ -532,3 +532,26 @@ def test_stats_subsystem_registered_and_pragma_free():
             assert "jaxlint: disable" not in fh.read(), (
                 f"{f}: the stats modules ship pragma-free"
             )
+
+
+def test_resilience_subsystem_registered_and_pragma_free():
+    """The fault-tolerance modules (r8) must be IN the self-check's
+    file set and hold the strongest form of the clean contract: zero
+    violations with zero pragmas — the resilience layer is host-side
+    Python over numpy buffers (no jitted code at all), so it has no
+    excuse for even a justified suppression."""
+    import glob
+
+    res_dir = os.path.join(REPO, "pumiumtally_tpu", "resilience")
+    files = sorted(glob.glob(os.path.join(res_dir, "*.py")))
+    names = {os.path.basename(f) for f in files}
+    assert {"__init__.py", "generations.py", "policy.py",
+            "faults.py"} <= names
+    from pumiumtally_tpu.analysis import lint_paths
+
+    assert lint_paths(files) == []
+    for f in files:
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the resilience modules ship pragma-free"
+            )

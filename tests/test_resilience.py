@@ -1,0 +1,733 @@
+"""Fault-tolerance suite (round 8): atomic generational checkpoints,
+autosave/drain policy, fault injection, kill-and-resume.
+
+Two layers:
+
+- in-process: store mechanics (atomicity, digest fallback, pruning),
+  policy cadence, drain semantics, the bitwise resume contract on
+  every engine facade, and the lost-particle accounting satellite;
+- subprocess: the acceptance gate — a campaign killed mid-flight
+  (graceful SIGTERM drain AND hard SIGKILL mid-save, both injected
+  deterministically via PUMIUMTALLY_FAULT) resumes from the surviving
+  generation and reproduces the uninterrupted run's final flux
+  BITWISE; a deliberately corrupted latest generation is skipped with
+  a warning, never a crash.
+"""
+
+import io
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    CheckpointPolicy,
+    PartitionedPumiTally,
+    PumiTally,
+    StreamingPartitionedTally,
+    StreamingTally,
+    TallyConfig,
+    build_box,
+    resume_latest,
+)
+from pumiumtally_tpu.resilience import (
+    CorruptCheckpointError,
+    GenerationStore,
+    parse_fault,
+)
+from pumiumtally_tpu.utils import load_tally_state, save_tally_state
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_resilience_driver.py")
+
+N = 24
+MESH_ARGS = (1, 1, 1, 3, 3, 3)
+
+
+def _mesh():
+    return build_box(*MESH_ARGS)
+
+
+def _policy(tmp_path, **kw):
+    kw.setdefault("handle_signals", False)
+    return CheckpointPolicy(dir=str(tmp_path / "ck"), **kw)
+
+
+def _drive(t, rng, moves=1):
+    src = rng.uniform(0.1, 0.9, (t.num_particles, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    for _ in range(moves):
+        dst = rng.uniform(0.1, 0.9, (t.num_particles, 3))
+        t.MoveToNextLocation(None, dst.reshape(-1).copy())
+
+
+# ---------------------------------------------------------------------------
+# Atomic save + corrupt-checkpoint errors (satellite: load_tally_state
+# on garbage must raise a clear error, not a raw zipfile traceback)
+# ---------------------------------------------------------------------------
+
+def test_load_garbage_npz_clear_error(tmp_path):
+    t = PumiTally(_mesh(), N)
+    bad = tmp_path / "garbage.npz"
+    bad.write_bytes(b"this is not a zip archive at all")
+    with pytest.raises(CorruptCheckpointError, match="corrupt checkpoint"):
+        load_tally_state(t, str(bad))
+
+
+def test_load_truncated_npz_clear_error(tmp_path):
+    t = PumiTally(_mesh(), N)
+    _drive(t, np.random.default_rng(0))
+    ckpt = tmp_path / "state.npz"
+    save_tally_state(t, str(ckpt))
+    data = ckpt.read_bytes()
+    ckpt.write_bytes(data[: int(len(data) * 0.6)])  # cut the tail
+    t2 = PumiTally(_mesh(), N)
+    with pytest.raises(CorruptCheckpointError, match="corrupt checkpoint"):
+        load_tally_state(t2, str(ckpt))
+    # Missing files stay FileNotFoundError: absence is not corruption.
+    with pytest.raises(FileNotFoundError):
+        load_tally_state(t2, str(tmp_path / "never_written.npz"))
+
+
+def test_save_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A failing save must leave the previous checkpoint intact and no
+    temp litter — the temp-write + os.replace contract."""
+    t = PumiTally(_mesh(), N)
+    _drive(t, np.random.default_rng(1))
+    ckpt = tmp_path / "state.npz"
+    save_tally_state(t, str(ckpt))
+    good = ckpt.read_bytes()
+
+    def boom(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="disk full"):
+        save_tally_state(t, str(ckpt))
+    monkeypatch.undo()
+    assert ckpt.read_bytes() == good  # old checkpoint untouched
+    assert [p for p in os.listdir(tmp_path) if "tmp" in p] == []
+
+
+# ---------------------------------------------------------------------------
+# Generation store: digest, fallback, pruning, payload validation
+# ---------------------------------------------------------------------------
+
+def _store_with_gens(tmp_path, n_gens=3, keep=5):
+    t = PumiTally(_mesh(), N)
+    rng = np.random.default_rng(7)
+    store = GenerationStore(str(tmp_path / "gens"), keep=keep)
+    fluxes = []
+    for g in range(n_gens):
+        _drive(t, rng)
+        store.save(t, meta={"g": g})
+        fluxes.append(np.asarray(t.flux, np.float64))
+    return t, store, fluxes
+
+
+def test_generation_store_latest_and_prune(tmp_path):
+    _, store, fluxes = _store_with_gens(tmp_path, n_gens=5, keep=2)
+    gens = store.generations()
+    assert [g for g, _ in gens] == [4, 5]  # oldest pruned, newest kept
+    t2 = PumiTally(_mesh(), N)
+    info = store.load_latest(t2)
+    assert info.generation == 5 and info.meta["g"] == 4
+    np.testing.assert_array_equal(np.asarray(t2.flux, np.float64), fluxes[-1])
+
+
+@pytest.mark.parametrize("damage", ["truncate", "bitflip", "header"])
+def test_generation_fallback_past_damage(tmp_path, damage):
+    """Storage damage on the newest generation: warn, fall back one
+    generation, never crash."""
+    _, store, fluxes = _store_with_gens(tmp_path, n_gens=3)
+    gen, path = store.generations()[-1]
+    data = bytearray(open(path, "rb").read())
+    if damage == "truncate":
+        open(path, "wb").write(data[:-80])
+    elif damage == "bitflip":
+        data[len(data) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+    else:  # garbage header
+        open(path, "wb").write(b"EHLO" + bytes(data))
+    t2 = PumiTally(_mesh(), N)
+    with pytest.warns(UserWarning, match="corrupt.*falling back"):
+        info = store.load_latest(t2)
+    assert info.generation == gen - 1
+    np.testing.assert_array_equal(np.asarray(t2.flux, np.float64), fluxes[-2])
+
+
+def test_generation_fallback_past_nan_payload(tmp_path, monkeypatch):
+    """A digest-clean generation carrying NaN flux (the nan@gen fault:
+    poisoned BEFORE sealing) must be rejected by payload validation and
+    fall back, same as storage damage."""
+    t, store, fluxes = _store_with_gens(tmp_path, n_gens=2)
+    monkeypatch.setenv("PUMIUMTALLY_FAULT", "nan@gen:3")
+    rng = np.random.default_rng(11)
+    _drive(t, rng)
+    store.save(t)  # generation 3, NaN-poisoned but digest-valid
+    monkeypatch.delenv("PUMIUMTALLY_FAULT")
+    payload, _, _ = store.read_generation(store.generations()[-1][1])
+    assert np.isnan(np.load(io.BytesIO(payload))["flux"]).all()  # sealed NaN
+    t2 = PumiTally(_mesh(), N)
+    with pytest.warns(UserWarning, match="non-finite"):
+        info = store.load_latest(t2)
+    assert info.generation == 2
+    np.testing.assert_array_equal(np.asarray(t2.flux, np.float64), fluxes[-1])
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    _, store, _ = _store_with_gens(tmp_path, n_gens=2)
+    for _, path in store.generations():
+        open(path, "wb").write(b"\x00" * 100)
+    t2 = PumiTally(_mesh(), N)
+    with pytest.warns(UserWarning):
+        with pytest.raises(CorruptCheckpointError, match="every checkpoint"):
+            store.load_latest(t2)
+
+
+def test_empty_store_returns_none(tmp_path):
+    t = PumiTally(_mesh(), N)
+    assert GenerationStore(str(tmp_path / "empty")).load_latest(t) is None
+
+
+def test_header_mismatch_is_config_error_not_corruption(tmp_path):
+    """A VALID generation that does not fit the target raises the
+    header ValueError immediately — falling back would be wrong (older
+    generations would not fit either)."""
+    _, store, _ = _store_with_gens(tmp_path, n_gens=2)
+    wrong_n = PumiTally(_mesh(), N + 1)
+    with pytest.raises(ValueError, match="particles"):
+        store.load_latest(wrong_n)
+
+
+def test_fault_spec_grammar():
+    f = parse_fault("kill@save:3")
+    assert (f.action, f.site, f.ordinal, f.arg) == ("kill", "save", 3, None)
+    assert parse_fault("truncate@gen:2:128").arg == 128
+    for bad in ("kill@gen:1", "kill@save", "kill@save:0", "frob@save:1",
+                "kill@save:1:2:3", "killsave:1"):
+        with pytest.raises(ValueError, match="PUMIUMTALLY_FAULT"):
+            parse_fault(bad)
+
+
+# ---------------------------------------------------------------------------
+# Autosave policy: cadence + drain
+# ---------------------------------------------------------------------------
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="every_n_batches"):
+        CheckpointPolicy(dir="/tmp/x", every_n_batches=0)
+    with pytest.raises(ValueError, match="every_seconds"):
+        CheckpointPolicy(dir="/tmp/x", every_seconds=0.0)
+    with pytest.raises(ValueError, match="keep"):
+        CheckpointPolicy(dir="/tmp/x", keep=0)
+    with pytest.raises(ValueError, match="CheckpointPolicy"):
+        TallyConfig(checkpoint="not-a-policy")
+
+
+def test_autosave_every_n_batches(tmp_path):
+    pol = _policy(tmp_path, every_n_batches=2, keep=10)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    rng = np.random.default_rng(3)
+    for _ in range(5):  # closes 4 batches (the 5th stays open)
+        _drive(t, rng)
+    store = t._resilience.store
+    # Batches close at sourcings 2..5; cadence 2 -> saves at closes 2, 4.
+    assert [g for g, _ in store.generations()] == [1, 2]
+    _, _, meta = store.read_generation(store.generations()[-1][1])
+    assert meta["batches_closed"] == 4 and meta["reason"] == "batch_close"
+    assert meta["iter_count"] == 4
+
+
+def test_autosave_every_seconds(tmp_path, monkeypatch):
+    from pumiumtally_tpu.resilience import policy as policy_mod
+
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(policy_mod.time, "monotonic", lambda: clock["t"])
+    pol = _policy(tmp_path, every_n_batches=None, every_seconds=30.0)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    rng = np.random.default_rng(4)
+    _drive(t, rng, moves=2)   # timer not yet due: no saves
+    store = t._resilience.store
+    assert store.generations() == []
+    clock["t"] += 31.0
+    dst = rng.uniform(0.1, 0.9, (N, 3))
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())  # move-end save
+    assert [g for g, _ in store.generations()] == [1]
+    _, _, meta = store.read_generation(store.generations()[0][1])
+    assert meta["reason"] == "every_seconds"
+
+
+def test_empty_batch_is_not_a_cadence_tick(tmp_path):
+    pol = _policy(tmp_path, every_n_batches=1)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    rng = np.random.default_rng(5)
+    src = rng.uniform(0.1, 0.9, (N, 3))
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.CopyInitialPosition(src.reshape(-1).copy())  # empty batch: no save
+    assert t._resilience.store.generations() == []
+    assert t._resilience.batches_closed == 0
+
+
+def test_drain_sigterm_saves_and_exits(tmp_path):
+    """First SIGTERM sets the drain flag; each further move end writes
+    a SAFETY generation (bounded loss if the grace window expires) but
+    keeps running; the in-flight source batch finishes and its close
+    saves + exits 0; handlers restored."""
+    pol = _policy(tmp_path, every_n_batches=None, handle_signals=True)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    runner = t._resilience
+    try:
+        assert signal.getsignal(signal.SIGTERM) is not prev_term
+        rng = np.random.default_rng(6)
+        src = rng.uniform(0.1, 0.9, (N, 3))
+        dst = rng.uniform(0.1, 0.9, (N, 3))
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        os.kill(os.getpid(), signal.SIGTERM)  # handler runs synchronously
+        assert runner.drain_requested
+        # Mid-batch move: completes, writes a safety gen, NO exit —
+        # the in-flight source batch is allowed to finish.
+        t.MoveToNextLocation(None, dst.reshape(-1).copy())
+        gens = runner.store.generations()
+        assert len(gens) == 1
+        _, _, meta = runner.store.read_generation(gens[0][1])
+        assert meta["reason"] == "drain_safety" and meta["iter_count"] == 1
+        # The batch close is the clean-exit point.
+        with pytest.raises(SystemExit) as exc:
+            t.CopyInitialPosition(src.reshape(-1).copy())
+        assert exc.value.code == 0
+        gens = runner.store.generations()
+        # Same state as the safety gen (nothing moved since): the
+        # drain exit deduplicates instead of writing a twin.
+        assert len(gens) == 1
+        # The move COMPLETED before the safety save (drain never
+        # aborts device work): the saved flux is the post-move flux.
+        t2 = PumiTally(_mesh(), N)
+        GenerationStore(pol.dir).load_latest(t2)
+        np.testing.assert_array_equal(
+            np.asarray(t2.flux), np.asarray(t.flux)
+        )
+        assert signal.getsignal(signal.SIGTERM) == prev_term  # restored
+    finally:
+        runner.close()
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_drain_safety_generation_resumes_midbatch_bitwise(tmp_path):
+    """A drain safety generation survived by a hard kill lands
+    MID-batch; the move-granular resume recipe (skip re-sourcing, skip
+    the done moves) must continue bitwise — the real preemption timing
+    where the grace window expires before the batch closes."""
+    pol = _policy(tmp_path, every_n_batches=None, handle_signals=True)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    rng = np.random.default_rng(16)
+    src = rng.uniform(0.1, 0.9, (N, 3))
+    d1 = rng.uniform(0.1, 0.9, (N, 3))
+    d2 = rng.uniform(0.1, 0.9, (N, 3))
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    try:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.MoveToNextLocation(None, d1.reshape(-1).copy())  # safety gen
+        # (hard kill here in real life: the batch close never runs)
+    finally:
+        t._resilience.close()
+        signal.signal(signal.SIGTERM, prev_term)
+
+    t_ref = PumiTally(_mesh(), N)  # uninterrupted arm, no checkpoints
+    t_ref.CopyInitialPosition(src.reshape(-1).copy())
+    t_ref.MoveToNextLocation(None, d1.reshape(-1).copy())
+    t_ref.MoveToNextLocation(None, d2.reshape(-1).copy())
+
+    t2 = PumiTally(_mesh(), N, TallyConfig(checkpoint=_policy(tmp_path)))
+    info = resume_latest(t2)
+    assert info.meta["reason"] == "drain_safety"
+    start, done = divmod(t2.iter_count, 2)
+    assert (start, done) == (0, 1)  # mid-batch: sources already in
+    t2.MoveToNextLocation(None, d2.reshape(-1).copy())  # remainder only
+    np.testing.assert_array_equal(
+        np.asarray(t2.flux), np.asarray(t_ref.flux)
+    )
+    np.testing.assert_array_equal(t2.positions, t_ref.positions)
+
+
+def test_checkpoint_now_consumes_pending_drain(tmp_path):
+    """A SIGTERM during the FINAL batch (whose close no re-sourcing
+    will ever run) must not be absorbed: the campaign's sealing
+    checkpoint_now saves, restores the signal handlers, and exits 0."""
+    pol = _policy(tmp_path, every_n_batches=None, handle_signals=True)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    try:
+        _drive(t, np.random.default_rng(17))
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(SystemExit) as exc:
+            t.checkpoint_now(final=True)
+        assert exc.value.code == 0
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        gens = t._resilience.store.generations()
+        _, _, meta = t._resilience.store.read_generation(gens[-1][1])
+        # The seal itself is the saved generation (reason manual with
+        # the caller's extras; a separate drain twin is not written).
+        assert meta["reason"] == "manual" and meta["final"] is True
+    finally:
+        t._resilience.close()
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_save_meta_reserved_keys_win(tmp_path):
+    """checkpoint_now extras must not shadow the runner's bookkeeping
+    keys — sync_from_resume reads them back into the cadence state."""
+    pol = _policy(tmp_path)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    _drive(t, np.random.default_rng(18))
+    t.checkpoint_now(iter_count=999, reason="lies", tag="ok")
+    store = t._resilience.store
+    _, _, meta = store.read_generation(store.generations()[-1][1])
+    assert meta["iter_count"] == 1 and meta["reason"] == "manual"
+    assert meta["tag"] == "ok"
+
+
+def test_second_runner_takes_over_and_escalation_still_kills(tmp_path):
+    """With several checkpoint-armed tallies the NEWEST runner owns the
+    drain handler, and the second-signal escalation restores the
+    original (pre-any-runner) disposition — stale runners can never
+    absorb the operator's 'kill now' signal."""
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    pol_a = CheckpointPolicy(dir=str(tmp_path / "a"), handle_signals=True)
+    pol_b = CheckpointPolicy(dir=str(tmp_path / "b"), handle_signals=True)
+    t_a = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol_a))
+    t_b = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol_b))
+    try:
+        os.kill(os.getpid(), signal.SIGINT)
+        assert t_b._resilience.drain_requested  # newest runner owns it
+        assert not t_a._resilience.drain_requested
+        with pytest.raises(KeyboardInterrupt):  # SECOND signal kills —
+            os.kill(os.getpid(), signal.SIGINT)  # never a third
+        assert signal.getsignal(signal.SIGINT) == prev_int  # originals
+        assert signal.getsignal(signal.SIGTERM) == prev_term  # restored
+    finally:
+        t_b._resilience.close()
+        t_a._resilience.close()
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_second_sigint_escalates(tmp_path):
+    """A second signal while draining restores the previous disposition
+    and re-delivers — the operator's double ctrl-C still interrupts."""
+    pol = _policy(tmp_path, handle_signals=True)
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    runner = t._resilience
+    try:
+        os.kill(os.getpid(), signal.SIGINT)
+        assert runner.drain_requested
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+    finally:
+        runner.close()
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise resume contract, every facade, in-process
+# ---------------------------------------------------------------------------
+
+def _build_facade(facade, n=N):
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    mesh = _mesh()
+    if facade == "mono":
+        return PumiTally(mesh, n)
+    if facade == "sharded":
+        return PumiTally(
+            mesh, n, TallyConfig(device_mesh=make_device_mesh(4))
+        )
+    if facade == "stream":
+        return StreamingTally(mesh, n, chunk_size=10)
+    if facade == "part":
+        return PartitionedPumiTally(
+            mesh, n, TallyConfig(capacity_factor=4.0)
+        )
+    if facade == "stream_part":
+        return StreamingPartitionedTally(
+            mesh, n, chunk_size=10,
+            config=TallyConfig(device_mesh=make_device_mesh(4),
+                               capacity_factor=6.0),
+        )
+    raise AssertionError(facade)
+
+
+@pytest.mark.parametrize(
+    "facade", ["mono", "sharded", "stream", "part", "stream_part"]
+)
+def test_resume_is_bitwise_on_every_facade(facade, tmp_path):
+    """The layout-exact restore contract at the HARDEST point: save
+    MID-source-batch (sources localized, one of two moves done — the
+    state a drain safety save or an every_seconds save captures),
+    restore into an identically configured engine, continue
+    move-granularly — flux, positions, and element ids all stay
+    BITWISE equal to the uninterrupted run through further batches."""
+    def trajectory():
+        rng = np.random.default_rng(42)
+        return [
+            (rng.uniform(0.1, 0.9, (N, 3)),
+             [rng.uniform(0.1, 0.9, (N, 3)) for _ in range(2)])
+            for _ in range(3)
+        ]
+
+    work = trajectory()
+
+    def run_batch(t, batch, skip_moves=0):
+        src, dests = batch
+        if skip_moves == 0:
+            t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests[skip_moves:]:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+    t_full = _build_facade(facade)
+    run_batch(t_full, work[0])
+    src1, dests1 = work[1]
+    t_full.CopyInitialPosition(src1.reshape(-1).copy())
+    t_full.MoveToNextLocation(None, dests1[0].reshape(-1).copy())
+    ckpt = str(tmp_path / "mid.npz")
+    save_tally_state(t_full, ckpt)  # MID batch 1: move 1 of 2 done
+    t_res = _build_facade(facade)
+    load_tally_state(t_res, ckpt)
+    assert divmod(t_res.iter_count, 2) == (1, 1)
+    run_batch(t_full, work[1], skip_moves=1)
+    run_batch(t_res, work[1], skip_moves=1)  # remainder only, no re-source
+    for t in (t_full, t_res):
+        run_batch(t, work[2])
+    np.testing.assert_array_equal(
+        np.asarray(t_res.flux), np.asarray(t_full.flux), err_msg=facade
+    )
+    np.testing.assert_array_equal(t_res.positions, t_full.positions)
+    np.testing.assert_array_equal(t_res.elem_ids, t_full.elem_ids)
+
+
+def test_layout_mismatch_falls_back_to_canonical(tmp_path):
+    """A partitioned checkpoint restored into a DIFFERENTLY laid-out
+    partitioned engine (different capacity) must still restore
+    correctly through the canonical path (exact state; flux scatter
+    order may differ on later moves, which is the documented class)."""
+    t = PartitionedPumiTally(_mesh(), N, TallyConfig(capacity_factor=4.0))
+    _drive(t, np.random.default_rng(8))
+    ckpt = str(tmp_path / "p.npz")
+    save_tally_state(t, ckpt)
+    t2 = PartitionedPumiTally(_mesh(), N, TallyConfig(capacity_factor=2.0))
+    load_tally_state(t2, ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(t2.flux, np.float64), np.asarray(t.flux, np.float64)
+    )
+    np.testing.assert_array_equal(t2.elem_ids, t.elem_ids)
+
+
+# ---------------------------------------------------------------------------
+# Lost-particle accounting (satellite): cumulative counter + VTK field
+# ---------------------------------------------------------------------------
+
+def _sources_with_lost(rng, n, n_lost):
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    src[:n_lost] = [2.5, 2.5, 2.5]  # outside the unit box: no element
+    return src
+
+
+def test_lost_particles_counter_partitioned():
+    t = PartitionedPumiTally(_mesh(), N, TallyConfig(capacity_factor=4.0))
+    rng = np.random.default_rng(9)
+    t.CopyInitialPosition(_sources_with_lost(rng, N, 2).reshape(-1).copy())
+    dst = rng.uniform(0.1, 0.9, (N, 3))
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    assert t.lost_particles == 2
+    # Second sourcing, 1 more lost: the counter is CUMULATIVE.
+    t.CopyInitialPosition(_sources_with_lost(rng, N, 1).reshape(-1).copy())
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    assert t.lost_particles == 3
+    # ... and rides checkpoints.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "l.npz")
+        save_tally_state(t, ckpt)
+        t2 = PartitionedPumiTally(_mesh(), N, TallyConfig(capacity_factor=4.0))
+        load_tally_state(t2, ckpt)
+        assert t2.lost_particles == 3
+
+
+def test_lost_particles_zero_on_clamping_facades():
+    """Monolithic/streaming engines clamp out-of-domain sources to the
+    hull instead of dropping them — their counter stays 0."""
+    rng = np.random.default_rng(10)
+    src = _sources_with_lost(rng, N, 2)
+    for t in (PumiTally(_mesh(), N), StreamingTally(_mesh(), N, chunk_size=10)):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        assert t.lost_particles == 0
+
+
+def test_lost_particles_in_vtk_field_data(tmp_path, capsys):
+    from pumiumtally_tpu.io.vtk import read_vtk_field_scalars
+
+    t = PartitionedPumiTally(_mesh(), N, TallyConfig(capacity_factor=4.0))
+    rng = np.random.default_rng(11)
+    t.CopyInitialPosition(_sources_with_lost(rng, N, 3).reshape(-1).copy())
+    dst = rng.uniform(0.1, 0.9, (N, 3))
+    t.MoveToNextLocation(None, dst.reshape(-1).copy())
+    for name in ("out.vtk", "out.vtu"):
+        path = str(tmp_path / name)
+        t.WriteTallyResults(path)
+        np.testing.assert_array_equal(
+            read_vtk_field_scalars(path, "lost_particles"), [3.0]
+        )
+    # The pvtu path replicates the field into every piece.
+    t.WriteTallyResults(str(tmp_path / "out.pvtu"))
+    np.testing.assert_array_equal(
+        read_vtk_field_scalars(str(tmp_path / "out_p0.vtu"),
+                               "lost_particles"),
+        [3.0],
+    )
+    capsys.readouterr()  # swallow the timing prints
+
+
+def test_streaming_partitioned_lost_counter():
+    from pumiumtally_tpu.parallel import make_device_mesh
+
+    t = StreamingPartitionedTally(
+        _mesh(), N, chunk_size=10,
+        config=TallyConfig(device_mesh=make_device_mesh(4),
+                           capacity_factor=6.0, check_found_all=False),
+    )
+    rng = np.random.default_rng(12)
+    t.CopyInitialPosition(_sources_with_lost(rng, N, 2).reshape(-1).copy())
+    assert t.lost_particles == 2
+
+
+# ---------------------------------------------------------------------------
+# Kill-and-resume, subprocess (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+def _driver_env(facade, fault=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PUMIUMTALLY_FAULT", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    if facade in ("sharded", "stream_part"):
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    if fault:
+        env["PUMIUMTALLY_FAULT"] = fault
+    return env
+
+
+def _run_driver(facade, ckpt_dir, out, fault=None, resume=False, timeout=240):
+    cmd = [sys.executable, DRIVER, "--facade", facade,
+           "--ckpt-dir", str(ckpt_dir), "--out", str(out)]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(
+        cmd, capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=_driver_env(facade, fault),
+    )
+
+
+def _kill_and_resume_case(facade, tmp_path):
+    # Uninterrupted reference run.
+    base_out = tmp_path / "base.npy"
+    r = _run_driver(facade, tmp_path / "ck_base", base_out)
+    assert r.returncode == 0, r.stderr
+    flux_base = np.load(base_out)
+
+    # Arm 1: graceful drain — SIGTERM injected at the 2nd batch close.
+    # The run must exit CLEANLY (rc 0) after saving, without finishing.
+    out1 = tmp_path / "drain.npy"
+    r = _run_driver(facade, tmp_path / "ck_drain", out1,
+                    fault="sigterm@batch:2")
+    assert r.returncode == 0, r.stderr
+    assert not out1.exists()  # drained before the campaign finished
+    r = _run_driver(facade, tmp_path / "ck_drain", out1, resume=True)
+    assert r.returncode == 0, r.stderr
+    assert "resumed generation" in r.stdout
+    np.testing.assert_array_equal(np.load(out1), flux_base,
+                                  err_msg=f"{facade}: drain arm")
+
+    # Arm 2: hard kill mid-save — SIGKILL between the temp-file fsync
+    # and the atomic rename of generation 3. The store must be left
+    # with generations 1-2 intact; resume falls back to generation 2.
+    out2 = tmp_path / "kill.npy"
+    r = _run_driver(facade, tmp_path / "ck_kill", out2,
+                    fault="kill@save:3")
+    assert r.returncode == -signal.SIGKILL
+    names = sorted(os.listdir(tmp_path / "ck_kill"))
+    assert [n for n in names if n.endswith(".ckpt")] == [
+        "gen-00000001.ckpt", "gen-00000002.ckpt",
+    ]
+    r = _run_driver(facade, tmp_path / "ck_kill", out2, resume=True)
+    assert r.returncode == 0, r.stderr
+    assert "resumed generation 2 at batch 2" in r.stdout
+    np.testing.assert_array_equal(np.load(out2), flux_base,
+                                  err_msg=f"{facade}: kill arm")
+    # The resumed store swept the dead writer's orphaned temp file.
+    assert not [n for n in os.listdir(tmp_path / "ck_kill")
+                if n.startswith(".tmp-gen-")]
+
+    # Arm 3: the reference run's LATEST generation is deliberately
+    # corrupted; resume must warn, fall back one generation, re-run
+    # the final batch, and still land bitwise on the same flux.
+    gens = sorted((tmp_path / "ck_base").glob("gen-*.ckpt"))
+    data = gens[-1].read_bytes()
+    gens[-1].write_bytes(data[: len(data) - 120])
+    out3 = tmp_path / "corrupt.npy"
+    r = _run_driver(facade, tmp_path / "ck_base", out3, resume=True)
+    assert r.returncode == 0, r.stderr
+    assert "corrupt" in (r.stderr + r.stdout)
+    np.testing.assert_array_equal(np.load(out3), flux_base,
+                                  err_msg=f"{facade}: corrupt arm")
+
+
+@pytest.mark.parametrize("facade", ["mono", "stream", "part"])
+def test_kill_and_resume_bitwise(facade, tmp_path):
+    _kill_and_resume_case(facade, tmp_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("facade", ["sharded", "stream_part"])
+def test_kill_and_resume_bitwise_multichip(facade, tmp_path):
+    _kill_and_resume_case(facade, tmp_path)
+
+
+def test_resume_counters_continue(tmp_path):
+    """A resumed runner continues generation numbering and batch
+    counters where the dead process stopped (resume_latest re-syncs
+    from the restored metadata)."""
+    pol = _policy(tmp_path, every_n_batches=1, keep=10)
+    rng_args = dict(seed=13)
+
+    def batches(t, start, stop):
+        rng = np.random.default_rng(rng_args["seed"])
+        work = [
+            (rng.uniform(0.1, 0.9, (N, 3)), rng.uniform(0.1, 0.9, (N, 3)))
+            for _ in range(stop)
+        ]
+        for src, dst in work[start:stop]:
+            t.CopyInitialPosition(src.reshape(-1).copy())
+            t.MoveToNextLocation(None, dst.reshape(-1).copy())
+
+    t = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    batches(t, 0, 3)  # closes batches at sourcings 2, 3 -> gens 1, 2
+    t2 = PumiTally(_mesh(), N, TallyConfig(checkpoint=pol))
+    info = resume_latest(t2)
+    assert info.generation == 2 and t2._resilience.batches_closed == 2
+    assert t2.iter_count == 2
+    batches(t2, 2, 4)
+    # Batch 2's sourcing closes nothing (the restored state is already
+    # at that boundary); batch 3's sourcing closes batch 2 -> gen 3;
+    # batch 3 itself stays open (no further sourcing).
+    assert [g for g, _ in t2._resilience.store.generations()] == [1, 2, 3]
+    assert t2._resilience.batches_closed == 3

@@ -1,5 +1,8 @@
-"""Tests for aux subsystems: checkpoint round-trip, logging, timers."""
+"""Tests for aux subsystems: checkpoint round-trip, logging, timers,
+chip-lock busy paths, CLI subprocess timeouts."""
 
+
+import os
 
 import numpy as np
 import pytest
@@ -489,6 +492,143 @@ def test_autotune_blocked_real_sweep_smoke():
     assert len(report) == 2  # candidate + unblocked incumbent
     assert all(r["moves_per_sec"] > 0 for r in report)
     assert (cfg.walk_vmem_max_elems in (None, 300))
+
+
+# ---------------------------------------------------------------------------
+# Chip-lock busy paths (utils/chiplock.py)
+# ---------------------------------------------------------------------------
+
+def _busy_lock(tmp_path, monkeypatch):
+    """Point the module at a fresh lock file, clear the in-process /
+    inherited short-circuits, and hold the lock on an independent file
+    descriptor (flock treats separate descriptors as separate owners,
+    so this models 'another process holds the window')."""
+    import fcntl
+
+    from pumiumtally_tpu.utils import chiplock
+
+    lockfile = str(tmp_path / "chip.lock")
+    monkeypatch.setattr(chiplock, "LOCK_PATH", lockfile)
+    monkeypatch.setattr(chiplock, "_held_in_process", False)
+    monkeypatch.delenv(chiplock._HELD_ENV, raising=False)
+    fd = os.open(lockfile, os.O_CREAT | os.O_RDWR, 0o666)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    return chiplock, fd
+
+
+def test_chip_lock_nonblocking_busy(tmp_path, monkeypatch):
+    """blocking=False against a held lock yields False immediately and
+    leaves no holder state behind (the caller decides skip-vs-proceed)."""
+    import fcntl
+    import time as _time
+
+    chiplock, fd = _busy_lock(tmp_path, monkeypatch)
+    try:
+        t0 = _time.monotonic()
+        with chiplock.chip_lock(blocking=False) as held:
+            assert held is False
+            # A busy miss must NOT masquerade as a held window.
+            assert chiplock._held_in_process is False
+            assert chiplock._HELD_ENV not in os.environ
+        assert _time.monotonic() - t0 < 0.5  # no 1 s retry sleep
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def test_chip_lock_timeout_expires_busy(tmp_path, monkeypatch):
+    """A timeout that expires while the lock stays busy yields False
+    after at least one retry sleep, without acquiring."""
+    import fcntl
+    import time as _time
+
+    chiplock, fd = _busy_lock(tmp_path, monkeypatch)
+    try:
+        t0 = _time.monotonic()
+        with chiplock.chip_lock(timeout_s=0.01) as held:
+            assert held is False
+        # One failed attempt, one 1 s sleep, one deadline check.
+        assert _time.monotonic() - t0 >= 0.9
+        assert chiplock._held_in_process is False
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def test_chip_lock_acquires_after_release(tmp_path, monkeypatch):
+    """After the contender releases: acquisition succeeds, exports the
+    child-inheritance env var, nests reentrantly, and cleans up."""
+    import fcntl
+
+    chiplock, fd = _busy_lock(tmp_path, monkeypatch)
+    fcntl.flock(fd, fcntl.LOCK_UN)
+    os.close(fd)
+    with chiplock.chip_lock(blocking=False) as held:
+        assert held is True
+        assert os.environ[chiplock._HELD_ENV] == "1"
+        assert chiplock._held_in_process is True
+        # Nested acquire in the same process: inherited, no deadlock.
+        with chiplock.chip_lock(blocking=False) as inner:
+            assert inner is True
+    assert chiplock._HELD_ENV not in os.environ
+    assert chiplock._held_in_process is False
+
+
+def test_chip_lock_parent_env_inherited(tmp_path, monkeypatch):
+    """A child of a lock holder sees the env var and skips acquisition
+    entirely — proven by pointing LOCK_PATH somewhere unopenable."""
+    from pumiumtally_tpu.utils import chiplock
+
+    monkeypatch.setattr(chiplock, "_held_in_process", False)
+    monkeypatch.setattr(
+        chiplock, "LOCK_PATH", str(tmp_path / "no_dir" / "x.lock")
+    )
+    monkeypatch.setenv(chiplock._HELD_ENV, "1")
+    with chiplock.chip_lock(blocking=False) as held:
+        assert held is True  # os.open would have raised if attempted
+
+
+# ---------------------------------------------------------------------------
+# CLI subprocess timeout (cli.py, PUMIUMTALLY_SUBPROC_TIMEOUT)
+# ---------------------------------------------------------------------------
+
+def test_subproc_timeout_env(monkeypatch):
+    from pumiumtally_tpu import cli
+
+    monkeypatch.delenv("PUMIUMTALLY_SUBPROC_TIMEOUT", raising=False)
+    assert cli._subproc_timeout() == 1800.0
+    monkeypatch.setenv("PUMIUMTALLY_SUBPROC_TIMEOUT", "42.5")
+    assert cli._subproc_timeout() == 42.5
+    monkeypatch.setenv("PUMIUMTALLY_SUBPROC_TIMEOUT", "zero")
+    with pytest.raises(SystemExit, match="PUMIUMTALLY_SUBPROC_TIMEOUT"):
+        cli._subproc_timeout()
+    monkeypatch.setenv("PUMIUMTALLY_SUBPROC_TIMEOUT", "-3")
+    with pytest.raises(SystemExit, match="PUMIUMTALLY_SUBPROC_TIMEOUT"):
+        cli._subproc_timeout()
+
+
+def test_aot_check_timeout_names_env_var(monkeypatch, capsys):
+    """An expired helper subprocess must surface the env var that
+    extends the budget, and honor the configured timeout value."""
+    import subprocess as sp
+
+    from pumiumtally_tpu import cli
+
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["timeout"] = kw.get("timeout")
+        raise sp.TimeoutExpired(cmd, kw.get("timeout"), output="partial")
+
+    monkeypatch.setenv("PUMIUMTALLY_SUBPROC_TIMEOUT", "7")
+    monkeypatch.setattr(sp, "run", fake_run)
+    args = type("A", (), {"multichip": False})()
+    with pytest.raises(SystemExit):
+        cli.cmd_aot_check(args)
+    out = capsys.readouterr().out
+    assert seen["timeout"] == 7.0
+    assert "timed out after 7s" in out
+    assert "PUMIUMTALLY_SUBPROC_TIMEOUT" in out
 
 
 # ---------------------------------------------------------------------------

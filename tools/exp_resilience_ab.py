@@ -1,0 +1,167 @@
+"""Autosave overhead A/B + per-save cost capture (r8).
+
+Two arms over the IDENTICAL box workload (same mesh, same seeds, same
+per-batch protocol: one CopyInitialPosition + ``moves`` continue-mode
+moves per source batch):
+
+- ``off``: the default engine (TallyConfig() — no resilience code
+  runs);
+- ``on``:  ``checkpoint=CheckpointPolicy(every_n_batches=1)`` — one
+  atomic digest-sealed generation written at every batch close
+  (keep=2, signal handling off: a bench must not repoint the
+  process's SIGINT).
+
+Reported, non-interactively (one JSON line — bench.py's resilience
+row consumes it):
+
+- both arms' moves/s and the relative autosave overhead;
+- the fenced per-save cost (state fetch + compress + digest + atomic
+  rename) and the on-disk generation size;
+- generations written/retained (the keep-K prune runs live);
+- the compiles-healthy contract (``compiles.timed``; the resilience
+  layer is host-side only, so autosave must add ZERO compiles).
+
+Flux parity between the arms is asserted bitwise before any number is
+reported — autosave only ever READS engine state, enforced where the
+measurement happens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _make_batches(rng, n: int, batches: int, moves: int):
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    segs = [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)]
+    return [(src, segs) for _ in range(batches)]
+
+
+def _drive(t, work):
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+
+def run_ab(
+    n: int = 100_000,
+    div: int = 20,
+    moves: int = 2,
+    batches: int = 8,
+) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import (
+        CheckpointPolicy,
+        PumiTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(7)
+    work = _make_batches(rng, n, batches, moves)
+    ckpt_dir = tempfile.mkdtemp(prefix="pumiumtally_resilience_ab_")
+    try:
+        t_on = PumiTally(
+            mesh, n,
+            TallyConfig(
+                check_found_all=False, fenced_timing=False,
+                checkpoint=CheckpointPolicy(
+                    dir=ckpt_dir, every_n_batches=1, keep=2,
+                    handle_signals=False,
+                ),
+            ),
+        )
+        with retrace_guard(raise_on_exceed=False) as guard:
+            _drive(t_on, work[:2])  # warmup: compiles happen here
+            jax.block_until_ready(t_on.flux)
+            with retrace_guard(raise_on_exceed=False) as timed_guard:
+                t0 = time.perf_counter()
+                _drive(t_on, work[2:])
+                jax.block_until_ready(t_on.flux)
+                on_s = time.perf_counter() - t0
+
+        t_off = PumiTally(
+            mesh, n, TallyConfig(check_found_all=False, fenced_timing=False)
+        )
+        _drive(t_off, work[:2])
+        jax.block_until_ready(t_off.flux)
+        t0 = time.perf_counter()
+        _drive(t_off, work[2:])
+        jax.block_until_ready(t_off.flux)
+        off_s = time.perf_counter() - t0
+
+        # Parity gate: autosave only READS the engine — the on-arm flux
+        # must be BITWISE the off-arm flux. RuntimeError (not
+        # sys.exit): bench.py wraps this row best-effort.
+        if not bool(jnp.all(t_on.flux == t_off.flux)):
+            raise RuntimeError(
+                "autosave-on flux diverged bitwise from autosave-off"
+            )
+
+        # Fenced per-save microcost on the final state (fetch +
+        # compress + sha256 + atomic rename), plus the on-disk size.
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            gen, path = t_on.checkpoint_now(bench=True)
+        save_ms = (time.perf_counter() - t0) / reps * 1e3
+        ckpt_bytes = os.path.getsize(path)
+        store = t_on._resilience.store
+        gens = store.generations()
+        moves_total = n * moves * (batches - 2)
+        return {
+            "row": "resilience",
+            "on_moves_per_sec": moves_total / on_s,
+            "off_moves_per_sec": moves_total / off_s,
+            "autosave_overhead_pct": (on_s - off_s) / off_s * 100.0,
+            "save_ms": save_ms,
+            "ckpt_bytes": ckpt_bytes,
+            "generations_written": gen,
+            "generations_retained": len(gens),
+            "keep": t_on.config.checkpoint.keep,
+            "flux_parity_bitwise": True,
+            # Host-side-only contract: resilience adds no entry points
+            # and no compiles anywhere (timed == 0 AND total == the
+            # engine's own warmup compiles).
+            "compiles": {
+                "total": guard.total_compiles,
+                "timed": timed_guard.total_compiles,
+                **guard.compiles,
+            },
+            "workload": {
+                "particles": n, "mesh_tets": 6 * div**3,
+                "moves_per_batch": moves, "batches": batches,
+            },
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 8))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves, batches=batches),
+                     default=float))
+
+
+if __name__ == "__main__":
+    main()
