@@ -480,6 +480,28 @@ def run_resilience_ab() -> dict | None:
     )
 
 
+def run_sentinel_ab() -> dict | None:
+    """Component row: the runtime-sentinel subsystem's cost
+    (tools/exp_sentinel_ab.py run_ab) — sentinel-on (per-move
+    on-device audit lanes, one packed scalar fetch) vs sentinel-off
+    rates on the identical workload (flux parity asserted bitwise
+    inside the tool: the audit only reads engine state and the
+    straggler ladder never fires on a healthy run), the fenced
+    per-move audit cost, the on-arm health report (zero anomalies
+    required), and the compiles-healthy contract —
+    ``compiles.timed == 0``: audit_pack compiles once in warmup,
+    straggler_retry never on a healthy run. Reduced shape (100k
+    particles) like the other component rows; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_sentinel_ab
+
+    return exp_sentinel_ab.run_ab(
+        n=min(N, 100_000), div=MESH_DIV, moves=2, batches=8
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -891,6 +913,12 @@ def _measure_and_report() -> None:
             resilience = run_resilience_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# resilience A/B failed: {e}", file=sys.stderr)
+    sentinel = None
+    if os.environ.get("PUMIUMTALLY_BENCH_SENTINEL", "1") != "0":
+        try:
+            sentinel = run_sentinel_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# sentinel A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -1028,6 +1056,12 @@ def _measure_and_report() -> None:
         # host-side-only contract (compiles.timed == 0: resilience
         # never touches the jit cache).
         "resilience": resilience,
+        # Runtime-sentinel subsystem cost: sentinel-on vs sentinel-off
+        # rates (flux parity bitwise — the audit only reads state; the
+        # straggler ladder never fires on a healthy run), the fenced
+        # per-move audit cost, the on-arm health report, and the
+        # compiles-healthy contract (compiles.timed == 0).
+        "sentinel": sentinel,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

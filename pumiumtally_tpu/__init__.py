@@ -30,6 +30,11 @@ from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
 from pumiumtally_tpu.stats import BatchStatistics, TriggerResult, TriggerSpec
 from pumiumtally_tpu.resilience import CheckpointPolicy, resume_latest
+from pumiumtally_tpu.sentinel import (
+    EnginePoisonedError,
+    HealthReport,
+    SentinelPolicy,
+)
 
 __version__ = "0.1.0"
 
@@ -49,4 +54,7 @@ __all__ = [
     "TriggerSpec",
     "CheckpointPolicy",
     "resume_latest",
+    "EnginePoisonedError",
+    "HealthReport",
+    "SentinelPolicy",
 ]

@@ -92,8 +92,30 @@ class PartitionedPumiTally(PumiTally):
             table_dtype=self._table_dtype,
             cap_frontier=self.config.cap_frontier,
         )
+        self._wire_engine_hooks(self.engine)
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0
+
+    # -- sentinel / recovery wiring ---------------------------------------
+    def _wire_engine_hooks(self, engine) -> None:
+        """Connect one PartitionedEngine's overflow-recovery ladder to
+        the facade: recoveries report into the sentinel health record,
+        and a ladder exhaustion triggers a resilience safety save (the
+        still-intact pre-overflow state) right before the poisoned
+        raise."""
+        engine.on_overflow_recovered = self._note_overflow_recovered
+        engine.on_poisoned = self._overflow_safety_save
+
+    def _note_overflow_recovered(self, escalated: bool) -> None:
+        if self._sentinel is not None:
+            self._sentinel.note_overflow_recovery(escalated)
+
+    def _overflow_safety_save(self) -> None:
+        if self._resilience is not None:
+            self._resilience.save(self, reason="overflow_safety")
+
+    def _engine_poisoned(self) -> bool:
+        return self._poisoned or self.engine.poisoned
 
     # -- dispatch hooks ---------------------------------------------------
     def _dispatch_localize(self, dest: jnp.ndarray):
@@ -109,7 +131,69 @@ class PartitionedPumiTally(PumiTally):
         # origin echo it hands back the device array that staged last
         # move's destinations (caller order), which this engine treats
         # exactly like freshly uploaded origins.
-        return self.engine.move(origins, dests, fly, w)
+        if self._sentinel is None:
+            return self.engine.move(origins, dests, fly, w)
+        # Sentinel audit needs the phase-B start in caller order: the
+        # staged origins, or (continue mode) the committed positions
+        # BEFORE the move (one pid-sort gather; migration permutes
+        # slots, so a post-move snapshot would pair wrong particles).
+        x0 = (
+            origins if origins is not None
+            else self.engine.caller_order_view(("x",))["x"]
+        )
+        ok = self.engine.move(origins, dests, fly, w)
+        return self._sentinel_post_move_partitioned(
+            self.engine, x0, dests, fly, w, ok
+        )
+
+    def _sentinel_post_move_partitioned(self, engine, x0, dests, fly, w,
+                                        ok):
+        """Partitioned arm of the sentinel protocol: audit from the
+        engine's caller-order views, then the engine-level straggler
+        ladder (resume-phase retry with multiplied budgets → declare
+        lost + quarantine)."""
+        pol = self.config.sentinel
+        view = engine.caller_order_view(("x", "done"))
+        n_unf, mask = self._sentinel.audit(
+            x0, view["x"], fly, w, view["done"],
+            engine.flux_original(),
+        )
+        recovered = lost = 0
+        if n_unf and pol.straggler_retry:
+            ok = engine.retry_stragglers(pol.retry_iters_factor)
+            if not ok:
+                self._quarantine_partitioned(engine, x0, dests, fly, w)
+                lost = engine.declare_lost_stragglers()
+                ok = lost == 0  # residue either lost or (rarely) found
+            recovered = max(0, n_unf - lost)
+            self._sentinel.resync(engine.flux_original())
+        self._sentinel.note_outcome(
+            mask, n_unf, recovered, lost, self.iter_count
+        )
+        return ok
+
+    def _quarantine_partitioned(self, engine, x0, dests, fly, w) -> None:
+        """Quarantine records for the particles the engine ladder is
+        about to declare lost (caller-order fetch of the residue)."""
+        from pumiumtally_tpu.sentinel.quarantine import (
+            append_quarantine,
+            build_records,
+        )
+
+        view = engine.caller_order_view(("done", "elem_orig"))
+        done = np.asarray(view["done"])
+        idx = np.flatnonzero(~done & (np.asarray(fly) == 1))
+        if idx.size == 0:
+            return
+        sel = jnp.asarray(idx)
+        append_quarantine(
+            self.config.sentinel.quarantine_dir,
+            build_records(
+                idx, np.asarray(x0[sel]), np.asarray(dests[sel]),
+                np.asarray(view["elem_orig"])[idx], np.asarray(w[sel]),
+                self.iter_count,
+            ),
+        )
 
     def WriteTallyResults(self, filename: Optional[str] = None) -> None:
         """Normalize and write results; a ``.pvtu`` filename writes one
@@ -117,6 +201,7 @@ class PartitionedPumiTally(PumiTally):
         file — the rank-aware output path of the reference
         (``vtk::write_parallel``, PumiTallyImpl.cpp:415). Any other
         extension falls through to the monolithic writers."""
+        self._check_poisoned()  # the .pvtu branch bypasses super()
         out = filename or self.config.output_filename
         if not out.endswith(".pvtu"):
             return super().WriteTallyResults(filename)

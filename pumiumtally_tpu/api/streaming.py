@@ -178,6 +178,7 @@ class StreamingTally(PumiTally):
 
     # -- the three-call protocol -----------------------------------------
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
+        self._check_poisoned()
         t0 = time.perf_counter()
         self._stats_roll_batch()  # each sourcing opens a new batch
         self._resilience_roll_batch()  # autosave/drain at batch close
@@ -208,6 +209,9 @@ class StreamingTally(PumiTally):
         self, particle_origin, particle_destinations, flying=None, weights=None,
         size: Optional[int] = None,
     ):
+        # Poisoned check FIRST (same order as the base facade): a
+        # corrupt engine must refuse whatever else is wrong.
+        self._check_poisoned()
         if not self.is_initialized:
             raise RuntimeError(
                 "CopyInitialPosition must be called before MoveToNextLocation"
@@ -244,6 +248,13 @@ class StreamingTally(PumiTally):
         if self.config.validate_inputs and w_h is not None:
             check_finite(w_h[: self.num_particles], "weights")
 
+        # Sentinel stash: the per-chunk staged views the post-move
+        # audit/ladder needs (phase-B start, dest, fly, w + the ray
+        # coordinates _chunk_move records into _move_s), retained only
+        # while a sentinel is armed — the sentinel-off path keeps its
+        # no-extra-references contract.
+        stash = [] if self._sentinel is not None else None
+        self._move_s = {}
         # Pre-dispatch finite check in the working dtype (ADVICE r4):
         # the narrow-dtype overflow corner (f64 input finite, f32 cast
         # inf) used to raise from a mid-loop chunk stage AFTER earlier
@@ -284,6 +295,10 @@ class StreamingTally(PumiTally):
                 orig = self._last_dests_dev[k]
             else:
                 orig = self._stage_chunk_positions(origins_h, k)
+            if stash is not None:
+                stash.append(
+                    (k, self._chunk_phase_b_start(k, orig), dest, fly, w)
+                )
             oks.append(self._chunk_move(k, orig, dest, fly, w))
         zero_flying_side_effect(flying, n)
         if retain:
@@ -301,7 +316,13 @@ class StreamingTally(PumiTally):
         self.iter_count += 1
         self._stats_note_move()
         self._after_chunk_dispatch()
-        if self.config.check_found_all and not all(bool(o) for o in oks):
+        oks = self._correct_verdicts(oks)
+        if stash is not None:
+            oks = self._sentinel_chunks_post_move(stash, oks)
+        # Per-chunk verdicts may be masks (round 9) or engine booleans.
+        if self.config.check_found_all and not all(
+            bool(jnp.all(o)) for o in oks
+        ):
             print("ERROR: Not all particles are found. May need more loops in search")
         if self.config.fenced_timing:
             jax.block_until_ready(self._flux)
@@ -310,6 +331,87 @@ class StreamingTally(PumiTally):
 
     def _after_chunk_dispatch(self) -> None:
         """Hook: deferred per-chunk error checks (partitioned mode)."""
+
+    def _correct_verdicts(self, oks):
+        """Hook: re-derive per-chunk found-all verdicts after a
+        deferred overflow recovery invalidated the lazily collected
+        ones (partitioned mode overrides)."""
+        return oks
+
+    # -- runtime sentinels (chunked arms) --------------------------------
+    def _chunk_phase_b_start(self, k: int, orig):
+        """Chunk k's phase-B start positions for the sentinel audit:
+        the staged origins, or the committed pre-move chunk state."""
+        return self._x[k] if orig is None else orig
+
+    def _sentinel_chunks_post_move(self, stash, oks):
+        """Streaming arm of the sentinel protocol, at the batch sync
+        point (per-chunk syncs would serialize the pipeline): ONE
+        concatenated audit over every chunk's caller-order view, then
+        the straggler ladder chunk-by-chunk over whatever residue the
+        done masks show."""
+        from pumiumtally_tpu.sentinel.straggler import run_ladder
+
+        pol = self.config.sentinel
+        x0 = jnp.concatenate([s[1] for s in stash], axis=0)
+        x1 = jnp.concatenate(self._x, axis=0)
+        fly = jnp.concatenate([s[3] for s in stash])
+        w = jnp.concatenate([s[4] for s in stash])
+        done = jnp.concatenate(oks)
+        n_unf, mask = self._sentinel.audit(
+            x0, x1, fly, w, done, self.flux
+        )
+        recovered = lost = 0
+        if n_unf and pol.straggler_retry:
+            new_oks = []
+            for (k, _x0k, dest, fly_k, w_k), done_k in zip(stash, oks):
+                unfinished = np.asarray(~done_k & (fly_k == 1))
+                if not unfinished.any():
+                    new_oks.append(done_k)
+                    continue
+                x2, e2, flux2, rec_idx, lost_idx = run_ladder(
+                    self.mesh, self._x[k], self._elem[k], dest, fly_k,
+                    w_k, self._flux[k], unfinished,
+                    tol=self._tol, base_iters=self._max_iters,
+                    retry_factor=pol.retry_iters_factor,
+                    walk_kw=self._walk_kw,
+                    two_tier=(self._table_dtype == "bfloat16"),
+                    x_start=_x0k, s_init=self._move_s.get(k),
+                )
+                self._x[k], self._elem[k], self._flux[k] = x2, e2, flux2
+                recovered += int(rec_idx.size)
+                lost += int(lost_idx.size)
+                if lost_idx.size:
+                    self._lost_total += int(lost_idx.size)
+                    self._quarantine_streaming(
+                        k, lost_idx, _x0k, dest, w_k
+                    )
+                new_oks.append(lost_idx.size == 0)
+            oks = new_oks
+            self._sentinel.resync(self.flux)
+        self._sentinel.note_outcome(
+            mask, n_unf, recovered, lost, self.iter_count - 1
+        )
+        return oks
+
+    def _quarantine_streaming(self, k: int, idx, x0, dest, w) -> None:
+        """Quarantine records for chunk k's unrecoverable residue —
+        pids in GLOBAL (caller) numbering via the chunk offset."""
+        from pumiumtally_tpu.sentinel.quarantine import (
+            append_quarantine,
+            build_records,
+        )
+
+        lo, _hi = self._chunk_bounds(k)
+        sel = jnp.asarray(idx)
+        append_quarantine(
+            self.config.sentinel.quarantine_dir,
+            build_records(
+                idx, np.asarray(x0[sel]), np.asarray(dest[sel]),
+                np.asarray(self._elem[k][sel]), np.asarray(w[sel]),
+                self.iter_count - 1, pid_offset=lo,
+            ),
+        )
 
     # -- per-chunk dispatch (overridden by StreamingPartitionedTally) ----
     def _chunk_localize(self, k: int, dest: jnp.ndarray):
@@ -334,7 +436,7 @@ class StreamingTally(PumiTally):
                 dest, tol=self._tol, max_iters=self._max_iters,
                 walk_kw=self._walk_kw,
             )
-            return done
+            return self._sentinel_chunk_post_localize(k, dest, done)
         if self.config.localization == "locate":
             # MXU point location per chunk; unlocated points keep
             # walking from the committed state (shared pre-pass with
@@ -347,11 +449,41 @@ class StreamingTally(PumiTally):
             tol=self._tol, max_iters=self._max_iters,
             walk_kw=self._walk_kw,
         )
-        return done
+        return self._sentinel_chunk_post_localize(k, dest, done)
+
+    def _sentinel_chunk_post_localize(self, k: int, dest, done):
+        """Chunk arm of the non-tallying localization ladder (see
+        PumiTally._sentinel_post_localize)."""
+        if self._sentinel is None or not (
+            self.config.sentinel.straggler_retry
+        ):
+            return done
+        unfinished = np.asarray(~done)
+        if not unfinished.any():
+            return done
+        from pumiumtally_tpu.sentinel.straggler import run_ladder
+
+        pol = self.config.sentinel
+        fly = jnp.ones((self.chunk_size,), jnp.int8)
+        w0 = jnp.zeros((self.chunk_size,), self.dtype)
+        x2, e2, _flux, rec_idx, lost_idx = run_ladder(
+            self.mesh, self._x[k], self._elem[k], dest, fly, w0,
+            self._flux[k], unfinished,
+            tol=self._tol, base_iters=self._max_iters,
+            retry_factor=pol.retry_iters_factor, walk_kw=self._walk_kw,
+            two_tier=(self._table_dtype == "bfloat16"),
+        )
+        self._x[k], self._elem[k] = x2, e2
+        self._sentinel.note_localization(rec_idx.size, lost_idx.size)
+        dn = np.asarray(done).copy()
+        dn[rec_idx] = True
+        return jnp.asarray(dn)
 
     def _chunk_move(self, k: int, orig, dest, fly, w):
         """One tallied move of chunk k (orig None = continue mode);
-        returns found_all (lazy)."""
+        returns the chunk's done mask (lazy). The phase-B ray
+        coordinates are stashed for the sentinel ladder when one is
+        armed (``_move_s``)."""
         if self.device_mesh is not None:
             from pumiumtally_tpu.parallel.sharded import (
                 sharded_move_step,
@@ -360,7 +492,7 @@ class StreamingTally(PumiTally):
 
             if orig is None:
                 (
-                    self._x[k], self._elem[k], self._flux[k], ok,
+                    self._x[k], self._elem[k], self._flux[k], ok, s_b,
                 ) = sharded_move_step_continue(
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], dest, fly, w, self._flux[k],
@@ -369,7 +501,7 @@ class StreamingTally(PumiTally):
                 )
             else:
                 (
-                    self._x[k], self._elem[k], self._flux[k], ok,
+                    self._x[k], self._elem[k], self._flux[k], ok, s_b,
                 ) = sharded_move_step(
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], orig, dest, fly, w, self._flux[k],
@@ -377,17 +509,21 @@ class StreamingTally(PumiTally):
                     walk_kw=self._walk_kw,
                 )
         elif orig is None:
-            self._x[k], self._elem[k], self._flux[k], ok = _move_step_continue(
+            (
+                self._x[k], self._elem[k], self._flux[k], ok, s_b,
+            ) = _move_step_continue(
                 self.mesh, self._x[k], self._elem[k], dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
                 walk_kw=self._walk_kw,
             )
         else:
-            self._x[k], self._elem[k], self._flux[k], ok = _move_step(
+            self._x[k], self._elem[k], self._flux[k], ok, s_b = _move_step(
                 self.mesh, self._x[k], self._elem[k], orig, dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
                 walk_kw=self._walk_kw,
             )
+        if self._sentinel is not None:
+            self._move_s[k] = s_b
         return ok
 
     # -- state views ------------------------------------------------------
@@ -438,6 +574,16 @@ class StreamingPartitionedTally(StreamingTally):
         if config is None or config.device_mesh is None:
             raise ValueError(
                 "StreamingPartitionedTally requires TallyConfig.device_mesh"
+            )
+        if config.sentinel is not None and int(config.device_groups) > 1:
+            # The audit concatenates caller-order device views across
+            # chunk engines; with disjoint device groups those live on
+            # different device sets (the same reason the flux property
+            # assembles on the host there).
+            raise ValueError(
+                "TallyConfig.sentinel with device_groups > 1 is not "
+                "supported: the audit needs one device set across "
+                "chunk engines"
             )
         super().__init__(mesh, num_particles, chunk_size, config)
 
@@ -533,12 +679,19 @@ class StreamingPartitionedTally(StreamingTally):
                 partition_method=self.config.resolved_partition_method(),
                 cap_frontier=self.config.cap_frontier,
             ))
+        for eng in self.engines:
+            # Recovery-ladder wiring (round 9): recoveries report into
+            # the sentinel record; a ladder exhaustion safety-saves
+            # before the poisoned raise.
+            eng.on_overflow_recovered = self._note_overflow_recovered
+            eng.on_poisoned = self._overflow_safety_save
         # Base-class sync/view lists are unused in this mode.
         self._x = []
         self._elem = []
         self._flux = []
         self._pending_overflows = []
         self._dispatched_localize = False
+        self._recovered_this_call = False
         jax.block_until_ready(part.table)
 
     # -- per-chunk dispatch via the partitioned engines ------------------
@@ -551,7 +704,7 @@ class StreamingPartitionedTally(StreamingTally):
         found_all, ovf = self.engines[k].localize(  # real slots
             dest[:n], defer_sync=True
         )
-        self._pending_overflows.append(ovf)
+        self._pending_overflows.append((self.engines[k], "localize", ovf))
         return found_all
 
     def _chunk_move(self, k: int, orig, dest, fly, w):
@@ -560,18 +713,53 @@ class StreamingPartitionedTally(StreamingTally):
             None if orig is None else orig[:n], dest[:n], fly[:n], w[:n],
             defer_sync=True,
         )
-        self._pending_overflows.append(ovf)
+        self._pending_overflows.append((self.engines[k], "move", ovf))
         return ok
 
-    def _after_chunk_dispatch(self) -> None:
-        from pumiumtally_tpu.parallel.partition import OVERFLOW_MESSAGE
+    def _engine_poisoned(self) -> bool:
+        return self._poisoned or any(e.poisoned for e in self.engines)
 
-        ovfs, self._pending_overflows = self._pending_overflows, []
+    def _note_overflow_recovered(self, escalated: bool) -> None:
+        if self._sentinel is not None:
+            self._sentinel.note_overflow_recovery(escalated)
+
+    def _overflow_safety_save(self) -> None:
+        if self._resilience is not None:
+            self._resilience.save(self, reason="overflow_safety")
+
+    def _recover_deferred_overflow(self, eng, kind: str) -> None:
+        """One engine's deferred overflow, at the batch sync point.
+        The overflow-safe migrate kept its pre-migrate snapshot, so
+        localization and single-phase (continue-mode) moves resume
+        through the engine ladder. A two-phase move whose PHASE A
+        overflowed is the unrecoverable corner: phase B already walked
+        (and tallied) from the incomplete relocation before the
+        deferred flag was read — poison rather than compute on."""
+        self._recovered_this_call = True
+        if kind == "localize":
+            eng._recover_localize_overflow()
+            return
+        ovf_a, _ovf_b = eng._last_defer_flags or (None, None)
+        if ovf_a is not None and bool(ovf_a):
+            eng.poisoned = True
+            self._overflow_safety_save()
+            from pumiumtally_tpu.sentinel.policy import POISONED_MESSAGE
+
+            raise RuntimeError(
+                "partitioned-mode capacity overflow in a deferred "
+                "two-phase relocation: the transport phase already ran "
+                "over the incomplete placement — " + POISONED_MESSAGE
+            )
+        eng._recover_overflow(eng._last_phase_tally)
+
+    def _after_chunk_dispatch(self) -> None:
+        pending, self._pending_overflows = self._pending_overflows, []
         # Per-flag host reads: this IS the batch sync point, and with
         # device_groups > 1 the flags live on disjoint device sets (a
         # device-side stack across groups is invalid).
-        if any(bool(o) for o in ovfs):
-            raise RuntimeError(OVERFLOW_MESSAGE)
+        for eng, kind, ovf in pending:
+            if bool(ovf):
+                self._recover_deferred_overflow(eng, kind)
         # Resolve every engine's lost count at this batch sync point:
         # the two-phase revival check in move() then reads a cached int
         # instead of forcing a mid-pipeline device fetch.
@@ -614,6 +802,95 @@ class StreamingPartitionedTally(StreamingTally):
         an int cached at the batch sync point, _after_chunk_dispatch —
         no extra device fetch here)."""
         return sum(e._n_lost for e in self.engines)
+
+    def _correct_verdicts(self, oks):
+        """A deferred overflow recovery re-ran part of a phase AFTER
+        the lazy verdicts were collected — re-derive found-all from
+        the engines' committed done flags (we are past the batch sync
+        point, so these fetches add no new pipeline stall)."""
+        if not self._recovered_this_call:
+            return oks
+        self._recovered_this_call = False
+        return [jnp.all(e.state["done"]) for e in self.engines]
+
+    # -- runtime sentinels (partitioned-chunk arm) ------------------------
+    def _chunk_phase_b_start(self, k: int, orig):
+        n = self.engines[k].n
+        if orig is not None:
+            return orig[:n]
+        return self.engines[k].caller_order_view(("x",))["x"]
+
+    def _sentinel_chunks_post_move(self, stash, oks):
+        """Partitioned-chunk arm: one concatenated audit over the
+        engines' caller-order views (single device group — enforced at
+        construction), then the ENGINE-level straggler ladder per
+        chunk (resume-phase retry → declare lost + quarantine; lost
+        particles land in the engines' ``lost`` flags, so
+        ``lost_particles`` counts them without a facade-side bump)."""
+        pol = self.config.sentinel
+        views = [
+            e.caller_order_view(("x", "done")) for e in self.engines
+        ]
+        x0 = jnp.concatenate([s[1] for s in stash], axis=0)
+        x1 = jnp.concatenate([v["x"] for v in views], axis=0)
+        fly = jnp.concatenate(
+            [s[3][: self.engines[s[0]].n] for s in stash]
+        )
+        w = jnp.concatenate(
+            [s[4][: self.engines[s[0]].n] for s in stash]
+        )
+        done = jnp.concatenate([v["done"] for v in views])
+        n_unf, mask = self._sentinel.audit(
+            x0, x1, fly, w, done, self.flux
+        )
+        recovered = lost = 0
+        if n_unf and pol.straggler_retry:
+            new_oks = []
+            for (k, x0k, dest, fly_k, w_k), ok in zip(stash, oks):
+                eng = self.engines[k]
+                done_k = np.asarray(views[k]["done"])
+                unf = ~done_k & (np.asarray(fly_k)[: eng.n] == 1)
+                if not unf.any():
+                    new_oks.append(ok)
+                    continue
+                ok_r = eng.retry_stragglers(pol.retry_iters_factor)
+                lost_k = 0
+                if not ok_r:
+                    self._quarantine_partitioned_chunk(
+                        k, eng, x0k, dest, w_k
+                    )
+                    lost_k = eng.declare_lost_stragglers()
+                lost += lost_k
+                recovered += int(unf.sum()) - lost_k
+                new_oks.append(lost_k == 0)
+            oks = new_oks
+            self._sentinel.resync(self.flux)
+        self._sentinel.note_outcome(
+            mask, n_unf, recovered, lost, self.iter_count - 1
+        )
+        return oks
+
+    def _quarantine_partitioned_chunk(self, k, eng, x0, dest, w) -> None:
+        from pumiumtally_tpu.sentinel.quarantine import (
+            append_quarantine,
+            build_records,
+        )
+
+        lo, _hi = self._chunk_bounds(k)
+        view = eng.caller_order_view(("done", "elem_orig"))
+        done = np.asarray(view["done"])
+        idx = np.flatnonzero(~done)
+        if idx.size == 0:
+            return
+        sel = jnp.asarray(idx)
+        append_quarantine(
+            self.config.sentinel.quarantine_dir,
+            build_records(
+                idx, np.asarray(x0[sel]), np.asarray(dest[sel]),
+                np.asarray(view["elem_orig"])[idx], np.asarray(w[sel]),
+                self.iter_count - 1, pid_offset=lo,
+            ),
+        )
 
     @property
     def flux(self) -> jnp.ndarray:

@@ -217,14 +217,23 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
     case for continuing particles (the reference's phase A then walks
     zero distance, PumiTallyImpl.cpp:88-109). Skipping it halves the
     device work and the host→device staging; a TPU-native extension, not
-    part of the reference's 3-call protocol."""
+    part of the reference's 3-call protocol.
+
+    Returns the per-particle ``done`` MASK and the final ray
+    coordinate ``s`` (round 9), not a pre-reduced scalar: the facades
+    reduce the mask for the found-all check, and the sentinel's
+    straggler-escalation ladder consumes both — ``s`` is what lets a
+    truncated particle's retry continue the exact original
+    parametrization (see ops.walk.WalkResult.s). The walk itself is
+    unchanged, so flux/positions/elements stay bitwise identical to
+    pre-mask builds."""
     is_flying = flying[:, None] == 1
     dest_b = jnp.where(is_flying, dests, x)  # stopped → hold (cpp:100-103)
     rb = walk(
         mesh, x, elem, dest_b, flying, weights, flux,
         tally=True, tol=tol, max_iters=max_iters, **dict(walk_kw),
     )
-    return rb.x, rb.elem, rb.flux, jnp.all(rb.done)
+    return rb.x, rb.elem, rb.flux, rb.done, rb.s
 
 
 def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
@@ -258,23 +267,27 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
             jnp.zeros((0,), x_.dtype),
             tally=False, tol=tol, max_iters=max_iters, **dict(walk_kw),
         )
-        return ra.x, ra.elem, jnp.all(ra.done)
+        return ra.x, ra.elem, ra.done
 
     trivial = jnp.all(dest_a == x)
 
     def skip_a(op):
         x_, elem_ = op
-        # `trivial` is True on this branch, and (being derived from the
-        # particle arrays) carries the right varying type when this
-        # runs inside shard_map — a literal True would not.
-        return x_, elem_, trivial
-    xa, ea, ok_a = lax.cond(trivial, skip_a, run_a, (x, elem))
+        # All-done mask, derived from the particle arrays so it carries
+        # the right varying type when this runs inside shard_map — a
+        # literal constant would not. (`trivial` is True on this
+        # branch by construction.)
+        return x_, elem_, elem_ == elem_
+    xa, ea, done_a = lax.cond(trivial, skip_a, run_a, (x, elem))
     # Phase B is exactly the continue-mode move from the relocated state.
-    x2, elem2, flux2, ok_b = move_step_continue(
+    x2, elem2, flux2, done_b, s_b = move_step_continue(
         mesh, xa, ea, dests, flying, weights, flux,
         tol=tol, max_iters=max_iters, walk_kw=walk_kw,
     )
-    return x2, elem2, flux2, ok_a & ok_b
+    # Per-particle mask + phase-B ray coordinate (round 9, see
+    # move_step_continue): a particle is "found" only if BOTH phases
+    # retired it.
+    return x2, elem2, flux2, done_a & done_b, s_b
 
 
 _move_step = register_entry_point(
@@ -414,6 +427,21 @@ class PumiTally:
             from pumiumtally_tpu.resilience import AutosaveRunner
 
             self._resilience = AutosaveRunner(self.config.checkpoint)
+        # Runtime sentinels (TallyConfig.sentinel): the audit/ladder
+        # runner, or None (default — no sentinel code runs anywhere in
+        # the protocol path; bitwise- and allocation-identical to a
+        # sentinel-less build).
+        self._sentinel = None
+        if self.config.sentinel is not None:
+            from pumiumtally_tpu.sentinel import SentinelRunner
+
+            self._sentinel = SentinelRunner(self.config.sentinel,
+                                            self.dtype)
+        # Poisoned latch (docs/DESIGN.md "Failure taxonomy"): set when
+        # a partitioned overflow exhausts the recovery ladder — every
+        # subsequent protocol call then refuses with a clear
+        # resume-from-checkpoint error instead of computing garbage.
+        self._poisoned = False
         return mesh
 
     def _cached_ones(self, kind: str) -> jnp.ndarray:
@@ -571,6 +599,132 @@ class PumiTally:
 
         return resume_latest(self)
 
+    # -- runtime sentinels (TallyConfig.sentinel) ------------------------
+    def _engine_poisoned(self) -> bool:
+        """Whether this tally's engine state is known-corrupt (the
+        partitioned facades also consult their engines' latches)."""
+        return self._poisoned
+
+    def _check_poisoned(self) -> None:
+        if self._engine_poisoned():
+            from pumiumtally_tpu.sentinel.policy import (
+                EnginePoisonedError,
+                POISONED_MESSAGE,
+            )
+
+            raise EnginePoisonedError(POISONED_MESSAGE)
+
+    def health_report(self):
+        """The cumulative ``sentinel.HealthReport`` of this campaign
+        (audited moves, anomaly mask union, worst conservation
+        residual, straggler/overflow ladder outcomes). Requires
+        ``TallyConfig(sentinel=SentinelPolicy(...))``."""
+        if self._sentinel is None:
+            raise RuntimeError(
+                "runtime sentinels are disabled; construct the tally "
+                "with TallyConfig(sentinel=sentinel.SentinelPolicy())"
+            )
+        return self._sentinel.health_report()
+
+    def _sentinel_post_move(self, x_start, dests, fly, w, done, s_b):
+        """Audit one committed move and run the straggler-escalation
+        ladder over its unfinished residue (sentinel package
+        docstring). ``x_start`` is the phase-B start (staged origins,
+        or the pre-move committed positions in continue mode) and
+        ``s_b`` the phase-B ray coordinates — together they let the
+        retry CONTINUE the exact original parametrization, which is
+        what makes recovered flux bitwise. All arrays are the facade's
+        padded caller-order views. Returns the found-all verdict the
+        protocol check consumes."""
+        pol = self.config.sentinel
+        n_unf, mask = self._sentinel.audit(
+            x_start, self.x, fly, w, done, self.flux
+        )
+        recovered = lost = 0
+        ok = done
+        if n_unf and pol.straggler_retry:
+            from pumiumtally_tpu.sentinel.straggler import run_ladder
+
+            unfinished = np.asarray(~done & (fly == 1))
+            x2, e2, flux2, rec_idx, lost_idx = run_ladder(
+                self.mesh, self.x, self.elem, dests, fly, w, self.flux,
+                unfinished,
+                tol=self._tol, base_iters=self._max_iters,
+                retry_factor=pol.retry_iters_factor,
+                walk_kw=self._walk_kw,
+                two_tier=(self._table_dtype == "bfloat16"),
+                x_start=x_start, s_init=s_b,
+            )
+            self.x, self.elem, self.flux = x2, e2, flux2
+            recovered, lost = int(rec_idx.size), int(lost_idx.size)
+            if lost:
+                self._lost_total += lost
+                self._quarantine_lost(lost_idx, x_start, dests, w)
+            # The ladder tallied after the audit snapshotted the flux
+            # sum — re-baseline so the next conservation delta is
+            # clean.
+            self._sentinel.resync(self.flux)
+            ok = lost == 0
+        self._sentinel.note_outcome(
+            mask, n_unf, recovered, lost, self.iter_count
+        )
+        return ok
+
+    def _sentinel_post_localize(self, dest, done):
+        """Non-tallying localization ladder: a localization walk that
+        exhausts ``max_iters`` would seed the whole campaign from
+        partial positions — re-walk the residue with the escalated
+        budget and ZERO weights (flux is untouched bitwise; the retry
+        program is the same ``straggler_retry`` entry point). Returns
+        the updated done mask."""
+        if self._sentinel is None or not (
+            self.config.sentinel.straggler_retry
+        ):
+            return done
+        unfinished = np.asarray(~done)
+        if not unfinished.any():
+            return done
+        from pumiumtally_tpu.sentinel.straggler import run_ladder
+
+        pol = self.config.sentinel
+        fly = jnp.ones((self._cap,), jnp.int8)
+        w0 = jnp.zeros((self._cap,), self.dtype)
+        x2, e2, _flux, rec_idx, lost_idx = run_ladder(
+            self.mesh, self.x, self.elem, dest, fly, w0, self.flux,
+            unfinished,
+            tol=self._tol, base_iters=self._max_iters,
+            retry_factor=pol.retry_iters_factor, walk_kw=self._walk_kw,
+            two_tier=(self._table_dtype == "bfloat16"),
+        )
+        # flux is deliberately NOT reassigned: zero-weight retries add
+        # exact zeros, so the returned array is bitwise-equal anyway.
+        self.x, self.elem = x2, e2
+        self._sentinel.note_localization(rec_idx.size, lost_idx.size)
+        dn = np.asarray(done).copy()
+        dn[rec_idx] = True
+        return jnp.asarray(dn)
+
+    def _quarantine_lost(self, idx: np.ndarray, x_start, dests, w,
+                         reason: str = "iteration_budget") -> None:
+        """Append one quarantine record per unrecoverable particle
+        (pid, origin, dest, element, weight, move) — the postmortem
+        payload for re-injection; no-op file-wise without a
+        ``quarantine_dir`` (the health report still counts them)."""
+        from pumiumtally_tpu.sentinel.quarantine import (
+            append_quarantine,
+            build_records,
+        )
+
+        sel = jnp.asarray(idx)
+        append_quarantine(
+            self.config.sentinel.quarantine_dir,
+            build_records(
+                idx, np.asarray(x_start[sel]), np.asarray(dests[sel]),
+                np.asarray(self.elem[sel]), np.asarray(w[sel]),
+                self.iter_count, reason=reason,
+            ),
+        )
+
     # -- leakage accounting ----------------------------------------------
     def _current_lost(self) -> int:
         """Particles currently excluded from transport (source in no
@@ -679,6 +833,7 @@ class PumiTally:
         """Localize particles to the host app's sampled source points
         (reference PumiTally.h:66-67; non-tallying initial search,
         PumiTallyImpl.cpp:54-64)."""
+        self._check_poisoned()
         t0 = time.perf_counter()
         self._stats_roll_batch()  # each sourcing opens a new batch
         self._resilience_roll_batch()  # autosave/drain at batch close
@@ -737,6 +892,7 @@ class PumiTally:
                 tol=self._tol, max_iters=self._max_iters,
                 walk_kw=self._walk_kw,
             )
+            done = self._sentinel_post_localize(dest, done)
             return jnp.all(done), jnp.sum(exited)
         if self.config.localization == "locate":
             return self._localize_by_planes(dest)
@@ -745,6 +901,7 @@ class PumiTally:
             tol=self._tol, max_iters=self._max_iters,
             walk_kw=self._walk_kw,
         )
+        done = self._sentinel_post_localize(dest, done)
         return jnp.all(done), jnp.sum(exited)
 
     def _localize_by_planes(self, dest: jnp.ndarray):
@@ -764,6 +921,7 @@ class PumiTally:
             tol=self._tol, max_iters=self._max_iters,
             walk_kw=self._walk_kw,
         )
+        done = self._sentinel_post_localize(dest, done)
         return jnp.all(done), jnp.sum(exited)
 
     def MoveToNextLocation(
@@ -787,6 +945,9 @@ class PumiTally:
           zeroing side effect is performed (there is no buffer to zero).
         - ``weights=None``: unit weights.
         """
+        # Poisoned check FIRST: a corrupt engine must refuse with the
+        # resume-from-checkpoint error whatever else is wrong.
+        self._check_poisoned()
         if not self.is_initialized:
             raise RuntimeError(
                 "CopyInitialPosition must be called before MoveToNextLocation "
@@ -885,7 +1046,9 @@ class PumiTally:
             self._last_dests_dev = dests
         self.iter_count += 1
         self._stats_note_move()
-        if self.config.check_found_all and not bool(found_all):
+        # found_all may be a per-particle mask (round 9) or an
+        # engine-reduced verdict — jnp.all covers both.
+        if self.config.check_found_all and not bool(jnp.all(found_all)):
             print("ERROR: Not all particles are found. May need more loops in search")
         if self.config.fenced_timing:
             jax.block_until_ready(self.flux)
@@ -928,11 +1091,17 @@ class PumiTally:
             step = partial(
                 _move_step, self.mesh, self.x, self.elem, origins, dests
             )
-        self.x, self.elem, self.flux, found_all = step(
+        x_prev = self.x  # phase-B start in continue mode (sentinel)
+        self.x, self.elem, self.flux, done, s_b = step(
             fly, w, self.flux, tol=self._tol, max_iters=self._max_iters,
             walk_kw=self._walk_kw,
         )
-        return found_all
+        if self._sentinel is None:
+            return done
+        return self._sentinel_post_move(
+            x_prev if origins is None else origins, dests, fly, w, done,
+            s_b,
+        )
 
     def _stats_vtk_cell_data(self) -> dict:
         """Optional flux_mean/rel_err cell arrays for the VTK payload
@@ -953,6 +1122,7 @@ class PumiTally:
         statistics enabled and >= 1 closed batch, ``flux_mean`` and
         (from 2 batches) ``rel_err`` cell arrays ride beside the
         reference's flux+volume payload."""
+        self._check_poisoned()
         t0 = time.perf_counter()
         out = filename or self.config.output_filename
         normalized = self.normalized_flux()
@@ -973,12 +1143,20 @@ class PumiTally:
     def _vtk_field_data(self) -> dict:
         """Campaign-level (non-per-cell) payload for the VTK writers:
         the cumulative lost-particle counter, so a result file accounts
-        for its own leakage."""
-        return {
+        for its own leakage — plus, with a sentinel armed, the health
+        report (audited moves, anomaly mask, worst conservation
+        residual, ladder outcomes), so a result file carries its own
+        health record."""
+        out = {
             "lost_particles": np.asarray(
                 [float(self.lost_particles)], np.float64
             ),
         }
+        if self._sentinel is not None:
+            from pumiumtally_tpu.io.vtk import health_field_data
+
+            out.update(health_field_data(self.health_report()))
+        return out
 
     # -- inspection (white-box surface used by the parity suite) ---------
     def normalized_flux(self) -> jnp.ndarray:

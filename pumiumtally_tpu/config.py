@@ -59,8 +59,17 @@ RETRACE_BUDGETS: dict = {
     "walk_continue": 3,
     "locate": 2,
     "localize": 4,
-    "partition_locate": 3,
-    "cascade_phase": 7,
+    # partition_locate raised 3→5 in r9: the sentinel recovery suites
+    # build reference + sentinel streaming-partitioned facades back to
+    # back (two chunk engines each, no shared cache across facades).
+    "partition_locate": 5,
+    # cascade_phase raised 7→12 in r9: the straggler-retry and
+    # overflow-recovery RESUME phases are separate static keys
+    # (resume flag + budget multipliers + forced-full-migrate ride the
+    # phase cache key), and the recovery tests drive reference +
+    # sentinel engines back to back — measured max 11 + 1 headroom
+    # (PUMIUMTALLY_RETRACE_RECORD over the full r9 tier-1).
+    "cascade_phase": 12,
     # Profiled-phase programs (parallel/partition.py component-budget
     # instrumentation): one jitted single-round program per
     # (engine, tally) — a profiled two-phase move drives both tally
@@ -76,8 +85,9 @@ RETRACE_BUDGETS: dict = {
     # FIRST move consumes replicated state arrays (checkpoint restore
     # materializes on one device; jit keys on input shardings) before
     # the steady sharded-layout key — a one-off per resume, not a
-    # per-call leak.
-    "sharded_walk_continue": 4,
+    # per-call leak. Measured max 4 in r9 (the sharded straggler
+    # recovery test adds a shape) + 1 headroom.
+    "sharded_walk_continue": 5,
     "sharded_locate": 2,
     "sharded_localize": 3,
     # Batch-statistics entry points (pumiumtally_tpu/stats): one
@@ -89,6 +99,19 @@ RETRACE_BUDGETS: dict = {
     # trigger tests sweep two metric/quantile keys) + 1 headroom.
     "close_batch": 3,
     "trigger_eval": 3,
+    # Sentinel entry points (r9, pumiumtally_tpu/sentinel):
+    # - "audit_pack": ONE cache key per audited particle shape (the
+    #   threshold and every carried scalar are traced). Measured
+    #   tier-1 max 2 (cross-facade audit tests drive two particle
+    #   shapes in one test) + 1 headroom.
+    # - "straggler_retry": one key per (padded straggler shape,
+    #   iteration budget, walk_kw, s_init-or-not) — shapes quantize
+    #   to powers of two (sentinel/straggler.py padded_size) precisely
+    #   so this stays bounded; the bf16 rung adds the forced-f32
+    #   walk_kw key and the localization ladder the s-less variant.
+    #   Measured tier-1 max 3 + 1 headroom.
+    "audit_pack": 3,
+    "straggler_retry": 4,
     # The resilience subsystem (r8, pumiumtally_tpu/resilience) is
     # deliberately host-side only — checkpoint serialization, autosave
     # cadence, signal handling, and fault injection never touch the
@@ -360,6 +383,23 @@ class TallyConfig:
     # carries the engine's exact slot/chunk layout). None (default):
     # no autosave code runs anywhere, no handlers are installed.
     checkpoint: Optional[Any] = None
+    # Runtime sentinels (pumiumtally_tpu/sentinel, docs/DESIGN.md
+    # "Failure taxonomy"): a sentinel.SentinelPolicy arms in-flight
+    # health monitoring and graceful degradation on this tally. Every
+    # audited move then runs ONE extra jitted reduction — unfinished
+    # count, tallied-vs-straight-line conservation residual, and a
+    # non-finite-flux probe, packed into one scalar fetch — and
+    # particles that exhaust the walk iteration budget go through the
+    # straggler-escalation ladder (2x-budget retry on the compacted
+    # residue -> exact-f32 retry for bf16 tiers -> quarantine +
+    # lost_particles) instead of being silently truncated mid-flight.
+    # Partitioned engines additionally recover capacity overflows
+    # (full-migrate retry -> one host-side capacity escalation ->
+    # safety save + poisoned refusal) instead of raising with a
+    # half-migrated round. None (default): no sentinel code runs
+    # anywhere, every engine is bitwise-identical and allocation-free
+    # vs a sentinel-less build (same contract as stats-off).
+    sentinel: Optional[Any] = None
     # Debug surface (reference getIntersectionPoints(),
     # PumiTallyImpl.h:177-178): when True the monolithic facade keeps
     # the staged inputs of the last move so
@@ -455,6 +495,14 @@ class TallyConfig:
                 raise ValueError(
                     "checkpoint must be a resilience.CheckpointPolicy, "
                     f"got {self.checkpoint!r}"
+                )
+        if self.sentinel is not None:
+            from pumiumtally_tpu.sentinel.policy import SentinelPolicy
+
+            if not isinstance(self.sentinel, SentinelPolicy):
+                raise ValueError(
+                    "sentinel must be a sentinel.SentinelPolicy, "
+                    f"got {self.sentinel!r}"
                 )
         if self.cap_frontier is not None and int(self.cap_frontier) < 0:
             raise ValueError(

@@ -78,6 +78,20 @@ def stats_cell_data(stats, volumes: np.ndarray) -> Dict[str, np.ndarray]:
     return out
 
 
+def health_field_data(report) -> Dict[str, np.ndarray]:
+    """Sentinel health report as VTK FIELD arrays (``report`` is a
+    ``pumiumtally_tpu.sentinel.HealthReport``): campaign-level scalars
+    — audited/anomalous move counts, the anomaly-mask union, the worst
+    conservation residual, straggler and overflow ladder outcomes —
+    riding the same FIELD block as ``lost_particles`` in every writer
+    (legacy leading FIELD, .vtu <FieldData>, every .pvtu piece), so a
+    result file carries its own health record. Returns {} for None,
+    keeping sentinel-off files byte-identical."""
+    if report is None:
+        return {}
+    return report.as_field_data()
+
+
 def write_vtk(
     path: str,
     coords: np.ndarray,

@@ -216,6 +216,14 @@ class WalkResult(NamedTuple):
     PumiTallyImpl.cpp:275-281, oracle test:242-245).
     ``elem`` is the final element (boundary leavers keep the last tet
     they were in, reference UpdateCurrentElement skips next==-1).
+    ``s`` is the final ray coordinate along the FIXED segment
+    ``x0 → dest`` (1 for particles that reached their destination,
+    < 1 for boundary leavers and iteration-cap stragglers): with the
+    walk's ``s_init``, a truncated particle's transport CONTINUES the
+    exact original parametrization — every remaining crossing computes
+    the bit-identical (s, contribution) pairs an uninterrupted walk
+    would have (the sentinel straggler ladder's bitwise-recovery
+    contract, round 9).
     """
 
     x: jnp.ndarray  # [N,3]
@@ -224,6 +232,7 @@ class WalkResult(NamedTuple):
     exited: jnp.ndarray  # [N] bool: finished by leaving the domain (vacuum BC)
     flux: jnp.ndarray  # [E] accumulated track-length tally
     iters: jnp.ndarray  # [] int32: iterations taken
+    s: jnp.ndarray = None  # [N] final ray coordinate (see above)
 
 
 def _gather_walk_row(mesh: TetMesh, elem: jnp.ndarray):
@@ -393,6 +402,7 @@ def walk(
     perm_mode: str = "auto",
     partition_method: str = "rank",
     table_dtype: str = "auto",
+    s_init: jnp.ndarray = None,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -456,7 +466,15 @@ def walk(
     done0 = in_flight != in_flight
     d0 = dest - x  # the whole walk's segment; s parametrizes along it
     seg_len = jnp.linalg.norm(d0, axis=1)  # computed once, not per iter
-    s0 = jnp.zeros_like(seg_len)
+    # ``s_init`` continues an interrupted walk's EXACT parametrization
+    # (the caller passes the previous WalkResult.s together with the
+    # ORIGINAL x/dest, so d0 — and with it every remaining crossing's
+    # arithmetic — is bit-identical to the uninterrupted walk). None
+    # (every production path) keeps the historical fresh-ray start.
+    s0 = (
+        jnp.zeros_like(seg_len) if s_init is None
+        else s_init.astype(fdtype)
+    )
     # flying/weight/seg_len enter the loop only through the tally
     # contribution — premultiply once (f64 parity: associativity-only
     # change, ~1 ulp).
@@ -520,7 +538,7 @@ def walk(
         exited = done & (s < one)
         return WalkResult(
             x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
-            exited=exited, flux=flux, iters=it,
+            exited=exited, flux=flux, iters=it, s=s,
         )
 
     # ---- compaction cascade --------------------------------------------
@@ -659,14 +677,14 @@ def walk(
         exited = done & (s < one)
         return WalkResult(
             x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
-            exited=exited, flux=flux, iters=it,
+            exited=exited, flux=flux, iters=it, s=s,
         )
     exited = done & (s < one)
     x_fin = final_x(s, done, exited, dest, d0)
     return WalkResult(
         x=unpermute(x_fin, idx), elem=unpermute(elem, idx),
         done=unpermute(done, idx), exited=unpermute(exited, idx),
-        flux=flux, iters=it,
+        flux=flux, iters=it, s=unpermute(s, idx),
     )
 
 

@@ -55,6 +55,7 @@ single chip's slots the way a literal walk-from-element-0 would.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -678,6 +679,15 @@ def _migrate_impl(part_L: int, ndev: int, cap_per_chip: int, state: dict,
         arrived, new_state["pending"] % part_L, new_state["lelem"]
     )
     new_state["pending"] = jnp.where(arrived, -1, new_state["pending"])
+    # Overflow-safe commit (round 9): an overflowing scatter collides
+    # slots, so the OLD state is kept verbatim instead — the caller
+    # commits unconditionally and recovers (retry at full capacity,
+    # host-side capacity escalation) from an intact pre-migrate
+    # snapshot rather than raising over poisoned slots. Healthy rounds
+    # select the new state bitwise (where(False, old, new) == new).
+    new_state = {
+        k: jnp.where(overflow, state[k], v) for k, v in new_state.items()
+    }
     return new_state, overflow
 
 
@@ -835,6 +845,13 @@ def _frontier_migrate_impl(part_L: int, nparts: int, cap_per_chip: int,
         jnp.where(valid, src // cap_per_chip, nparts), length=nparts + 1
     )[:nparts].astype(jnp.int32)
     arr = jnp.bincount(key, length=nparts + 1)[:nparts].astype(jnp.int32)
+    # Overflow-safe commit, same contract as _migrate_impl: on overflow
+    # the pre-migrate state survives verbatim (the phase loop exits on
+    # the flag without walking, and the host recovery ladder resumes
+    # from this intact snapshot).
+    new_state = {
+        k: jnp.where(overflow, state[k], v) for k, v in new_state.items()
+    }
     return new_state, overflow, dep, arr
 
 
@@ -909,6 +926,31 @@ OVERFLOW_MESSAGE = (
     "partitioned-mode chip capacity exceeded during particle "
     "migration; raise TallyConfig.capacity_factor"
 )
+
+LADDER_EXHAUSTED_MESSAGE = (
+    "partitioned-mode chip capacity exceeded during particle migration "
+    "and the recovery ladder (full-capacity retry, one host-side "
+    "capacity escalation) could not place the particles; the engine is "
+    "poisoned — resume from checkpoint with a larger "
+    "TallyConfig.capacity_factor"
+)
+
+
+def _grow_state(state: dict, old_cb: int, new_cb: int, nparts: int) -> dict:
+    """Re-home every slot of a ``nparts``-block state into a larger
+    per-block capacity (the overflow-recovery capacity escalation):
+    block d's slot r moves from ``d·old_cb + r`` to ``d·new_cb + r``;
+    the new tail slots take the dead-slot defaults. Pure relabeling —
+    no particle moves between blocks, so the escalated engine resumes
+    the interrupted phase from bitwise-identical particle state."""
+    iota = np.arange(nparts * old_cb)
+    new_slot = jnp.asarray(
+        (iota // old_cb) * new_cb + (iota % old_cb), jnp.int32
+    )
+    defaults = _default_state(nparts * new_cb, state)
+    return {
+        k: defaults[k].at[new_slot].set(v) for k, v in state.items()
+    }
 
 
 @dataclasses.dataclass
@@ -1154,6 +1196,22 @@ class PartitionedEngine:
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
+        # Overflow-recovery ladder state (round 9): recovery is
+        # always-armed — it only ever engages where the engine
+        # previously raised over a half-migrated round. ``poisoned``
+        # latches when the ladder exhausts; every facade call then
+        # refuses with a clear resume-from-checkpoint error instead of
+        # computing garbage. The callbacks let a facade report
+        # recoveries to its sentinel runner and trigger a resilience
+        # safety save before the poisoned raise.
+        self.capacity_factor = float(capacity_factor)
+        self.poisoned = False
+        self.overflow_recoveries = 0
+        self.capacity_escalations = 0
+        self.on_overflow_recovered = None  # callable(escalated: bool)
+        self.on_poisoned = None  # callable() — safety-save hook
+        self._last_phase_tally = False  # defer-mode recovery context
+        self._last_defer_flags = None  # (ovf_phase_a, ovf_phase_b) lazy
         self.cond_every = int(cond_every)
         self.min_window = int(min_window)
         self.use_vmem_walk = (
@@ -1335,22 +1393,63 @@ class PartitionedEngine:
             cap_per_chip=self.cap_per_block, state=st,
             partition_method=self.partition_method,
         )
-        # Mark the phase finished for all particles.
-        self.state["done"] = jnp.ones((self.cap,), bool)
-        self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
         # Lazy lost count: fetched only when the warning needs it or
         # when a two-phase move engages the revival path.
         self._n_lost_dev = jnp.sum(~found)
         self._n_lost_cache = None
         if defer_sync:
+            # Finalize (phase done for everyone) only when the
+            # placement actually happened: an overflowing migrate kept
+            # the pre-migrate snapshot, whose pending rows the deferred
+            # recovery (_recover_localize_overflow, at the caller's
+            # batch sync point) still needs. One device select per
+            # lane — no host sync here.
+            self._finalize_localize(overflow)
             return jnp.all(found), overflow
-        self._check_overflow(overflow)
+        if bool(overflow):
+            self._recover_localize_overflow()
+        else:
+            self._finalize_localize()
         if self.check_found_all and self._n_lost:
             print(
                 f"[WARNING] {self._n_lost} source points lie in no mesh "
                 "element; their particles are excluded from transport"
             )
         return jnp.all(found), 0
+
+    def _finalize_localize(self, overflow=None) -> None:
+        """Mark the localization phase finished for all particles —
+        conditionally (device select, no sync) when a lazy overflow
+        flag is in play."""
+        done = jnp.ones((self.cap,), bool)
+        pend = jnp.full((self.cap,), -1, jnp.int32)
+        if overflow is None:
+            self.state["done"] = done
+            self.state["pending"] = pend
+        else:
+            self.state["done"] = jnp.where(
+                overflow, self.state["done"], done
+            )
+            self.state["pending"] = jnp.where(
+                overflow, self.state["pending"], pend
+            )
+
+    def _recover_localize_overflow(self) -> None:
+        """Localization/revival placement overflowed: those paths
+        already use the full-capacity migrate (their frontier IS the
+        whole population), so the ladder goes straight to the capacity
+        escalation, retries the placement over the intact pending
+        snapshot, and poisons on a second failure."""
+        self._escalate_capacity(self._needed_capacity_growth())
+        self.state, ovf = migrate(
+            part_L=self.part.L, ndev=self.nparts,
+            cap_per_chip=self.cap_per_block, state=self.state,
+            partition_method=self.partition_method,
+        )
+        if bool(ovf):
+            self._poison()  # raises
+        self._finalize_localize()
+        self._note_recovery(escalated=True)
 
     @property
     def last_walk_rounds(self) -> int:
@@ -1441,15 +1540,20 @@ class PartitionedEngine:
             )
         return self._n_lost_cache
 
-    def _make_round_sm(self, tally: bool):
+    def _make_round_sm(self, tally: bool, max_iters: Optional[int] = None):
         """The shard_mapped one-walk-round kernel, shared by the fused
         phase program (``_phase_program``) and the profiled per-round
-        driver (``_round_program``) so the two can never drift."""
+        driver (``_round_program``) so the two can never drift.
+        ``max_iters`` overrides the engine budget (the straggler-retry
+        resume phases walk with a multiplied iteration budget)."""
         pp = P(self.axis)
         ax = self.axis
         part_L = self.part.L
         blocks = self.blocks_per_chip
-        tol, max_iters = self.tol, self.max_iters
+        tol = self.tol
+        max_iters = (
+            self.max_iters if max_iters is None else int(max_iters)
+        )
         cond_every = self.cond_every
         min_window = self.min_window
         has_adj = self.part.adj_int is not None
@@ -1614,49 +1718,74 @@ class PartitionedEngine:
             **shard_map_check_kwargs(not use_vmem),
         )
 
-    def _phase_key(self, kind: str, tally: bool) -> tuple:
+    def _phase_key(self, kind: str, tally: bool, variant: tuple = ()
+                   ) -> tuple:
         """Shared cache-key components of the phase-family programs.
         The closures bake in EVERY per-engine parameter they capture —
         capacity, round/iteration budgets, tolerance, the frontier
         slab, and the partition itself — so the key must carry all of
         them: engines sharing a cache reuse a compiled program only
         for a fully identical configuration (chunked engines differ in
-        the last, smaller chunk's capacity)."""
+        the last, smaller chunk's capacity). ``variant`` carries the
+        recovery-family extras (resume flag, budget multipliers,
+        forced-full-migrate)."""
         return (kind, tally, self.cap_per_chip, self.max_rounds,
                 self.max_iters, self.tol, self.cond_every,
                 self.min_window, self.use_vmem_walk, self.blocks_per_chip,
-                self.partition_method, self.cap_frontier, id(self.part))
+                self.partition_method, self.cap_frontier, id(self.part),
+                variant)
 
-    def _phase_program(self, tally: bool):
+    def _phase_program(self, tally: bool, resume: bool = False,
+                       iters_mult: int = 1, rounds_mult: int = 1,
+                       force_full_migrate: bool = False):
         """Cached jitted FULL phase: initial walk round plus as many
         migrate→walk rounds as needed, all inside one ``lax.while_loop``
         — zero per-round host syncs (the reference's search loop pays an
-        MPI rendezvous per migration instead)."""
-        key = self._phase_key("phase", tally)
+        MPI rendezvous per migration instead).
+
+        The recovery family (round 9): ``resume=True`` skips the
+        done/exited/dest re-derivation at phase entry and continues
+        EXACTLY the committed mid-phase state — finished particles stay
+        done (their committed positions are never re-derived, which
+        would not be bitwise-stable), stragglers walk on from their
+        tallied partial positions, and stale paused rows re-derive
+        their partition crossing geometrically. ``iters_mult``/
+        ``rounds_mult`` multiply the walk/round budgets (the straggler
+        retry rung); ``force_full_migrate`` disables the frontier slab
+        for this program (the overflow ladder's defragmenting
+        full-capacity retry)."""
+        variant = (resume, iters_mult, rounds_mult, force_full_migrate)
+        key = self._phase_key("phase", tally, variant)
         if key in self._jit_cache:
             return self._jit_cache[key]
         part_L = self.part.L
         nparts, cap_b = self.nparts, self.cap_per_block
-        max_rounds = self.max_rounds
+        max_rounds = self.max_rounds * int(rounds_mult)
         has_adj = self.part.adj_int is not None
         pmethod = self.partition_method
         two_tier = self.two_tier
-        cap_frontier = self.cap_frontier
-        round_sm = self._make_round_sm(tally)
+        cap_frontier = (
+            None if force_full_migrate else self.cap_frontier
+        )
+        round_sm = self._make_round_sm(
+            tally, max_iters=self.max_iters * int(iters_mult)
+        )
 
         @jax.jit
         def phase(table, adj, hi, state, flux):
             st = dict(state)
-            st["done"] = ~st["alive"] | (st["fly"] == 0)
-            # Per-walk flag, like the single-chip engine's fresh
-            # exited mask each walk() call: a particle that left the
-            # domain last move but was re-flown must not carry a stale
-            # True (it would dodge the commit-dest-bit-exactly path).
-            st["exited"] = jnp.zeros_like(st["exited"])
-            # Non-flying particles hold position: dest <- x.
-            st["dest"] = jnp.where(
-                (st["fly"] == 1)[:, None], st["dest"], st["x"]
-            )
+            if not resume:
+                st["done"] = ~st["alive"] | (st["fly"] == 0)
+                # Per-walk flag, like the single-chip engine's fresh
+                # exited mask each walk() call: a particle that left
+                # the domain last move but was re-flown must not carry
+                # a stale True (it would dodge the
+                # commit-dest-bit-exactly path).
+                st["exited"] = jnp.zeros_like(st["exited"])
+                # Non-flying particles hold position: dest <- x.
+                st["dest"] = jnp.where(
+                    (st["fly"] == 1)[:, None], st["dest"], st["x"]
+                )
 
             def call_round(st, fx, n_act):
                 args = (
@@ -1863,9 +1992,12 @@ class PartitionedEngine:
                 st, ovf, dep, arr, fb = mig(st, n_p)
                 ovf_h = bool(ovf)  # fence; also gates the next walk
             if ovf_h:
-                # Pre-phase engine state stays committed, like
-                # _run_phase's default path.
-                raise RuntimeError(OVERFLOW_MESSAGE)
+                # Overflow-safe migrate kept the pre-migrate snapshot;
+                # commit it and hand the phase to the recovery ladder
+                # (mirrors _run_phase's fused path).
+                self.state = st
+                self.flux_padded = fx
+                return self._recover_overflow(tally)
             if self.cap_frontier is not None and bool(fb):
                 prof.fallback_rounds += 1
                 phase_fallbacks += 1
@@ -1927,6 +2059,7 @@ class PartitionedEngine:
                     "exclusive (profiling syncs every round)"
                 )
             return self._run_phase_profiled(tally, profile)
+        self._last_phase_tally = tally  # defer-mode recovery context
         phase = self._phase_program(tally)
         st, fx, found_all, ovf, rounds, disp, fmax, fsum, nfb = phase(
             self.part.table, self.part.adj_int, self.part.table_hi,
@@ -1951,10 +2084,211 @@ class PartitionedEngine:
             self.flux_padded = fx
             return found_all, ovf
         ovf_v, found_v = jax.device_get((ovf, found_all))
-        self._check_overflow(ovf_v)
+        # Overflow-safe migrate: the committed state on overflow is the
+        # intact pre-migrate snapshot of the failed round — safe to
+        # commit, then recover instead of raise.
         self.state = st
         self.flux_padded = fx
+        if bool(ovf_v):
+            return self._recover_overflow(tally)
         return bool(found_v)
+
+    # -- overflow recovery + straggler escalation (round 9) --------------
+    def _resume_phase(self, tally: bool, iters_mult: int = 1,
+                      rounds_mult: int = 1,
+                      force_full_migrate: bool = False):
+        """Run a recovery-family phase program over the COMMITTED
+        mid-phase state and commit the result. Returns
+        ``(found_all, overflowed)`` as host bools — recovery paths are
+        rare and synchronous by design."""
+        phase = self._phase_program(
+            tally, resume=True, iters_mult=iters_mult,
+            rounds_mult=rounds_mult,
+            force_full_migrate=force_full_migrate,
+        )
+        st, fx, found_all, ovf, rounds, disp, fmax, fsum, nfb = phase(
+            self.part.table, self.part.adj_int, self.part.table_hi,
+            self.state, self.flux_padded,
+        )
+        ovf_v, found_v = jax.device_get((ovf, found_all))
+        self.state = st
+        self.flux_padded = fx
+        self._last_rounds_dev = rounds
+        self._last_rounds_cache = None
+        self._last_disp_dev = disp
+        self._last_disp_cache = None
+        return bool(found_v), bool(ovf_v)
+
+    def _note_recovery(self, escalated: bool) -> None:
+        self.overflow_recoveries += 1
+        if self.on_overflow_recovered is not None:
+            self.on_overflow_recovered(escalated)
+
+    def _poison(self) -> None:
+        """Latch the poisoned flag and fire the safety-save hook (a
+        facade with a resilience policy writes one last generation of
+        the still-intact pre-overflow state before the raise)."""
+        self.poisoned = True
+        if self.on_poisoned is not None:
+            try:
+                self.on_poisoned()
+            except Exception as e:  # noqa: BLE001 — best-effort save
+                warnings.warn(f"overflow safety save failed: {e}")
+        raise RuntimeError(LADDER_EXHAUSTED_MESSAGE)
+
+    def _recover_overflow(self, tally: bool) -> bool:
+        """The overflow-recovery ladder, from a committed intact
+        mid-phase snapshot (overflow-safe migrate):
+
+        1. resume the phase through the FULL-CAPACITY migrate path —
+           ``_migrate_impl`` re-compacts every part, so the retry
+           doubles as a defragmenter (and bypasses the frontier slab
+           when one is configured);
+        2. escalate once to the demand the committed snapshot shows
+           (``_needed_capacity_growth``): grow every part's slot
+           capacity host-side (``_grow_state`` — a pure slot
+           relabeling, particle state bitwise-preserved) and resume;
+        3. the resumed phase can still overflow — mid-phase demand
+           accrues over FUTURE migration rounds the snapshot cannot
+           see — so the terminal rung escalates to the mathematical
+           bound (every part can host the whole population:
+           ``cap_per_block > n`` makes overflow impossible) and
+           resumes once more;
+        4. an overflow past that is an internal invariant violation →
+           safety-save hook, poison, raise.
+        """
+        ok, ovf = self._resume_phase(tally, force_full_migrate=True)
+        if not ovf:
+            self._note_recovery(escalated=False)
+            return ok
+        self._escalate_capacity(self._needed_capacity_growth())
+        ok, ovf = self._resume_phase(tally, force_full_migrate=True)
+        if not ovf:
+            self._note_recovery(escalated=True)
+            return ok
+        terminal = 1.05 * (self.n + 2) / max(self.cap_per_block, 1)
+        if terminal > 1.0:
+            self._escalate_capacity(terminal)
+            ok, ovf = self._resume_phase(tally, force_full_migrate=True)
+            if not ovf:
+                self._note_recovery(escalated=True)
+                return ok
+        self._poison()  # raises
+        return False  # pragma: no cover — _poison always raises
+
+    def _needed_capacity_growth(self) -> float:
+        """Size the ONE capacity escalation from the actual demand:
+        the committed snapshot's per-part population (stayers +
+        pending arrivals, from the intact pending rows) tells exactly
+        how many slots the worst part needs — a blind 2x would leave a
+        pathological concentration still overflowing and burn the
+        ladder's only escalation. Host fetch of two slot lanes; a
+        recovery event, not a hot path."""
+        pending = np.asarray(self.state["pending"])
+        alive = np.asarray(self.state["alive"])
+        slot_chip = np.arange(self.cap) // self.cap_per_block
+        target = np.where(
+            pending >= 0, pending // self.part.L, slot_chip
+        )
+        counts = np.bincount(target[alive], minlength=self.nparts)
+        needed = int(counts.max()) + 1
+        return max(2.0, 1.1 * needed / max(self.cap_per_block, 1))
+
+    def _escalate_capacity(self, factor: float = 2.0) -> None:
+        """Host-side rebuild at a larger per-block capacity: slot
+        arrays grow in place (``_grow_state``), the padded flux and the
+        partition are untouched (capacity is a slot-side quantity), and
+        the phase/locate programs recompile for the new geometry (the
+        jit-cache keys carry ``cap_per_chip``)."""
+        old_cb = self.cap_per_block
+        new_cb = int(old_cb * float(factor)) + 1
+        if self.blocks_per_chip > 1 and self.block_kernel == "vmem":
+            from pumiumtally_tpu.ops.vmem_walk import W_TILE_DEFAULT
+
+            new_cb = -(-new_cb // W_TILE_DEFAULT) * W_TILE_DEFAULT
+        self.capacity_factor *= float(factor)
+        self.capacity_escalations += 1
+        self.state = _grow_state(
+            self.state, old_cb, new_cb, self.nparts
+        )
+        self.cap_per_block = new_cb
+        self.cap_per_chip = self.blocks_per_chip * new_cb
+        self.cap = self.nparts * new_cb
+        if self.cap_frontier is not None:
+            self.cap_frontier = min(self.cap_frontier, self.cap)
+
+    def retry_stragglers(self, iters_factor: int = 2) -> bool:
+        """Straggler rung for the partitioned engine: resume the
+        interrupted phase over the committed state with multiplied
+        iteration AND round budgets — the compaction is inherent (done
+        particles never re-walk under ``resume=True``, and the gather
+        sub-split dispatches occupied blocks only). The multipliers
+        floor the effective budgets at the mesh-derived safe bounds
+        (a deliberately tiny engine budget — the truncation scenario
+        this ladder exists for — must not starve its own cure; both
+        loops exit early, so generosity costs nothing). Returns
+        found_all; an overflow during the retry goes through the same
+        recovery ladder."""
+        f = int(iters_factor)
+        need_iters = max(self.max_iters * f, 64 + self.part.L)
+        need_rounds = max(self.max_rounds * f, 64)
+        ok, ovf = self._resume_phase(
+            True,
+            iters_mult=-(-need_iters // self.max_iters),
+            rounds_mult=-(-need_rounds // self.max_rounds),
+        )
+        if ovf:
+            return self._recover_overflow(True)
+        return ok
+
+    def declare_lost_stragglers(self) -> int:
+        """Ladder exhausted: fold the still-unfinished particles into
+        the ``lost`` flag — excluded from transport (their committed
+        position is a mid-flight partial point the caller does not
+        know about), counted by ``lost_particles``, revivable by a
+        re-located source exactly like localization losses. Returns
+        how many were declared (a host fetch; the quarantine path
+        needs their records anyway)."""
+        st = dict(self.state)
+        strag = st["alive"] & ~st["done"] & ~st["lost"]
+        n = int(jnp.sum(strag))
+        if n == 0:
+            return 0
+        st["lost"] = st["lost"] | strag
+        st["fly"] = jnp.where(strag, jnp.asarray(0, st["fly"].dtype),
+                              st["fly"])
+        st["done"] = st["done"] | strag
+        st["pending"] = jnp.where(strag, -1, st["pending"]).astype(
+            jnp.int32
+        )
+        self.state = st
+        self._n_lost_dev = jnp.sum(st["lost"])
+        self._n_lost_cache = None
+        return n
+
+    def caller_order_view(self, keys=("x", "lelem", "done")) -> dict:
+        """Caller-order device views of slot-state rows (sentinel
+        audit / quarantine, and the ``elem_ids`` output path): one
+        stable argsort by pid, then row gathers — [n]-shaped, original
+        particle order. ``elem_orig`` maps local elements to original
+        ids with lost rows masked to −1 (their lelem is meaningless
+        and must not read as a real element — same contract as
+        ``elem_ids``)."""
+        o = self._order()
+        out = {}
+        for k in keys:
+            if k == "elem_orig":
+                glid = (
+                    (jnp.cumsum(jnp.ones_like(self.state["pid"])) - 1)
+                    // self.cap_per_block
+                ) * self.part.L + self.state["lelem"]
+                out[k] = jnp.where(
+                    self.state["lost"][o], -1,
+                    self.part.orig_of_glid[glid[o]],
+                )
+            else:
+                out[k] = self.state[k][o]
+        return out
 
     def move(
         self,
@@ -2009,6 +2343,11 @@ class PartitionedEngine:
         if defer_sync:
             ok_b, ovf_b = rb
             ovf = ovf_b if ovf_a is None else (ovf_a | ovf_b)
+            # Per-phase lazy flags for the deferred recovery: a
+            # phase-B-only overflow resumes through the ladder at the
+            # caller's sync point; a phase-A overflow that phase B has
+            # already walked over is unrecoverable (poison).
+            self._last_defer_flags = (ovf_a, ovf_b)
             return ok_a & ok_b, ovf
         return ok_a and rb
 
@@ -2032,7 +2371,19 @@ class PartitionedEngine:
             cap_per_chip=self.cap_per_block, state=st,
             partition_method=self.partition_method,
         )
-        self._check_overflow(overflow)
+        if bool(overflow):
+            # Same ladder as localization: one demand-sized escalation,
+            # retry the placement over the intact snapshot, poison on
+            # failure.
+            self._escalate_capacity(self._needed_capacity_growth())
+            self.state, overflow = migrate(
+                part_L=self.part.L, ndev=self.nparts,
+                cap_per_chip=self.cap_per_block, state=self.state,
+                partition_method=self.partition_method,
+            )
+            if bool(overflow):
+                self._poison()  # raises
+            self._note_recovery(escalated=True)
         self.state["pending"] = jnp.full((self.cap,), -1, jnp.int32)
         self._n_lost_dev = jnp.sum(self.state["lost"])
         self._n_lost_cache = None
@@ -2053,16 +2404,11 @@ class PartitionedEngine:
 
     def elem_ids(self) -> np.ndarray:
         """Original (caller-visible) element ids per particle; −1 for
-        lost particles (no containing element — their slot's lelem is
-        meaningless and must not read as a real element)."""
-        o = self._order()
-        glid = (
-            (jnp.cumsum(jnp.ones_like(self.state["pid"])) - 1)
-            // self.cap_per_block
-        ) * self.part.L + self.state["lelem"]
-        ids = np.asarray(self.part.orig_of_glid[glid[o]]).copy()
-        ids[np.asarray(self.state["lost"][o])] = -1
-        return ids
+        lost particles (``caller_order_view`` holds the one mapping +
+        masking definition)."""
+        return np.asarray(
+            self.caller_order_view(("elem_orig",))["elem_orig"]
+        )
 
     def flux_original(self) -> jnp.ndarray:
         return self.part.flux_to_original(self.flux_padded)

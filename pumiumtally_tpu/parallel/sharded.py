@@ -178,7 +178,11 @@ def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux,
     single-chip move from api.tally) on its shard, accumulating a local
     flux delta from a varying zero; deltas are ``psum``'d over ICI, so
     the returned flux is identical (and bitwise deterministic) on every
-    chip. ``found_all`` is the all-chips AND of per-shard convergence.
+    chip. The per-particle ``done`` mask and phase-B ray coordinate
+    ``s`` stay sharded like the other particle outputs — the facade
+    reduces the mask for the found-all check and the sentinel's
+    straggler ladder consumes both (round 9: every tallied step
+    returns the mask + s, not a pre-reduced scalar).
     """
     ax = _axis_name(device_mesh)
     pp = P(ax)
@@ -187,21 +191,18 @@ def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux,
         shard_map,
         mesh=device_mesh,
         in_specs=(P(),) + (pp,) * len(particle_args) + (P(),),
-        out_specs=(pp, pp, P(), P()),
+        out_specs=(pp, pp, P(), pp, pp),
         **shard_map_check_kwargs(),
     )
     def step(mesh_, *rest):
         *pargs, flux_ = rest
         zero_flux = _pvary(jnp.zeros_like(flux_), ax)
-        x2, elem2, dflux, local_ok = step_fn(
+        x2, elem2, dflux, local_done, local_s = step_fn(
             mesh_, *pargs, zero_flux, tol=tol, max_iters=max_iters,
             walk_kw=walk_kw,
         )
         flux_out = flux_ + lax.psum(dflux, ax)
-        found_all = (
-            lax.psum(local_ok.astype(jnp.int32), ax) == device_mesh.shape[ax]
-        )
-        return x2, elem2, flux_out, found_all
+        return x2, elem2, flux_out, local_done, local_s
 
     return step(mesh, *particle_args, flux)
 

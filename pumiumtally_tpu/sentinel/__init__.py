@@ -1,0 +1,64 @@
+"""Runtime sentinels & graceful degradation (round 9, docs/DESIGN.md
+"Failure taxonomy").
+
+PR 8 made campaigns survive *crashes*; this package makes them survive
+the engine's own failure modes, in flight:
+
+- **Audit lanes** (audit.py) — opt-in per-move on-device diagnostics:
+  unfinished-particle count after the walk loop, the tallied-length vs
+  straight-line-length conservation residual (the bench-only gate
+  moved on-device), and a non-finite-flux probe, packed into ONE
+  scalar fetch per move.
+- **Straggler escalation** (straggler.py) — particles that exhaust
+  ``max_iters`` are no longer silently truncated: a bounded retry
+  ladder (2× budget on the compacted residue → exact-f32 retry for
+  bf16 tiers → quarantine + ``lost_particles``).
+- **Quarantine** (quarantine.py) — an append-safe JSONL record of
+  every particle nothing could recover, for postmortem re-injection.
+- **Policy/report** (policy.py, runner.py) — ``SentinelPolicy`` on
+  ``TallyConfig.sentinel`` arms all of it; ``tally.health_report()``
+  returns the cumulative ``HealthReport`` (also written as VTK FIELD
+  data). The partitioned overflow-recovery ladder
+  (parallel/partition.py) reports its events through the same runner.
+
+Sentinel-off (the default) constructs nothing anywhere: every engine
+is bitwise-identical and allocation-free vs a sentinel-less build —
+the same contract as stats-off and checkpoint-off, pinned by
+tests/test_sentinel.py and the bench A/B parity gate
+(tools/exp_sentinel_ab.py).
+"""
+
+from pumiumtally_tpu.sentinel.policy import (
+    ANOMALY_CONSERVATION,
+    ANOMALY_NONFINITE,
+    ANOMALY_UNFINISHED,
+    EnginePoisonedError,
+    HealthReport,
+    POISONED_MESSAGE,
+    SentinelAnomalyError,
+    SentinelPolicy,
+    describe_mask,
+)
+from pumiumtally_tpu.sentinel.quarantine import (
+    append_quarantine,
+    quarantine_path,
+    read_quarantine,
+)
+from pumiumtally_tpu.sentinel.runner import SentinelRunner, build_runner
+
+__all__ = [
+    "ANOMALY_CONSERVATION",
+    "ANOMALY_NONFINITE",
+    "ANOMALY_UNFINISHED",
+    "EnginePoisonedError",
+    "HealthReport",
+    "POISONED_MESSAGE",
+    "SentinelAnomalyError",
+    "SentinelPolicy",
+    "SentinelRunner",
+    "append_quarantine",
+    "build_runner",
+    "describe_mask",
+    "quarantine_path",
+    "read_quarantine",
+]

@@ -1,0 +1,85 @@
+"""On-device audit lanes: one jitted program, one packed scalar.
+
+Per audited move the facade hands this module the caller-order view of
+the move — phase-B start positions, committed end positions, flying
+flags, weights, the per-particle done mask, and the current flux — and
+gets back ONE packed int32 scalar plus two carried device scalars (the
+running flux sum and the running worst residual). Everything reduces
+inside a single jitted program (entry point ``audit_pack``,
+config.RETRACE_BUDGETS), so the audit costs one dispatch and, when the
+facade fetches the packed scalar, one scalar D2H per move — under the
+default fenced timing that fetch piggybacks on the fence the facade
+already pays.
+
+The conservation lane is the bench-only ``check_conservation`` gate
+moved on-device: a track-length tally over segments that stay inside
+the mesh must satisfy ``Σ flux == Σ fly·w·|x_end − x_start|`` exactly
+up to accumulation rounding — boundary-clamped AND iteration-truncated
+particles both commit exactly the position their partial track was
+tallied to (the walk's s-telescoping), so the identity holds for them
+too and the two anomaly signals stay independent.
+
+Packing: ``packed = n_unfinished · 8 + anomaly_mask`` (mask in the low
+``_ANOMALY_BITS`` bits); ``split_packed`` undoes it on the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from pumiumtally_tpu.sentinel.policy import (
+    _ANOMALY_BITS,
+    ANOMALY_CONSERVATION,
+    ANOMALY_NONFINITE,
+    ANOMALY_UNFINISHED,
+)
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def wide_dtype():
+    """The audit's accumulation dtype: f64 under x64 (parity suites),
+    else f32 — requesting f64 on an x64-less runtime only produces a
+    truncation warning per op."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+@jax.jit
+def _audit_pack(x0, x1, fly, w, done, flux, prev_sum, prev_max, rtol):
+    """One-program audit reduction.
+
+    Returns ``(packed, flux_sum, new_max, residual)`` — all device
+    scalars; the caller fetches ``packed`` (the one scalar) and
+    carries the rest lazily. ``rtol`` is a traced scalar so the jit
+    key never varies with the threshold.
+    """
+    wd = wide_dtype()
+    flying = fly.astype(bool)
+    traveled = jnp.linalg.norm(x1.astype(wd) - x0.astype(wd), axis=1)
+    expected = jnp.sum(jnp.where(flying, w.astype(wd) * traveled, 0.0))
+    flux_sum = jnp.sum(flux.astype(wd))
+    delta = flux_sum - prev_sum
+    tiny = jnp.asarray(jnp.finfo(wd).tiny, wd)
+    residual = jnp.abs(delta - expected) / jnp.maximum(expected, tiny)
+    n_unf = jnp.sum(flying & ~done).astype(jnp.int32)
+    mask = (
+        jnp.where(n_unf > 0, ANOMALY_UNFINISHED, 0)
+        | jnp.where(residual > rtol, ANOMALY_CONSERVATION, 0)
+        | jnp.where(~jnp.isfinite(delta), ANOMALY_NONFINITE, 0)
+    ).astype(jnp.int32)
+    packed = n_unf * (1 << _ANOMALY_BITS) + mask
+    return packed, flux_sum, jnp.maximum(prev_max, residual), residual
+
+
+# The counting wrapper (retrace tripwire): audit_pack has ONE cache
+# key per particle shape — the threshold and every carried scalar are
+# traced, so repeated moves hit the cache.
+audit_pack = register_entry_point("audit_pack", _audit_pack)
+
+
+def split_packed(packed: int):
+    """(n_unfinished, anomaly_mask) from the fetched packed scalar."""
+    p = int(packed)
+    return p >> _ANOMALY_BITS, p & ((1 << _ANOMALY_BITS) - 1)

@@ -1,0 +1,199 @@
+"""Sentinel policy + health report (round 9, docs/DESIGN.md "Failure
+taxonomy").
+
+The engine's existing gates (input validation, conservation in bench,
+the found-all ERROR print) either run off-device, run only in the
+bench, or merely *print*: an in-flight anomaly — a walk that exhausts
+``max_iters``, a flux delta that stopped matching the straight-line
+track length, a non-finite accumulator — used to corrupt the campaign
+silently. ``SentinelPolicy`` arms the runtime health subsystem on a
+tally (``TallyConfig.sentinel``): cheap on-device per-move audit lanes
+packed into ONE scalar fetch, a bounded straggler-escalation ladder in
+place of silent truncation, and quarantine accounting for particles
+nothing could recover. Sentinel-off (the default) constructs nothing:
+every engine stays bitwise-identical and allocation-free, the same
+contract as stats-off / checkpoint-off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Anomaly bitmask (low 3 bits of the packed audit scalar; the
+# remaining bits carry the unfinished-particle count — see
+# audit.pack_audit / split_packed).
+ANOMALY_UNFINISHED = 1  # particles not done when the walk loop exited
+ANOMALY_CONSERVATION = 2  # tallied-vs-straight-line residual over rtol
+ANOMALY_NONFINITE = 4  # non-finite flux delta (poisoned accumulator)
+_ANOMALY_BITS = 3  # bit width of the mask inside the packed scalar
+
+ANOMALY_NAMES = {
+    ANOMALY_UNFINISHED: "unfinished",
+    ANOMALY_CONSERVATION: "conservation",
+    ANOMALY_NONFINITE: "nonfinite_flux",
+}
+
+
+def describe_mask(mask: int) -> str:
+    """Human-readable anomaly mask, for warnings and reports."""
+    names = [n for bit, n in ANOMALY_NAMES.items() if mask & bit]
+    return "+".join(names) if names else "none"
+
+
+@dataclasses.dataclass(frozen=True)
+class SentinelPolicy:
+    """Runtime health knobs (TallyConfig.sentinel).
+
+    Attributes:
+      audit: per-move on-device audit lanes — unfinished-particle
+        count, tallied-length vs straight-line-length conservation
+        residual (the bench-only ``check_conservation`` gate moved
+        on-device), and a non-finite-flux probe — packed into one
+        scalar fetched per move. Under the default fenced timing this
+        adds no sync point (the facade already blocks on the flux);
+        an unfenced pipeline pays one scalar sync per move for the
+        audit, which is why it is a policy knob and not always-on.
+      conservation_rtol: relative residual above which the
+        conservation bit fires. ``None`` → 1e-9 in f64, 1e-3
+        otherwise (the residual of a healthy move is pure
+        accumulation rounding, ~ulp·sqrt(n) — the f32 default leaves
+        headroom for million-particle batches). Two-phase moves whose
+        phase-A relocation clamps at the hull legitimately travel less
+        than ``|x1 − origin|`` — the audit measures phase B against the
+        staged origins — so non-convex relocation workloads should
+        widen this or read the report instead of raising.
+      straggler_retry: arm the escalation ladder — particles still
+        unfinished when the walk loop exits are no longer silently
+        truncated mid-flight; they are compacted and re-dispatched
+        with ``retry_iters_factor``× the iteration budget, bf16
+        two-tier engines additionally retry against the exact
+        f32/hi-tier tables, and only then is a particle declared lost
+        (folded into ``lost_particles`` + a quarantine record).
+      retry_iters_factor: iteration-budget multiplier for the retry
+        rungs (the partitioned retry also multiplies the round
+        budget).
+      quarantine_dir: directory for ``quarantine.jsonl`` — one record
+        per unrecoverable particle (pid, origin, dest, element,
+        weight, move) for postmortem re-injection. ``None`` keeps
+        quarantine accounting in the health report only.
+      on_anomaly: what a non-zero audit mask does beyond counting:
+        ``"warn"`` prints one warning per anomalous move, ``"raise"``
+        raises ``SentinelAnomalyError`` (the move's state is already
+        committed — the raise is a tripwire, not a rollback),
+        ``"record"`` only accumulates into the health report.
+    """
+
+    audit: bool = True
+    conservation_rtol: Optional[float] = None
+    straggler_retry: bool = True
+    retry_iters_factor: int = 2
+    quarantine_dir: Optional[str] = None
+    on_anomaly: str = "warn"
+
+    def __post_init__(self) -> None:
+        if self.on_anomaly not in ("warn", "raise", "record"):
+            raise ValueError(
+                "on_anomaly must be 'warn', 'raise' or 'record', "
+                f"got {self.on_anomaly!r}"
+            )
+        if int(self.retry_iters_factor) < 1:
+            raise ValueError(
+                f"retry_iters_factor must be >= 1, "
+                f"got {self.retry_iters_factor!r}"
+            )
+        if self.conservation_rtol is not None and (
+            float(self.conservation_rtol) <= 0
+        ):
+            raise ValueError(
+                f"conservation_rtol must be > 0 or None, "
+                f"got {self.conservation_rtol!r}"
+            )
+
+    def resolved_rtol(self, dtype) -> float:
+        import numpy as np
+
+        if self.conservation_rtol is not None:
+            return float(self.conservation_rtol)
+        return 1e-9 if np.dtype(dtype) == np.float64 else 1e-3
+
+
+class SentinelAnomalyError(RuntimeError):
+    """An audited move tripped the anomaly mask under
+    ``on_anomaly="raise"``. The move's state is committed (the audit
+    runs after the walk); the campaign should checkpoint/abort rather
+    than keep accumulating."""
+
+
+class EnginePoisonedError(RuntimeError):
+    """The engine state is known-corrupt (a partitioned capacity
+    overflow exhausted the recovery ladder, or an unrecoverable
+    mid-pipeline overflow); every further protocol call refuses until
+    the tally is restored from a checkpoint."""
+
+
+POISONED_MESSAGE = (
+    "engine state corrupt — a capacity overflow exhausted the recovery "
+    "ladder; resume from checkpoint (resilience.resume_latest) or "
+    "rebuild the tally with a larger TallyConfig.capacity_factor"
+)
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """Cumulative campaign health (``tally.health_report()``); also
+    written as VTK FIELD data so a result file carries its own health
+    record (io.vtk.health_field_data).
+
+    ``moves_audited``/``anomaly_moves`` count audited moves and the
+    subset with a non-zero anomaly mask; ``anomaly_mask_union`` ORs
+    every move's mask (``describe_mask`` renders it).
+    ``unfinished_total`` counts particle-moves that hit the iteration
+    cap BEFORE the ladder ran; ``stragglers_recovered``/
+    ``stragglers_lost`` split them by ladder outcome (recovered +
+    lost == unfinished_total when the ladder is armed).
+    ``max_conservation_residual`` is the worst relative residual seen.
+    ``overflow_recoveries``/``capacity_escalations`` count partitioned
+    overflow events the recovery ladder absorbed and the host-side
+    capacity rebuilds among them.
+    """
+
+    moves_audited: int = 0
+    anomaly_moves: int = 0
+    anomaly_mask_union: int = 0
+    max_conservation_residual: float = 0.0
+    unfinished_total: int = 0
+    stragglers_recovered: int = 0
+    stragglers_lost: int = 0
+    overflow_recoveries: int = 0
+    capacity_escalations: int = 0
+
+    def as_field_data(self) -> dict:
+        """Scalar FIELD arrays for the VTK writers (float64 — legacy
+        VTK field blocks are typed, and every writer already emits
+        float fields for lost_particles)."""
+        import numpy as np
+
+        return {
+            "sentinel_moves_audited": np.asarray(
+                [float(self.moves_audited)], np.float64
+            ),
+            "sentinel_anomaly_moves": np.asarray(
+                [float(self.anomaly_moves)], np.float64
+            ),
+            "sentinel_anomaly_mask": np.asarray(
+                [float(self.anomaly_mask_union)], np.float64
+            ),
+            "sentinel_max_conservation_residual": np.asarray(
+                [float(self.max_conservation_residual)], np.float64
+            ),
+            "sentinel_stragglers_recovered": np.asarray(
+                [float(self.stragglers_recovered)], np.float64
+            ),
+            "sentinel_stragglers_lost": np.asarray(
+                [float(self.stragglers_lost)], np.float64
+            ),
+            "sentinel_overflow_recoveries": np.asarray(
+                [float(self.overflow_recoveries)], np.float64
+            ),
+        }

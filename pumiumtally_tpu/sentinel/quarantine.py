@@ -1,0 +1,109 @@
+"""Quarantine records for particles nothing could recover.
+
+When the straggler-escalation ladder exhausts its rungs (sentinel
+module docstring) the particle is declared lost: folded into the
+facade's ``lost_particles`` counter AND — when the policy names a
+``quarantine_dir`` — appended to ``quarantine.jsonl`` there, one JSON
+object per particle, so a postmortem can re-inject or bill exactly the
+histories the campaign dropped:
+
+    {"pid": 7, "move": 12, "origin": [...], "dest": [...],
+     "elem": 4311, "weight": 1.0, "reason": "iteration_budget"}
+
+Writes go through ``utils.checkpoint.atomic_append`` (the shared
+temp+fsync+replace durability sequence, append-safe variant), so a
+crash mid-append never tears a record. ``read_quarantine`` skips a
+truncated final line — logs written by older code or foreign appenders
+may still carry one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from pumiumtally_tpu.utils.checkpoint import atomic_append
+
+QUARANTINE_FILENAME = "quarantine.jsonl"
+
+
+def quarantine_path(directory: str) -> str:
+    return os.path.join(directory, QUARANTINE_FILENAME)
+
+
+def build_records(
+    idx,
+    origins,
+    dests,
+    elems,
+    weights,
+    move: int,
+    *,
+    pid_offset: int = 0,
+    reason: str = "iteration_budget",
+) -> List[dict]:
+    """THE quarantine record schema, in one place (every facade's
+    quarantine path builds through here — four independent copies of
+    this loop drifted once already during review). ``idx`` are the
+    residue's caller-order indices; ``origins``/``dests`` [k,3] and
+    ``elems``/``weights`` [k] are aligned with it; ``pid_offset``
+    shifts chunk-local indices into global pid numbering."""
+    return [
+        {
+            "pid": int(pid_offset + idx[i]),
+            "move": int(move),
+            "origin": [float(v) for v in origins[i]],
+            "dest": [float(v) for v in dests[i]],
+            "elem": int(elems[i]),
+            "weight": float(weights[i]),
+            "reason": reason,
+        }
+        for i in range(len(idx))
+    ]
+
+
+def append_quarantine(directory: Optional[str], records: List[dict]) -> None:
+    """Append one JSONL line per record, atomically; no-op with no
+    directory (report-only quarantine accounting) or no records."""
+    if directory is None or not records:
+        return
+    os.makedirs(directory, exist_ok=True)
+    payload = "".join(
+        json.dumps(r, sort_keys=True) + "\n" for r in records
+    ).encode()
+    atomic_append(quarantine_path(directory), payload)
+
+
+def read_quarantine(path: str) -> List[dict]:
+    """Parse a quarantine JSONL file; a torn final line (no newline, or
+    unparseable JSON) is skipped rather than raising — everything
+    before it is intact by the atomic-append contract. A torn line
+    ANYWHERE else is real corruption and raises."""
+    records: List[dict] = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.split(b"\n")
+    # A well-formed file ends with a newline -> last split element is
+    # empty; anything else is a torn tail, tolerated (skipped).
+    body, tail = lines[:-1], lines[-1]
+    for i, line in enumerate(body):
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(body) - 1 and not tail:
+                # Torn final line that still got its newline in before
+                # the crash cut the payload short.
+                break
+            raise ValueError(
+                f"corrupt quarantine file {path!r}: unparseable record "
+                f"at line {i + 1}"
+            )
+    if tail:
+        try:
+            records.append(json.loads(tail))
+        except json.JSONDecodeError:
+            pass  # torn tail: the atomic-append crash window
+    return records

@@ -1,0 +1,132 @@
+"""Per-tally sentinel engine: audit bookkeeping + anomaly dispatch.
+
+One ``SentinelRunner`` per armed tally (built by the facades from
+``TallyConfig.sentinel``, exactly like the stats accumulator and the
+autosave runner). It owns the carried device scalars — the running
+flux sum the conservation delta diffs against and the running worst
+residual — and the cumulative ``HealthReport``. The per-move protocol:
+
+    n_unf, mask = runner.audit(x0, x1, fly, w, done, flux)
+    ... facade runs the straggler ladder if n_unf ...
+    runner.note_outcome(mask, n_unf, recovered, lost, move)
+
+``audit`` performs the move's ONE scalar fetch (the packed audit
+word); every other scalar stays on device and is fetched lazily by
+``health_report``. ``note_outcome`` applies the policy's anomaly
+disposition AFTER the ladder ran, so a fully recovered straggler move
+does not warn about a condition the sentinel just cured (it still
+counts in ``unfinished_total`` — recovery is not silence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from pumiumtally_tpu.sentinel.audit import audit_pack, split_packed
+from pumiumtally_tpu.sentinel.policy import (
+    ANOMALY_UNFINISHED,
+    HealthReport,
+    SentinelAnomalyError,
+    SentinelPolicy,
+    describe_mask,
+)
+
+
+class SentinelRunner:
+    def __init__(self, policy: SentinelPolicy, dtype):
+        from pumiumtally_tpu.sentinel.audit import wide_dtype
+
+        self.policy = policy
+        self.report = HealthReport()
+        wd = wide_dtype()
+        self._rtol = jnp.asarray(policy.resolved_rtol(dtype), wd)
+        self._flux_sum_prev = jnp.asarray(0.0, wd)
+        self._max_resid_dev = jnp.asarray(0.0, wd)
+
+    # -- audit -----------------------------------------------------------
+    def resync(self, flux) -> None:
+        """Re-baseline the conservation delta (checkpoint restore, or
+        any path that rewrites flux outside a move)."""
+        from pumiumtally_tpu.sentinel.audit import wide_dtype
+
+        self._flux_sum_prev = jnp.sum(
+            jnp.asarray(flux).astype(wide_dtype())
+        )
+
+    def audit(self, x0, x1, fly, w, done, flux) -> Tuple[int, int]:
+        """Run the one-program audit over a move's caller-order view;
+        returns the host ``(n_unfinished, anomaly_mask)`` pair (the
+        move's single scalar fetch)."""
+        packed, self._flux_sum_prev, self._max_resid_dev, _resid = (
+            audit_pack(
+                x0, x1, fly, w, done, flux,
+                self._flux_sum_prev, self._max_resid_dev, self._rtol,
+            )
+        )
+        return split_packed(int(packed))
+
+    # -- outcome dispatch -------------------------------------------------
+    def note_outcome(self, mask: int, n_unf: int, recovered: int,
+                     lost: int, move: int) -> None:
+        """Fold one audited move into the report and apply the
+        ``on_anomaly`` disposition. ``recovered``/``lost`` are the
+        ladder's split of ``n_unf`` (0/0 when the ladder is disarmed
+        or nothing straggled)."""
+        self.report.moves_audited += 1
+        self.report.unfinished_total += int(n_unf)
+        self.report.stragglers_recovered += int(recovered)
+        self.report.stragglers_lost += int(lost)
+        effective = mask
+        if (mask & ANOMALY_UNFINISHED) and n_unf and lost == 0 and (
+            recovered == n_unf
+        ):
+            # The ladder recovered every straggler: the unfinished
+            # condition no longer holds on the committed state.
+            effective = mask & ~ANOMALY_UNFINISHED
+        if effective == 0:
+            return
+        self.report.anomaly_moves += 1
+        self.report.anomaly_mask_union |= effective
+        msg = (
+            f"[SENTINEL] move {move}: anomaly "
+            f"{describe_mask(effective)} (mask {effective}); "
+            f"{n_unf} unfinished, {recovered} recovered, {lost} lost"
+        )
+        if self.policy.on_anomaly == "raise":
+            raise SentinelAnomalyError(msg)
+        if self.policy.on_anomaly == "warn":
+            print(msg)
+
+    def note_localization(self, recovered: int, lost: int) -> None:
+        """Localization-walk stragglers (the non-tallying ladder):
+        straggler accounting only — localization is not an audited
+        move, so no anomaly-mask bookkeeping happens here."""
+        self.report.unfinished_total += int(recovered) + int(lost)
+        self.report.stragglers_recovered += int(recovered)
+        self.report.stragglers_lost += int(lost)
+
+    def note_overflow_recovery(self, escalated: bool) -> None:
+        """A partitioned capacity overflow the recovery ladder
+        absorbed (``escalated`` = it needed the host-side capacity
+        rebuild, not just the full-migrate retry)."""
+        self.report.overflow_recoveries += 1
+        if escalated:
+            self.report.capacity_escalations += 1
+
+    # -- report -----------------------------------------------------------
+    def health_report(self) -> HealthReport:
+        """The cumulative report with the lazily carried device maximum
+        folded in (this is the fetch point for the residual)."""
+        return dataclasses.replace(
+            self.report,
+            max_conservation_residual=float(self._max_resid_dev),
+        )
+
+
+def build_runner(policy: Optional[SentinelPolicy], dtype):
+    """Facade hook: a runner when a policy is armed, else None (the
+    sentinel-off path constructs NOTHING — same contract as stats-off)."""
+    return None if policy is None else SentinelRunner(policy, dtype)

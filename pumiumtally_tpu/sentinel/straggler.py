@@ -1,0 +1,175 @@
+"""Straggler escalation for the replicated-mesh facades.
+
+A particle still unfinished when the walk loop exits used to be
+truncated mid-flight with zero signal: its partial track was tallied
+(the s-telescoping commits exactly the traveled prefix) and the rest
+silently dropped. The ladder re-dispatches the residue instead:
+
+1. compact the stragglers into a small padded batch and re-walk them
+   from their committed partial positions toward their original
+   destinations with ``retry_iters_factor``× the iteration budget —
+   the common cure (a forced-tiny ``max_iters``, an adversarial mesh
+   corridor). Because the committed position IS the tallied position,
+   the retry's tally continues the telescoped sum exactly: a recovered
+   particle's flux/position/element match an unconstrained run.
+2. two-tier (bf16 select) engines retry once more against the exact
+   full-precision tables (``table_dtype="float32"`` walks the
+   hi-tier planes the lowp mesh retains) — the cure for the
+   documented tie-class dead ends of the select tier.
+3. whatever remains is declared lost: the caller folds it into
+   ``lost_particles`` and appends quarantine records
+   (sentinel.quarantine).
+
+The compacted batch is padded to the next power of two (floor 8) so
+the retry program compiles O(log n) distinct shapes, not one per
+straggler count; pad slots carry ``fly=0, dest=x`` and retire on the
+first iteration with zero contribution (the walk's own contract).
+Entry point ``straggler_retry`` (config.RETRACE_BUDGETS).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu.ops.walk import walk
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def padded_size(k: int, floor: int = 8) -> int:
+    """Next power of two >= k (>= floor) — the shape-quantization that
+    bounds the retry's jit keys."""
+    m = max(int(floor), 1)
+    while m < k:
+        m *= 2
+    return m
+
+
+@partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))
+def _retry_step(mesh, x, elem, dest, fly, w, flux, k, s_init=None, *,
+                tol, max_iters, walk_kw=()):
+    """Tallied retry walk over one compacted straggler batch. ``k``
+    (traced) marks the real rows; pad rows are forced inert
+    (``fly=0, dest=x`` — the walk's hold contract) so duplicated pad
+    indices can never double-tally. ``s_init`` (with ``x`` = the
+    ORIGINAL phase start) continues the interrupted parametrization —
+    see ops.walk.WalkResult.s."""
+    valid = (jnp.cumsum(jnp.ones_like(elem)) - 1) < k
+    fly_v = jnp.where(valid, fly, 0).astype(jnp.int8)
+    dest_v = jnp.where((fly_v == 1)[:, None], dest, x)
+    r = walk(
+        mesh, x, elem, dest_v, fly_v, w, flux,
+        tally=True, tol=tol, max_iters=max_iters, s_init=s_init,
+        **dict(walk_kw),
+    )
+    return r.x, r.elem, r.done, r.flux, r.s
+
+
+_retry_step = register_entry_point("straggler_retry", _retry_step)
+
+
+def _f32_walk_kw(walk_kw: tuple) -> tuple:
+    """The rung-2 key: the same tuned knobs with the table tier forced
+    to the exact full-precision path (the lowp mesh's hi-tier rows back
+    the f32 gather through the face_* views)."""
+    kw = dict(walk_kw)
+    kw["table_dtype"] = "float32"
+    return tuple(sorted(kw.items()))
+
+
+def run_ladder(
+    mesh,
+    x: jnp.ndarray,
+    elem: jnp.ndarray,
+    dests: jnp.ndarray,
+    fly: jnp.ndarray,
+    w: jnp.ndarray,
+    flux: jnp.ndarray,
+    unfinished: np.ndarray,
+    *,
+    tol: float,
+    base_iters: int,
+    retry_factor: int,
+    walk_kw: tuple = (),
+    two_tier: bool = False,
+    x_start: jnp.ndarray = None,
+    s_init: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, np.ndarray, np.ndarray]:
+    """Run the escalation ladder over the ``unfinished`` host mask.
+
+    Arrays are the facade's committed caller-order state ([cap]-shaped,
+    any padding already inert). With ``x_start``/``s_init`` (the
+    phase's start positions and the walk's final ray coordinates) the
+    retry CONTINUES the exact original parametrization — every
+    remaining crossing computes bit-identically to an uninterrupted
+    walk, so recovered flux is bitwise; without them (the non-tallying
+    localization ladder) rungs restart from the committed partial
+    positions. Returns ``(x, elem, flux, recovered_idx, lost_idx)``
+    with the straggler rows updated in place (scattered back) and the
+    index sets as host int arrays. The caller must only invoke this
+    when ``unfinished.any()``.
+    """
+    idx = np.flatnonzero(unfinished)
+    k = idx.size
+    m = padded_size(k)
+    idx_pad = np.concatenate([idx, np.full(m - k, idx[0], idx.dtype)])
+    idx_dev = jnp.asarray(idx_pad)
+    continuing = x_start is not None and s_init is not None
+    xs = (x_start if continuing else x)[idx_dev]
+    es = elem[idx_dev]
+    ss = s_init[idx_dev] if continuing else None
+    ds, fs, ws = dests[idx_dev], fly[idx_dev], w[idx_dev]
+    k_dev = jnp.asarray(k, jnp.int32)
+
+    # The retry budget: retry_factor x the engine budget, floored at
+    # the mesh-derived safe bound (config.resolved_max_iters'
+    # heuristic) — a deliberately tiny engine max_iters (the truncation
+    # scenario this ladder exists for) must not also starve its own
+    # cure, and the walk's while_loop exits early anyway, so a
+    # generous bound costs nothing at runtime.
+    retry_iters = max(
+        int(base_iters) * int(retry_factor), 64 + int(mesh.nelems)
+    )
+    rungs = [(retry_iters, walk_kw)]
+    if two_tier:
+        rungs.append((retry_iters, _f32_walk_kw(walk_kw)))
+    # Committed outputs accumulate rung by rung: a particle's
+    # (x, elem) are captured by the rung that FINISHES it and never
+    # touched again (a later rung's zero-length re-walk of a finished
+    # particle would not round-trip its position bitwise).
+    x_out, e_out = xs, es
+    done_acc = None
+    for max_iters, kw in rungs:
+        xr, er, done_r, flux, sr = _retry_step(
+            mesh, xs, es, ds, fs, ws, flux, k_dev, ss,
+            tol=tol, max_iters=max_iters, walk_kw=kw,
+        )
+        if done_acc is None:
+            x_out, e_out, done_acc = xr, er, done_r
+        else:
+            newly = done_r & ~done_acc
+            x_out = jnp.where(newly[:, None], xr, x_out)
+            e_out = jnp.where(newly, er, e_out)
+            done_acc = done_acc | done_r
+        if bool(jnp.all(done_acc[:k])):
+            break
+        # Later rungs re-dispatch ONLY the still-unfinished rows
+        # (finished ones are masked inert: fly=0 -> hold) and continue
+        # from the rung's committed progress: element from the rung,
+        # ray coordinate chained in continuation mode, position
+        # restarted from the rung's partial commit otherwise.
+        fs = jnp.where(done_acc, 0, fs).astype(jnp.int8)
+        es = er
+        if continuing:
+            ss = sr  # xs stays the ORIGINAL start: same ray
+        else:
+            xs = xr
+
+    x = x.at[idx_dev[:k]].set(x_out[:k])
+    elem = elem.at[idx_dev[:k]].set(e_out[:k])
+    done_h = np.asarray(done_acc)[:k]
+    return x, elem, flux, idx[done_h], idx[~done_h]

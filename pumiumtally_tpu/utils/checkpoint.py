@@ -217,6 +217,26 @@ def atomic_write(path: str, write_payload, tmp_path: str = None,
     _fsync_dir(os.path.dirname(path) or ".")
 
 
+def atomic_append(path: str, payload: bytes) -> None:
+    """Append-safe variant of ``atomic_write`` for line-oriented logs
+    (the sentinel quarantine JSONL): the existing file content plus the
+    new payload is written to a temp file and renamed over the
+    original through the SAME temp+fsync+replace+dir-fsync sequence —
+    a crash mid-append leaves either the old log or the extended one,
+    never a torn record. O(file) per append by design: quarantine
+    events are rare (an append per *unrecoverable* particle batch),
+    and torn tail records are exactly what a plain ``open(path, "a")``
+    cannot rule out. Readers should still skip a torn final line
+    (``sentinel.quarantine.read_quarantine`` does) for logs written by
+    older code or foreign tools."""
+    try:
+        with open(path, "rb") as f:
+            existing = f.read()
+    except FileNotFoundError:
+        existing = b""
+    atomic_write(path, lambda f: (f.write(existing), f.write(payload)))
+
+
 def _fsync_dir(d: str) -> None:
     """Best-effort directory fsync so the rename itself is durable
     (not just the file bytes) — preemption-safe autosave must survive
@@ -300,6 +320,16 @@ def load_tally_state(tally, path: Union[str, io.IOBase]) -> None:
 def apply_tally_state(tally, z: dict) -> None:
     """Restore an already-loaded checkpoint dict (see
     ``read_checkpoint_arrays``) into ``tally``."""
+    _apply_tally_state_inner(tally, z)
+    # A restore rewrites flux outside any move: re-baseline the
+    # sentinel's conservation delta or the first post-resume move
+    # would audit against the pre-restore sum (false anomaly).
+    sentinel = getattr(tally, "_sentinel", None)
+    if sentinel is not None:
+        sentinel.resync(tally.flux)
+
+
+def _apply_tally_state_inner(tally, z: dict) -> None:
     import jax.numpy as jnp
 
     _check_header(z, tally)
@@ -562,7 +592,21 @@ def _restore_partitioned_engine(eng, x, elem, flux, dtype) -> None:
         cap_per_chip=eng.cap_per_block, state=st,
         partition_method=eng.partition_method,
     )
-    eng._check_overflow(overflow)
+    if bool(overflow):
+        # The checkpointed particle distribution does not fit this
+        # engine's provisioning — e.g. the SAVING engine recovered an
+        # overflow by escalating capacity (round 9), and the restore
+        # target was built with the original factor. Recover the same
+        # way: one demand-sized escalation over the intact pre-migrate
+        # snapshot (the overflow-safe migrate kept it), then retry; a
+        # second failure is a real configuration error and raises.
+        eng._escalate_capacity(eng._needed_capacity_growth())
+        eng.state, overflow = migrate(
+            part_L=eng.part.L, ndev=eng.nparts,
+            cap_per_chip=eng.cap_per_block, state=eng.state,
+            partition_method=eng.partition_method,
+        )
+        eng._check_overflow(overflow)
     eng.state["done"] = jnp.ones((eng.cap,), bool)
     eng.state["pending"] = jnp.full((eng.cap,), -1, jnp.int32)
     eng._n_lost_dev = None

@@ -1,0 +1,132 @@
+"""Subprocess arms for the sentinel/overflow-recovery suite
+(tests/test_sentinel.py) — run as ``python _sentinel_driver.py <arm>
+<workdir>`` so recovery, safety saves, and the poisoned refusal are
+pinned across a REAL process boundary (the acceptance contract:
+overflow recovery + safety save survive outside the test process's
+jax/session state).
+
+Arms:
+  recover — a capacity-overflow workload (all particles concentrated
+            into one part with ~1/4 of the needed slots) that raised
+            RuntimeError at HEAD~ must now complete through the
+            recovery ladder; prints one JSON line with the flux sum,
+            recovery counters, and a parity flux from a generously
+            provisioned engine.
+  poison  — the same workload with the capacity escalation disabled
+            (monkeypatched to a no-op): the ladder must exhaust,
+            write an overflow_safety checkpoint generation through
+            the armed CheckpointPolicy, latch the poisoned flag, and
+            every subsequent facade call must refuse; prints one JSON
+            line describing the refusal.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def _workload():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from pumiumtally_tpu import build_box
+
+    mesh = build_box(1.0, 1.0, 1.0, 4, 4, 4)
+    n = 40
+    rng = np.random.default_rng(7)
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    corner = rng.uniform(0.02, 0.10, (n, 3))
+    return mesh, n, src, corner
+
+
+def _tally(mesh, n, capacity_factor, ckpt_dir=None):
+    from pumiumtally_tpu import (
+        CheckpointPolicy,
+        PartitionedPumiTally,
+        SentinelPolicy,
+        TallyConfig,
+    )
+
+    cfg = TallyConfig(
+        check_found_all=False,
+        capacity_factor=capacity_factor,
+        walk_vmem_max_elems=100,
+        walk_block_kernel="gather",
+        sentinel=SentinelPolicy(),
+        checkpoint=(
+            None if ckpt_dir is None else CheckpointPolicy(
+                dir=ckpt_dir, every_n_batches=None, handle_signals=False,
+            )
+        ),
+    )
+    return PartitionedPumiTally(mesh, n, cfg)
+
+
+def arm_recover(workdir: str) -> None:
+    mesh, n, src, corner = _workload()
+    big = _tally(mesh, n, 9.0)
+    big.CopyInitialPosition(src.reshape(-1).copy())
+    big.MoveToNextLocation(None, corner.reshape(-1).copy())
+
+    t = _tally(mesh, n, 1.05, ckpt_dir=workdir)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, corner.reshape(-1).copy())
+    rep = t.health_report()
+    print(json.dumps({
+        "arm": "recover",
+        "flux_sum": float(np.asarray(t.flux).sum()),
+        "flux_bitwise_vs_big": bool(
+            np.array_equal(np.asarray(t.flux), np.asarray(big.flux))
+        ),
+        "overflow_recoveries": rep.overflow_recoveries,
+        "capacity_escalations": rep.capacity_escalations,
+        "poisoned": t.engine.poisoned,
+    }))
+
+
+def arm_poison(workdir: str) -> None:
+    from pumiumtally_tpu.resilience import GenerationStore
+    from pumiumtally_tpu.sentinel import EnginePoisonedError
+
+    mesh, n, src, corner = _workload()
+    t = _tally(mesh, n, 1.05, ckpt_dir=workdir)
+    # Disable the ladder's escalation rungs: the overflow then has no
+    # cure and must exhaust into the poisoned refusal.
+    t.engine._escalate_capacity = lambda *a, **k: None
+    try:
+        # Either the (near-capacity) localization or the concentrating
+        # move exhausts the cureless ladder — both end poisoned.
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, corner.reshape(-1).copy())
+        raise SystemExit("expected the ladder to exhaust")
+    except RuntimeError as e:
+        ladder_msg = str(e)
+    try:
+        t.MoveToNextLocation(None, corner.reshape(-1).copy())
+        raise SystemExit("expected the poisoned refusal")
+    except EnginePoisonedError as e:
+        refusal_msg = str(e)
+    store = GenerationStore(workdir)
+    gens = store.generations()
+    reasons = []
+    for _gen, path in gens:
+        _payload, _g, meta = store.read_generation(path)
+        reasons.append(meta.get("reason"))
+    print(json.dumps({
+        "arm": "poison",
+        "poisoned": t.engine.poisoned,
+        "ladder_msg_has_poisoned": "poisoned" in ladder_msg,
+        "refusal_msg_has_resume": "resume from checkpoint" in refusal_msg,
+        "generations": len(gens),
+        "save_reasons": reasons,
+    }))
+
+
+def main() -> None:
+    arm, workdir = sys.argv[1], sys.argv[2]
+    {"recover": arm_recover, "poison": arm_poison}[arm](workdir)
+
+
+if __name__ == "__main__":
+    main()

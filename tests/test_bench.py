@@ -232,6 +232,32 @@ def test_resilience_row(bench):
     assert res["compiles"]["timed"] == 0
 
 
+def test_sentinel_row(bench):
+    """The runtime-sentinel component row: schema keys present,
+    bitwise flux parity between the sentinel-on/off arms asserted
+    (the tool raises otherwise), an anomaly-free health report on the
+    healthy workload, a positive fenced per-move audit cost, and the
+    compiles-healthy contract — ``compiles.timed == 0``: audit_pack
+    compiles once in the warmup batches and straggler_retry never
+    compiles on a healthy run."""
+    res = bench.run_sentinel_ab()
+    for key in ("on_moves_per_sec", "off_moves_per_sec",
+                "sentinel_overhead_pct", "audit_ms",
+                "flux_parity_bitwise", "health", "compiles",
+                "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["on_moves_per_sec"] > 0 and res["off_moves_per_sec"] > 0
+    assert res["audit_ms"] > 0
+    health = res["health"]
+    assert health["anomaly_moves"] == 0
+    assert health["stragglers_lost"] == 0
+    assert health["moves_audited"] > 0
+    assert res["compiles"]["timed"] == 0
+    assert res["compiles"].get("audit_pack", 0) == 1
+    assert res["compiles"].get("straggler_retry", 0) == 0
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

@@ -555,3 +555,33 @@ def test_resilience_subsystem_registered_and_pragma_free():
             assert "jaxlint: disable" not in fh.read(), (
                 f"{f}: the resilience modules ship pragma-free"
             )
+
+
+def test_sentinel_subsystem_registered_and_pragma_free():
+    """The runtime-sentinel modules (r9) must be IN the self-check's
+    file set and hold the strongest form of the clean contract: zero
+    violations with zero pragmas — the audit/retry programs are plain
+    jitted reductions and walks with no host syncs reachable from a
+    trace, so there is no excuse for even a justified suppression.
+    The bench-consumed A/B tool is covered the same way (it is in
+    tools/lint_all.py's jaxlint targets)."""
+    import glob
+
+    sen_dir = os.path.join(REPO, "pumiumtally_tpu", "sentinel")
+    files = sorted(glob.glob(os.path.join(sen_dir, "*.py")))
+    names = {os.path.basename(f) for f in files}
+    assert {"__init__.py", "policy.py", "audit.py", "straggler.py",
+            "quarantine.py", "runner.py"} <= names
+    from pumiumtally_tpu.analysis import lint_paths
+
+    ab = os.path.join(REPO, "tools", "exp_sentinel_ab.py")
+    assert lint_paths(files + [ab]) == []
+    for f in files + [ab]:
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the sentinel modules ship pragma-free"
+            )
+    # tools/lint_all.py actually targets the A/B tool (a slip here
+    # would silently drop its CI coverage).
+    with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
+        assert "tools/exp_sentinel_ab.py" in fh.read()

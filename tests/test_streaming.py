@@ -153,25 +153,42 @@ def test_streaming_partitioned_composition():
     )
 
 
-def test_streaming_partitioned_deferred_overflow_raises():
-    """Deferred per-chunk syncs must still surface capacity overflow —
-    at the end of the move, not silently never."""
+def test_streaming_partitioned_deferred_overflow_recovers():
+    """Deferred per-chunk syncs used to surface capacity overflow as a
+    RuntimeError over corrupt state at the end of the move; since
+    round 9 the commit is overflow-safe and the batch sync point runs
+    the recovery ladder instead — the continue-mode move completes
+    with the same flux as a generously provisioned run (scatter-order
+    class) and no stale not-found error."""
     from pumiumtally_tpu import StreamingPartitionedTally
     from pumiumtally_tpu.parallel import make_device_mesh
 
     mesh = build_box(1, 1, 1, 4, 4, 4)
     dm = make_device_mesh(8)
     n = 1600
+    rng = np.random.default_rng(3)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    corner = np.tile([0.03, 0.03, 0.03], (n, 1))
+
+    big = StreamingPartitionedTally(
+        mesh, n, chunk_size=800,
+        config=TallyConfig(device_mesh=dm, capacity_factor=9.0),
+    )
+    big.CopyInitialPosition(src.reshape(-1).copy())
+    big.MoveToNextLocation(None, corner.reshape(-1).copy())
+
     sp = StreamingPartitionedTally(
         mesh, n, chunk_size=800,
         config=TallyConfig(device_mesh=dm, capacity_factor=1.3),
     )
-    rng = np.random.default_rng(3)
-    src = rng.uniform(0.05, 0.95, (n, 3))
     sp.CopyInitialPosition(src.reshape(-1).copy())
-    corner = np.tile([0.03, 0.03, 0.03], (n, 1))
-    with pytest.raises(RuntimeError, match="capacity exceeded"):
-        sp.MoveToNextLocation(None, corner.reshape(-1).copy())
+    sp.MoveToNextLocation(None, corner.reshape(-1).copy())
+    assert sum(e.overflow_recoveries for e in sp.engines) >= 1
+    assert not any(e.poisoned for e in sp.engines)
+    np.testing.assert_allclose(
+        np.asarray(sp.flux), np.asarray(big.flux), rtol=1e-12
+    )
+    np.testing.assert_array_equal(sp.positions, big.positions)
 
 
 def test_streaming_partitioned_lost_warning(capsys):

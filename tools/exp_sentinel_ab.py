@@ -1,0 +1,178 @@
+"""Sentinel overhead A/B + audit-cost capture (r9).
+
+Two arms over the IDENTICAL box workload (same mesh, same seeds, same
+per-batch protocol: one CopyInitialPosition + ``moves`` continue-mode
+moves per source batch):
+
+- ``off``: the default engine (TallyConfig() — no sentinel code runs);
+- ``on``:  ``sentinel=SentinelPolicy()`` — per-move on-device audit
+  lanes (unfinished count + conservation residual + non-finite probe,
+  ONE packed scalar fetch per move) and the straggler ladder armed
+  (which must never fire on this healthy workload).
+
+Reported, non-interactively (one JSON line — bench.py's sentinel row
+consumes it):
+
+- both arms' moves/s and the relative sentinel overhead (the ≤3%
+  budget the round-9 acceptance demands);
+- the fenced per-move audit cost (one jitted reduction + one scalar
+  D2H) measured on the final state;
+- the health report the on-arm accumulated (anomaly_moves must be 0
+  and the worst conservation residual within the policy threshold —
+  a clean workload that trips its own audit is a sentinel bug);
+- the compiles-healthy contract (``compiles.timed == 0``; audit_pack
+  compiles once in the warmup batches, never in the timed window).
+
+Flux parity between the arms is asserted BITWISE before any number is
+reported — the audit only ever reads engine state, and the ladder
+never fires on a healthy run, enforced where the measurement happens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _make_batches(rng, n: int, batches: int, moves: int):
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    segs = [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)]
+    return [(src, segs) for _ in range(batches)]
+
+
+def _drive(t, work):
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+
+def run_ab(
+    n: int = 100_000,
+    div: int = 20,
+    moves: int = 2,
+    batches: int = 8,
+) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import (
+        PumiTally,
+        SentinelPolicy,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(11)
+    work = _make_batches(rng, n, batches, moves)
+
+    t_on = PumiTally(
+        mesh, n,
+        TallyConfig(
+            check_found_all=False, fenced_timing=False,
+            sentinel=SentinelPolicy(),
+        ),
+    )
+    with retrace_guard(raise_on_exceed=False) as guard:
+        _drive(t_on, work[:2])  # warmup: compiles happen here
+        jax.block_until_ready(t_on.flux)
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            t0 = time.perf_counter()
+            _drive(t_on, work[2:])
+            jax.block_until_ready(t_on.flux)
+            on_s = time.perf_counter() - t0
+
+    t_off = PumiTally(
+        mesh, n, TallyConfig(check_found_all=False, fenced_timing=False)
+    )
+    _drive(t_off, work[:2])
+    jax.block_until_ready(t_off.flux)
+    t0 = time.perf_counter()
+    _drive(t_off, work[2:])
+    jax.block_until_ready(t_off.flux)
+    off_s = time.perf_counter() - t0
+
+    # Parity gate: the audit only READS engine state and the ladder
+    # never fires on a healthy workload — the on-arm flux must be
+    # BITWISE the off-arm flux. RuntimeError (not sys.exit): bench.py
+    # wraps this row best-effort.
+    if not bool(jnp.all(t_on.flux == t_off.flux)):
+        raise RuntimeError(
+            "sentinel-on flux diverged bitwise from sentinel-off"
+        )
+
+    report = t_on.health_report()
+    if report.anomaly_moves != 0 or report.stragglers_lost != 0:
+        raise RuntimeError(
+            f"sentinel flagged anomalies on a healthy workload: "
+            f"{report}"
+        )
+
+    # Fenced per-move audit microcost on the final state (one jitted
+    # reduction + the packed-scalar fetch).
+    runner = t_on._sentinel
+    fly = jnp.ones((n,), jnp.int8)
+    w = jnp.ones((n,), t_on.dtype)
+    done = jnp.ones((n,), bool)
+    runner.audit(t_on.x, t_on.x, fly, w, done, t_on.flux)  # warm
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        runner.audit(t_on.x, t_on.x, fly, w, done, t_on.flux)
+    audit_ms = (time.perf_counter() - t0) / reps * 1e3
+    runner.resync(t_on.flux)
+
+    moves_total = n * moves * (batches - 2)
+    return {
+        "row": "sentinel",
+        "on_moves_per_sec": moves_total / on_s,
+        "off_moves_per_sec": moves_total / off_s,
+        "sentinel_overhead_pct": (on_s - off_s) / off_s * 100.0,
+        "audit_ms": audit_ms,
+        "flux_parity_bitwise": True,
+        "health": {
+            "moves_audited": report.moves_audited,
+            "anomaly_moves": report.anomaly_moves,
+            "max_conservation_residual":
+                report.max_conservation_residual,
+            "stragglers_recovered": report.stragglers_recovered,
+            "stragglers_lost": report.stragglers_lost,
+        },
+        # The audit adds exactly ONE entry point (audit_pack), compiled
+        # once per particle shape in warmup — never in the timed
+        # window; the straggler_retry entry point must not compile at
+        # all on a healthy run.
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 8))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves, batches=batches),
+                     default=float))
+
+
+if __name__ == "__main__":
+    main()
