@@ -459,6 +459,25 @@ def run_batch_stats() -> dict | None:
     )
 
 
+def run_scoring() -> dict | None:
+    """Component row: the filtered-scoring subsystem's cost
+    (tools/exp_scoring_ab.py run_ab) — scoring-on (2-bin energy
+    filter x flux/heating/events lanes riding every walk) vs
+    scoring-off rates on the identical corridor workload, with the
+    flux parity AND bin-telescoping gates asserted BITWISE inside the
+    tool, the fenced per-move scoring cost, and the compiles-healthy
+    contract — ``compiles.timed == 0``: the scoring-armed walk and
+    the ``score_bins`` resolution compile once each in warmup.
+    Reduced shape (100k particles) like the other component rows;
+    best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_scoring_ab
+
+    return exp_scoring_ab.run_ab(n=min(N, 100_000), div=MESH_DIV, moves=4)
+
+
 def run_resilience_ab() -> dict | None:
     """Component row: the fault-tolerance subsystem's cost
     (tools/exp_resilience_ab.py run_ab) — autosave-on (one atomic
@@ -907,6 +926,12 @@ def _measure_and_report() -> None:
             batch_stats = run_batch_stats()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# batch-stats A/B failed: {e}", file=sys.stderr)
+    scoring = None
+    if os.environ.get("PUMIUMTALLY_BENCH_SCORING", "1") != "0":
+        try:
+            scoring = run_scoring()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# scoring A/B failed: {e}", file=sys.stderr)
     resilience = None
     if os.environ.get("PUMIUMTALLY_BENCH_RESILIENCE", "1") != "0":
         try:
@@ -1050,6 +1075,11 @@ def _measure_and_report() -> None:
         # lane-update/trigger ms, convergence trace, and the
         # compiles-healthy contract (compiles.timed == 0).
         "batch_stats": batch_stats,
+        # Filtered-scoring subsystem cost: scoring-on vs scoring-off
+        # rates (flux parity AND 2-bin telescoping asserted bitwise
+        # inside the tool), fenced per-move scoring ms, and the
+        # compiles-healthy contract (compiles.timed == 0).
+        "scoring": scoring,
         # Fault-tolerance subsystem cost: autosave-on vs autosave-off
         # rates (flux parity bitwise — autosave only reads state), the
         # fenced per-generation save cost and on-disk size, and the

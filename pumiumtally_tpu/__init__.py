@@ -29,6 +29,7 @@ from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
 from pumiumtally_tpu.api.partitioned import PartitionedPumiTally
 from pumiumtally_tpu.api.streaming import StreamingPartitionedTally, StreamingTally
 from pumiumtally_tpu.stats import BatchStatistics, TriggerResult, TriggerSpec
+from pumiumtally_tpu.scoring import EnergyFilter, ScoringSpec, TimeFilter
 from pumiumtally_tpu.resilience import CheckpointPolicy, resume_latest
 from pumiumtally_tpu.sentinel import (
     EnginePoisonedError,
@@ -52,6 +53,9 @@ __all__ = [
     "BatchStatistics",
     "TriggerResult",
     "TriggerSpec",
+    "EnergyFilter",
+    "ScoringSpec",
+    "TimeFilter",
     "CheckpointPolicy",
     "resume_latest",
     "EnginePoisonedError",

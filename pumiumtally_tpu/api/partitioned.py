@@ -91,8 +91,17 @@ class PartitionedPumiTally(PumiTally):
             partition_method=self.config.resolved_partition_method(),
             table_dtype=self._table_dtype,
             cap_frontier=self.config.cap_frontier,
+            scoring=self.config.scoring,
         )
         self._wire_engine_hooks(self.engine)
+        # Scoring runtime AFTER the engine: the DROP sentinel needs the
+        # engine's PADDED lane-bank size (nparts·L·B·S).
+        self._arm_scoring(
+            bank_size=None if self.config.scoring is None else (
+                self.engine.nparts * self.engine.part.L
+                * self.engine.score_stride
+            )
+        )
         jax.block_until_ready(self.engine.part.table)
         self.tally_times.initialization_time += time.perf_counter() - t0
 
@@ -126,13 +135,18 @@ class PartitionedPumiTally(PumiTally):
         cached as a host int after the first fetch)."""
         return self.engine._n_lost
 
-    def _dispatch_move(self, origins, dests, fly, w):
+    def _dispatch_move(self, origins, dests, fly, w, sbin=None, sfac=None):
         # auto_continue applies here too: when the base class detects an
         # origin echo it hands back the device array that staged last
         # move's destinations (caller order), which this engine treats
-        # exactly like freshly uploaded origins.
+        # exactly like freshly uploaded origins. Scoring operands are
+        # caller-order [n] rows: the engine routes them by pid and
+        # migrates them with their particles.
+        skw = {}
+        if self._scoring is not None:
+            skw = {"sbin_n": sbin, "sfac_n": sfac}
         if self._sentinel is None:
-            return self.engine.move(origins, dests, fly, w)
+            return self.engine.move(origins, dests, fly, w, **skw)
         # Sentinel audit needs the phase-B start in caller order: the
         # staged origins, or (continue mode) the committed positions
         # BEFORE the move (one pid-sort gather; migration permutes
@@ -141,7 +155,7 @@ class PartitionedPumiTally(PumiTally):
             origins if origins is not None
             else self.engine.caller_order_view(("x",))["x"]
         )
-        ok = self.engine.move(origins, dests, fly, w)
+        ok = self.engine.move(origins, dests, fly, w, **skw)
         return self._sentinel_post_move_partitioned(
             self.engine, x0, dests, fly, w, ok
         )
@@ -210,20 +224,25 @@ class PartitionedPumiTally(PumiTally):
         # chip owns a contiguous run of blocks_per_chip parts — pieces
         # stay one-per-CHIP (the reference's rank-aware layout).
         owner = self.engine.part.owner // self.engine.blocks_per_chip
+        from pumiumtally_tpu.io.vtk import merge_cell_data
+
         write_pvtu(
             out,
             np.asarray(self.mesh.coords),
             np.asarray(self.mesh.tet2vert),
             owner,
-            cell_data={
-                "flux": np.asarray(self.normalized_flux()),
-                "volume": np.asarray(self.mesh.volumes),
-                "owner": owner.astype(np.float64),
-                # Same optional statistics payload as the monolithic
-                # writer (flux_mean / rel_err), split per piece like
-                # every other cell array.
-                **self._stats_vtk_cell_data(),
-            },
+            cell_data=merge_cell_data(
+                {
+                    "flux": np.asarray(self.normalized_flux()),
+                    "volume": np.asarray(self.mesh.volumes),
+                    "owner": owner.astype(np.float64),
+                },
+                # Same optional statistics / scoring payloads as the
+                # monolithic writer, split per piece like every other
+                # cell array.
+                self._stats_vtk_cell_data(),
+                self._scoring_vtk_cell_data(),
+            ),
             # Campaign-level leakage accounting, replicated into every
             # piece (field data is global, not per-cell).
             field_data=self._vtk_field_data(),
@@ -241,6 +260,13 @@ class PartitionedPumiTally(PumiTally):
     def flux(self) -> jnp.ndarray:
         """Owned per-chip flux assembled into original element order."""
         return self.engine.flux_original()
+
+    @property
+    def score_bank(self) -> jnp.ndarray:
+        """Owned scoring lanes assembled into the canonical [E·B·S]
+        layout (original element order)."""
+        self._require_scoring()
+        return self.engine.score_original()
 
     @property
     def positions(self) -> np.ndarray:

@@ -38,9 +38,11 @@ from pumiumtally_tpu.api.tally import (
     _localize_step,
     _move_step,
     _move_step_continue,
+    _perf_counter,
     adopt_located,
     check_finite,
     host_positions,
+    host_scalar_field,
     locate_or_committed,
     zero_flying_side_effect,
 )
@@ -97,6 +99,15 @@ class StreamingTally(PumiTally):
         self._flux = [
             jnp.zeros((mesh.nelems,), self.dtype) for _ in range(self.nchunks)
         ]
+        # Scoring (round 10): each chunk accumulates into its OWN lane
+        # bank, exactly like the per-chunk flux (a shared bank would
+        # chain the chunk walks through a data dependency and
+        # serialize the pipeline); banks sum on read.
+        self._arm_scoring()
+        if self._scoring is not None:
+            self._score = [
+                self._scoring.zero_bank() for _ in range(self.nchunks)
+            ]
         jax.block_until_ready(self._x[0])
 
     # -- chunk staging ----------------------------------------------------
@@ -139,12 +150,15 @@ class StreamingTally(PumiTally):
             a = self._owned(a)
         return jnp.asarray(a)
 
-    def _prevalidate_narrow(self, dests_h, origins_h, w_h) -> None:
+    def _prevalidate_narrow(self, dests_h, origins_h, w_h, e_h=None,
+                            t_h=None) -> None:
         """Pre-dispatch working-dtype finite check for MoveToNextLocation
         (see the call site): chunk-at-a-time casts, discarded after the
         check, so a non-finite value anywhere in the batch raises before
-        ANY chunk dispatches. No-op in f64 mode (cast is identity; the
-        raw batch was checked at entry) or with validation off."""
+        ANY chunk dispatches — error messages name the argument
+        (``energy``/``time`` included, round 10). No-op in f64 mode
+        (cast is identity; the raw batch was checked at entry) or with
+        validation off."""
         if (not self.config.validate_inputs
                 or np.dtype(self.dtype) == np.float64):
             return
@@ -159,6 +173,12 @@ class StreamingTally(PumiTally):
             if w_h is not None:
                 check_finite(np.asarray(w_h[lo:hi], dtype=dt),
                              "weights", offset=lo)
+            if e_h is not None:
+                check_finite(np.asarray(e_h[lo:hi], dtype=dt),
+                             "energy", offset=lo)
+            if t_h is not None:
+                check_finite(np.asarray(t_h[lo:hi], dtype=dt),
+                             "time", offset=lo)
 
     def _stage_chunk_vec(self, host, k: int, dtype, fill,
                          what: Optional[str] = None) -> jnp.ndarray:
@@ -207,7 +227,7 @@ class StreamingTally(PumiTally):
 
     def MoveToNextLocation(
         self, particle_origin, particle_destinations, flying=None, weights=None,
-        size: Optional[int] = None,
+        size: Optional[int] = None, energy=None, time=None,
     ):
         # Poisoned check FIRST (same order as the base facade): a
         # corrupt engine must refuse whatever else is wrong.
@@ -216,8 +236,19 @@ class StreamingTally(PumiTally):
             raise RuntimeError(
                 "CopyInitialPosition must be called before MoveToNextLocation"
             )
-        t0 = time.perf_counter()
+        t0 = _perf_counter()
         n = self.num_particles
+        # Scoring-attribute validation BEFORE any staging: shape/
+        # combination errors name the argument (round 10).
+        self._score_args_check(energy, time)
+        e_h = (
+            None if energy is None
+            else host_scalar_field(energy, n, "energy")
+        )
+        t_h = (
+            None if time is None
+            else host_scalar_field(time, n, "time")
+        )
         dests_h = host_positions(particle_destinations, size, n)
         origins_h = (
             None
@@ -228,6 +259,10 @@ class StreamingTally(PumiTally):
             check_finite(dests_h, "destinations")
             if origins_h is not None:
                 check_finite(origins_h, "origins")
+            if e_h is not None:
+                check_finite(e_h, "energy")
+            if t_h is not None:
+                check_finite(t_h, "time")
         # Origin-echo dedup (TallyConfig.auto_continue), chunk-wise: when
         # the caller's origins equal the previous move's destinations
         # bit-for-bit in the working dtype (same rule as the monolithic
@@ -265,7 +300,8 @@ class StreamingTally(PumiTally):
         # per-chunk re-check (what=None). Costs one extra cast pass,
         # only in validate+narrow mode, still chunk-at-a-time (the
         # no-full-batch-copies property holds).
-        self._prevalidate_narrow(dests_h, None if echo else origins_h, w_h)
+        self._prevalidate_narrow(dests_h, None if echo else origins_h, w_h,
+                                 e_h, t_h)
         retain = origins_h is not None and self._retain_echo_snapshots()
         oks = []
         dest_chunks = []
@@ -295,11 +331,31 @@ class StreamingTally(PumiTally):
                 orig = self._last_dests_dev[k]
             else:
                 orig = self._stage_chunk_positions(origins_h, k)
+            sbin = sfac = None
+            if self._scoring is not None:
+                # Chunk-local bin/factor resolution (pad slots never
+                # fly, so their fill value never scores); what=None —
+                # the batch was validated at entry and per chunk by
+                # _prevalidate_narrow.
+                e_c = (
+                    None if e_h is None else self._stage_chunk_vec(
+                        e_h, k, np.dtype(self.dtype), 0.0
+                    )
+                )
+                t_c = (
+                    None if t_h is None else self._stage_chunk_vec(
+                        t_h, k, np.dtype(self.dtype), 0.0
+                    )
+                )
+                sbin, sfac = self._scoring.resolve(
+                    e_c, t_c, self.chunk_size
+                )
             if stash is not None:
                 stash.append(
-                    (k, self._chunk_phase_b_start(k, orig), dest, fly, w)
+                    (k, self._chunk_phase_b_start(k, orig), dest, fly, w,
+                     sbin, sfac)
                 )
-            oks.append(self._chunk_move(k, orig, dest, fly, w))
+            oks.append(self._chunk_move(k, orig, dest, fly, w, sbin, sfac))
         zero_flying_side_effect(flying, n)
         if retain:
             # Snapshot in the working dtype (the compare representation
@@ -326,7 +382,7 @@ class StreamingTally(PumiTally):
             print("ERROR: Not all particles are found. May need more loops in search")
         if self.config.fenced_timing:
             jax.block_until_ready(self._flux)
-        self.tally_times.total_time_to_tally += time.perf_counter() - t0
+        self.tally_times.total_time_to_tally += _perf_counter() - t0
         self._resilience_note_move()  # drain/timer-cadence safe point
 
     def _after_chunk_dispatch(self) -> None:
@@ -364,12 +420,18 @@ class StreamingTally(PumiTally):
         recovered = lost = 0
         if n_unf and pol.straggler_retry:
             new_oks = []
-            for (k, _x0k, dest, fly_k, w_k), done_k in zip(stash, oks):
+            for (k, _x0k, dest, fly_k, w_k, sbin_k, sfac_k), done_k in zip(
+                stash, oks
+            ):
                 unfinished = np.asarray(~done_k & (fly_k == 1))
                 if not unfinished.any():
                     new_oks.append(done_k)
                     continue
-                x2, e2, flux2, rec_idx, lost_idx = run_ladder(
+                sc = None
+                if self._scoring is not None:
+                    sc = (self._scoring.spec.kinds, self._score[k],
+                          sbin_k, sfac_k)
+                x2, e2, flux2, rec_idx, lost_idx, bank2 = run_ladder(
                     self.mesh, self._x[k], self._elem[k], dest, fly_k,
                     w_k, self._flux[k], unfinished,
                     tol=self._tol, base_iters=self._max_iters,
@@ -377,8 +439,11 @@ class StreamingTally(PumiTally):
                     walk_kw=self._walk_kw,
                     two_tier=(self._table_dtype == "bfloat16"),
                     x_start=_x0k, s_init=self._move_s.get(k),
+                    scoring=sc,
                 )
                 self._x[k], self._elem[k], self._flux[k] = x2, e2, flux2
+                if sc is not None:
+                    self._score[k] = bank2
                 recovered += int(rec_idx.size)
                 lost += int(lost_idx.size)
                 if lost_idx.size:
@@ -466,7 +531,7 @@ class StreamingTally(PumiTally):
         pol = self.config.sentinel
         fly = jnp.ones((self.chunk_size,), jnp.int8)
         w0 = jnp.zeros((self.chunk_size,), self.dtype)
-        x2, e2, _flux, rec_idx, lost_idx = run_ladder(
+        x2, e2, _flux, rec_idx, lost_idx, _bank = run_ladder(
             self.mesh, self._x[k], self._elem[k], dest, fly, w0,
             self._flux[k], unfinished,
             tol=self._tol, base_iters=self._max_iters,
@@ -479,11 +544,20 @@ class StreamingTally(PumiTally):
         dn[rec_idx] = True
         return jnp.asarray(dn)
 
-    def _chunk_move(self, k: int, orig, dest, fly, w):
+    def _chunk_move(self, k: int, orig, dest, fly, w, sbin=None,
+                    sfac=None):
         """One tallied move of chunk k (orig None = continue mode);
         returns the chunk's done mask (lazy). The phase-B ray
         coordinates are stashed for the sentinel ladder when one is
-        armed (``_move_s``)."""
+        armed (``_move_s``). ``sbin``/``sfac`` (scoring armed) are the
+        chunk's resolved bin offsets / factor rows; the chunk's OWN
+        lane bank accumulates like its flux."""
+        score_kw = {}
+        if self._scoring is not None:
+            score_kw = {
+                "score_kinds": self._scoring.spec.kinds,
+                "score_ops": (self._score[k], sbin, sfac),
+            }
         if self.device_mesh is not None:
             from pumiumtally_tpu.parallel.sharded import (
                 sharded_move_step,
@@ -491,37 +565,34 @@ class StreamingTally(PumiTally):
             )
 
             if orig is None:
-                (
-                    self._x[k], self._elem[k], self._flux[k], ok, s_b,
-                ) = sharded_move_step_continue(
+                res = sharded_move_step_continue(
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], dest, fly, w, self._flux[k],
                     tol=self._tol, max_iters=self._max_iters,
-                    walk_kw=self._walk_kw,
+                    walk_kw=self._walk_kw, **score_kw,
                 )
             else:
-                (
-                    self._x[k], self._elem[k], self._flux[k], ok, s_b,
-                ) = sharded_move_step(
+                res = sharded_move_step(
                     self.device_mesh, self.mesh, self._x[k],
                     self._elem[k], orig, dest, fly, w, self._flux[k],
                     tol=self._tol, max_iters=self._max_iters,
-                    walk_kw=self._walk_kw,
+                    walk_kw=self._walk_kw, **score_kw,
                 )
         elif orig is None:
-            (
-                self._x[k], self._elem[k], self._flux[k], ok, s_b,
-            ) = _move_step_continue(
+            res = _move_step_continue(
                 self.mesh, self._x[k], self._elem[k], dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
-                walk_kw=self._walk_kw,
+                walk_kw=self._walk_kw, **score_kw,
             )
         else:
-            self._x[k], self._elem[k], self._flux[k], ok, s_b = _move_step(
+            res = _move_step(
                 self.mesh, self._x[k], self._elem[k], orig, dest, fly, w,
                 self._flux[k], tol=self._tol, max_iters=self._max_iters,
-                walk_kw=self._walk_kw,
+                walk_kw=self._walk_kw, **score_kw,
             )
+        self._x[k], self._elem[k], self._flux[k], ok, s_b = res[:5]
+        if self._scoring is not None:
+            self._score[k] = res[5]
         if self._sentinel is not None:
             self._move_s[k] = s_b
         return ok
@@ -540,6 +611,16 @@ class StreamingTally(PumiTally):
         total = self._flux[0]
         for f in self._flux[1:]:
             total = total + f
+        return total
+
+    @property
+    def score_bank(self) -> jnp.ndarray:
+        """Scoring lanes summed over the per-chunk banks (same
+        read-path assembly as ``flux``)."""
+        self._require_scoring()
+        total = self._score[0]
+        for b in self._score[1:]:
+            total = total + b
         return total
 
     @property
@@ -678,7 +759,17 @@ class StreamingPartitionedTally(StreamingTally):
                 block_kernel=self.config.walk_block_kernel,
                 partition_method=self.config.resolved_partition_method(),
                 cap_frontier=self.config.cap_frontier,
+                scoring=self.config.scoring,
             ))
+        # Scoring runtime AFTER the engines: the DROP sentinel needs
+        # the shared partition's PADDED lane-bank size (every chunk
+        # engine shares one partition, hence one bank geometry).
+        self._arm_scoring(
+            bank_size=None if self.config.scoring is None else (
+                self.engines[0].nparts * self.engines[0].part.L
+                * self.engines[0].score_stride
+            )
+        )
         for eng in self.engines:
             # Recovery-ladder wiring (round 9): recoveries report into
             # the sentinel record; a ladder exhaustion safety-saves
@@ -707,11 +798,15 @@ class StreamingPartitionedTally(StreamingTally):
         self._pending_overflows.append((self.engines[k], "localize", ovf))
         return found_all
 
-    def _chunk_move(self, k: int, orig, dest, fly, w):
+    def _chunk_move(self, k: int, orig, dest, fly, w, sbin=None,
+                    sfac=None):
         n = self.engines[k].n
+        skw = {}
+        if self._scoring is not None:
+            skw = {"sbin_n": sbin[:n], "sfac_n": sfac[:n]}
         ok, ovf = self.engines[k].move(
             None if orig is None else orig[:n], dest[:n], fly[:n], w[:n],
-            defer_sync=True,
+            defer_sync=True, **skw,
         )
         self._pending_overflows.append((self.engines[k], "move", ovf))
         return ok
@@ -846,7 +941,9 @@ class StreamingPartitionedTally(StreamingTally):
         recovered = lost = 0
         if n_unf and pol.straggler_retry:
             new_oks = []
-            for (k, x0k, dest, fly_k, w_k), ok in zip(stash, oks):
+            for (k, x0k, dest, fly_k, w_k, _sb, _sf), ok in zip(
+                stash, oks
+            ):
                 eng = self.engines[k]
                 done_k = np.asarray(views[k]["done"])
                 unf = ~done_k & (np.asarray(fly_k)[: eng.n] == 1)
@@ -905,4 +1002,21 @@ class StreamingPartitionedTally(StreamingTally):
         total = self.engines[0].flux_original()
         for e in self.engines[1:]:
             total = total + e.flux_original()
+        return total
+
+    @property
+    def score_bank(self) -> jnp.ndarray:
+        """Scoring lanes summed over the chunk engines' canonical
+        views (same assembly rules as ``flux``, device-groups
+        included)."""
+        self._require_scoring()
+        if self.config.device_groups > 1:
+            stride = self.engines[0].score_stride
+            total = np.zeros(self.mesh.nelems * stride, np.float64)
+            for e in self.engines:
+                total += np.asarray(e.score_original(), np.float64)
+            return jnp.asarray(total, self.dtype)
+        total = self.engines[0].score_original()
+        for e in self.engines[1:]:
+            total = total + e.score_original()
         return total

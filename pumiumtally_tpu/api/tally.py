@@ -101,6 +101,12 @@ _ECHO_MISS_LIMIT = 8
 _ECHO_REARM_PERIOD = 64
 
 
+# time.perf_counter pre-bound at module level: MoveToNextLocation's
+# protocol keyword ``time`` (the scoring TimeFilter attribute, round
+# 10) shadows the module name inside that method body.
+_perf_counter = time.perf_counter
+
+
 def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
     """Validate a caller position buffer → flat [3n] float64 host array
     (shared by the monolithic and streaming facades)."""
@@ -112,6 +118,21 @@ def host_positions(buf, size: Optional[int], n: int) -> np.ndarray:
             f"position buffer has {a.shape[0]} values, need {3 * n}"
         )
     return a[: 3 * n]
+
+
+def host_scalar_field(buf, n: int, what: str) -> np.ndarray:
+    """Validate a caller per-particle scalar buffer (``energy``/
+    ``time``/...) → flat [n] float64 host array, with SHAPE errors that
+    name the argument — without this narrow prevalidation a wrong-shape
+    array surfaces later as an opaque jit broadcast failure (shared by
+    the monolithic and streaming facades; the finite check happens
+    after the working-dtype cast, like positions)."""
+    a = np.asarray(buf, dtype=np.float64).reshape(-1)
+    if a.shape[0] < n:
+        raise ValueError(
+            f"{what} buffer has {a.shape[0]} values, need {n}"
+        )
+    return a[:n]
 
 
 def check_finite(a: np.ndarray, what: str, offset: int = 0) -> None:
@@ -210,7 +231,8 @@ def _localize_step(mesh, x, elem, dest, *, tol, max_iters, walk_kw=()):
 
 
 def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
-                       max_iters, walk_kw=()):
+                       max_iters, walk_kw=(), score_kinds=(),
+                       score_ops=None):
     """Phase-B-only move: transport from the COMMITTED state straight to
     the destinations, tallying. Semantically identical to ``move_step``
     when the caller's origins equal the committed positions — the common
@@ -226,18 +248,32 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
     truncated particle's retry continue the exact original
     parametrization (see ops.walk.WalkResult.s). The walk itself is
     unchanged, so flux/positions/elements stay bitwise identical to
-    pre-mask builds."""
+    pre-mask builds.
+
+    ``score_kinds`` (static) + ``score_ops`` — the traced
+    ``(bank, bin_off, fac)`` bundle from scoring.ScoringRuntime —
+    arm the walk's segment-commit scoring hook (round 10); the return
+    then gains the accumulated bank as a SIXTH element. None
+    (default) leaves the trace byte-identical to pre-scoring builds."""
     is_flying = flying[:, None] == 1
     dest_b = jnp.where(is_flying, dests, x)  # stopped → hold (cpp:100-103)
+    sc = None
+    if score_ops is not None:
+        from pumiumtally_tpu.scoring.binding import ScoreOps
+
+        sc = ScoreOps(score_kinds, *score_ops)
     rb = walk(
         mesh, x, elem, dest_b, flying, weights, flux,
-        tally=True, tol=tol, max_iters=max_iters, **dict(walk_kw),
+        tally=True, tol=tol, max_iters=max_iters, scoring=sc,
+        **dict(walk_kw),
     )
-    return rb.x, rb.elem, rb.flux, rb.done, rb.s
+    if score_ops is None:
+        return rb.x, rb.elem, rb.flux, rb.done, rb.s
+    return rb.x, rb.elem, rb.flux, rb.done, rb.s, rb.score_bank
 
 
 def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
-              max_iters, walk_kw=()):
+              max_iters, walk_kw=(), score_kinds=(), score_ops=None):
     """One full MoveToNextLocation: phase A (relocate, no tally) then
     phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149.
 
@@ -280,27 +316,32 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
         return x_, elem_, elem_ == elem_
     xa, ea, done_a = lax.cond(trivial, skip_a, run_a, (x, elem))
     # Phase B is exactly the continue-mode move from the relocated state.
-    x2, elem2, flux2, done_b, s_b = move_step_continue(
+    res = move_step_continue(
         mesh, xa, ea, dests, flying, weights, flux,
         tol=tol, max_iters=max_iters, walk_kw=walk_kw,
+        score_kinds=score_kinds, score_ops=score_ops,
     )
+    x2, elem2, flux2, done_b, s_b = res[:5]
     # Per-particle mask + phase-B ray coordinate (round 9, see
     # move_step_continue): a particle is "found" only if BOTH phases
     # retired it.
-    return x2, elem2, flux2, done_a & done_b, s_b
+    out = (x2, elem2, flux2, done_a & done_b, s_b)
+    return out if score_ops is None else out + (res[5],)
 
 
 _move_step = register_entry_point(
     "walk",
-    partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))(
-        move_step
-    ),
+    partial(
+        jax.jit,
+        static_argnames=("tol", "max_iters", "walk_kw", "score_kinds"),
+    )(move_step),
 )
 _move_step_continue = register_entry_point(
     "walk_continue",
-    partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))(
-        move_step_continue
-    ),
+    partial(
+        jax.jit,
+        static_argnames=("tol", "max_iters", "walk_kw", "score_kinds"),
+    )(move_step_continue),
 )
 # Rebinds, not bare calls: register_entry_point returns the counting
 # wrapper, and only calls through the wrapper are counted.
@@ -349,6 +390,9 @@ class PumiTally:
         self.x = jnp.broadcast_to(c0, (self._cap, 3))
         self.elem = jnp.zeros((self._cap,), jnp.int32)
         self.flux = jnp.zeros((mesh.nelems,), self.dtype)
+        self._arm_scoring()
+        if self._scoring is not None:
+            self._score_bank = self._scoring.zero_bank()
         jax.block_until_ready(self.x)
         self.tally_times.initialization_time += time.perf_counter() - t0
 
@@ -415,6 +459,17 @@ class PumiTally:
             from pumiumtally_tpu.stats import BatchAccumulator
 
             self._stats = BatchAccumulator(mesh.nelems, self.dtype)
+        # Filtered scoring (TallyConfig.scoring, round 10): the
+        # per-facade ScoringRuntime, or None (default — no scoring
+        # code runs anywhere; every engine is bitwise- and
+        # allocation-identical to a scoring-less build). The facades
+        # arm it AFTER construction fixes their bank geometry
+        # (_arm_scoring): the partitioned ones need the engine's
+        # padded lane-bank size for the DROP sentinel.
+        self._scoring = None
+        self._score_bank = None
+        self._score_stats = None
+        self._last_score_ops = None  # staged (sbin, sfac) for the ladder
         # Cumulative leakage counter (the rolled part of
         # ``lost_particles``; partitioned facades add the open batch's
         # current lost count on read).
@@ -646,16 +701,25 @@ class PumiTally:
             from pumiumtally_tpu.sentinel.straggler import run_ladder
 
             unfinished = np.asarray(~done & (fly == 1))
-            x2, e2, flux2, rec_idx, lost_idx = run_ladder(
+            sc = None
+            if self._scoring is not None:
+                # The retry must CONTINUE the scoring lanes too: same
+                # bins/factors the interrupted move staged.
+                sbin, sfac = self._last_score_ops
+                sc = (self._scoring.spec.kinds, self._score_bank,
+                      sbin, sfac)
+            x2, e2, flux2, rec_idx, lost_idx, bank2 = run_ladder(
                 self.mesh, self.x, self.elem, dests, fly, w, self.flux,
                 unfinished,
                 tol=self._tol, base_iters=self._max_iters,
                 retry_factor=pol.retry_iters_factor,
                 walk_kw=self._walk_kw,
                 two_tier=(self._table_dtype == "bfloat16"),
-                x_start=x_start, s_init=s_b,
+                x_start=x_start, s_init=s_b, scoring=sc,
             )
             self.x, self.elem, self.flux = x2, e2, flux2
+            if sc is not None:
+                self._score_bank = bank2
             recovered, lost = int(rec_idx.size), int(lost_idx.size)
             if lost:
                 self._lost_total += lost
@@ -689,7 +753,7 @@ class PumiTally:
         pol = self.config.sentinel
         fly = jnp.ones((self._cap,), jnp.int8)
         w0 = jnp.zeros((self._cap,), self.dtype)
-        x2, e2, _flux, rec_idx, lost_idx = run_ladder(
+        x2, e2, _flux, rec_idx, lost_idx, _bank = run_ladder(
             self.mesh, self.x, self.elem, dest, fly, w0, self.flux,
             unfinished,
             tol=self._tol, base_iters=self._max_iters,
@@ -758,10 +822,13 @@ class PumiTally:
         new one at the current flux. No-op with stats disabled."""
         if self._stats is not None:
             self._stats.close(self.flux, reopen=True)
+            self._score_stats_close(reopen=True)
 
     def _stats_note_move(self) -> None:
         if self._stats is not None:
             self._stats.note_move()
+        if self._score_stats is not None:
+            self._score_stats.note_move()
 
     def _require_stats(self):
         if self._stats is None:
@@ -792,6 +859,7 @@ class PumiTally:
         a no-op (an empty batch is not a sample)."""
         stats = self._require_stats()
         stats.close(self.flux, reopen=True)
+        self._score_stats_close(reopen=True)
         self._resilience_roll_batch()  # explicit close = batch close
         spec = (
             trigger if trigger is not None
@@ -810,6 +878,7 @@ class PumiTally:
         (or ``close_batch``) opens one."""
         stats = self._require_stats()
         stats.close(self.flux, reopen=False)
+        self._score_stats_close(reopen=False)
         self._resilience_roll_batch()  # final close = batch close
         return self.batch_statistics()
 
@@ -827,6 +896,135 @@ class PumiTally:
             num_batches=stats.num_batches,
             elapsed_seconds=self._stats_elapsed(),
         )
+
+    # -- filtered scoring (TallyConfig.scoring, round 10) -----------------
+    def _arm_scoring(self, bank_size: Optional[int] = None) -> None:
+        """Build the ScoringRuntime once the facade's bank geometry is
+        known (``bank_size`` = the padded lane-bank length for the
+        partitioned facades; None = the canonical ``E·B·S``). Also
+        arms the optional scoring statistics lanes — with
+        ``batch_stats=True`` the scoring bank gets its own per-batch
+        (sum, sum-of-squares) accumulator, exactly like the flux lane
+        ("stats accumulators gain scoring lanes")."""
+        if self.config.scoring is None:
+            return
+        from pumiumtally_tpu.scoring.binding import ScoringRuntime
+
+        self._scoring = ScoringRuntime(
+            self.config.scoring, self.mesh.nelems, self.dtype,
+            bank_size=bank_size,
+        )
+        if self.config.batch_stats:
+            from pumiumtally_tpu.stats import BatchAccumulator
+
+            self._score_stats = BatchAccumulator(
+                self.mesh.nelems * self._scoring.stride, self.dtype
+            )
+
+    def _require_scoring(self):
+        if self._scoring is None:
+            raise RuntimeError(
+                "filtered scoring is disabled; construct the tally "
+                "with TallyConfig(scoring=scoring.ScoringSpec(...))"
+            )
+        return self._scoring
+
+    @property
+    def score_bank(self) -> jnp.ndarray:
+        """The accumulated scoring lanes, CANONICAL flattened
+        ``[E·B·S]`` layout in original element order (partitioned /
+        streaming facades override the assembly)."""
+        self._require_scoring()
+        return self._score_bank
+
+    def score_array(self) -> jnp.ndarray:
+        """The scoring lanes as ``[E, n_bins, n_scores]`` — bin-major,
+        score-minor; ``spec.scores`` names the last axis."""
+        rt = self._require_scoring()
+        return self.score_bank.reshape(
+            self.mesh.nelems, rt.spec.n_bins, rt.spec.n_scores
+        )
+
+    def score_statistics(self):
+        """Per-batch ``BatchStatistics`` over the FLATTENED scoring
+        lanes (mean/std dev/rel err per lane) — needs both
+        ``batch_stats=True`` and a scoring spec."""
+        from pumiumtally_tpu.stats import BatchStatistics
+
+        self._require_scoring()
+        self._require_stats()
+        return BatchStatistics(
+            flux_sum=self._score_stats.flux_sum,
+            flux_sq_sum=self._score_stats.flux_sq_sum,
+            num_batches=self._score_stats.num_batches,
+            elapsed_seconds=self._stats_elapsed(),
+        )
+
+    def _score_args_check(self, energy, time_) -> None:
+        """Refuse mismatched energy=/time= combinations with errors
+        that NAME the argument (narrow prevalidation — the alternative
+        is an opaque trace failure deep in the move)."""
+        if self._scoring is None:
+            if energy is not None or time_ is not None:
+                raise ValueError(
+                    "energy=/time= require TallyConfig(scoring="
+                    "scoring.ScoringSpec(...)); this tally has no "
+                    "scoring lanes to bin them into"
+                )
+            return
+        spec = self._scoring.spec
+        if spec.needs_energy and energy is None:
+            raise ValueError(
+                "this ScoringSpec bins (or scales) by energy: pass "
+                "energy= (one value per particle) to MoveToNextLocation"
+            )
+        if spec.needs_time and time_ is None:
+            raise ValueError(
+                "this ScoringSpec bins by time: pass time= (one value "
+                "per particle) to MoveToNextLocation"
+            )
+        if energy is not None and not spec.needs_energy:
+            raise ValueError(
+                "energy= passed but this ScoringSpec has no "
+                "EnergyFilter and no energy-scaled score"
+            )
+        if time_ is not None and not spec.needs_time:
+            raise ValueError(
+                "time= passed but this ScoringSpec has no TimeFilter"
+            )
+
+    def _stage_move_attr(self, buf, what: str) -> Optional[jnp.ndarray]:
+        """Validate + stage one per-particle move attribute ([n],
+        working dtype): shape errors name the argument
+        (host_scalar_field) and the finite check runs AFTER the
+        working-dtype cast, like every other staged buffer."""
+        if buf is None:
+            return None
+        a = host_scalar_field(buf, self.num_particles, what)
+        cast = np.asarray(a, dtype=np.dtype(self.dtype))
+        if self.config.validate_inputs:
+            check_finite(cast, what)
+        return jnp.asarray(self._owned(cast))
+
+    def _resolve_move_scoring(self, energy, time_):
+        """Per-move scoring operands: validate, stage, resolve bins +
+        factor rows (jitted ``score_bins``), pad to capacity. Returns
+        (sbin, sfac) or (None, None) with scoring off."""
+        self._score_args_check(energy, time_)
+        if self._scoring is None:
+            return None, None
+        e_dev = self._stage_move_attr(energy, "energy")
+        t_dev = self._stage_move_attr(time_, "time")
+        # Unpadded [n] rows: _dispatch_move pads to engine capacity
+        # where the other staged inputs do (the partitioned facades
+        # size their engines to n and consume these as-is).
+        return self._scoring.resolve(e_dev, t_dev, self.num_particles)
+
+    def _score_stats_close(self, reopen: bool) -> None:
+        """Scoring arm of every batch-close hook (no-op unless both
+        stats and scoring are armed)."""
+        if self._score_stats is not None:
+            self._score_stats.close(self.score_bank, reopen=reopen)
 
     # -- the three-call protocol ----------------------------------------
     def CopyInitialPosition(self, init_particle_positions, size: Optional[int] = None):
@@ -926,7 +1124,7 @@ class PumiTally:
 
     def MoveToNextLocation(
         self, particle_origin, particle_destinations, flying=None, weights=None,
-        size: Optional[int] = None,
+        size: Optional[int] = None, energy=None, time=None,
     ):
         """Two-phase tracked move (reference PumiTally.h:87-89).
 
@@ -944,6 +1142,10 @@ class PumiTally:
         - ``flying=None``: every particle is in flight; no host-side
           zeroing side effect is performed (there is no buffer to zero).
         - ``weights=None``: unit weights.
+        - ``energy=`` / ``time=`` (round 10): per-particle attribute
+          arrays ([n] values) for a ``TallyConfig.scoring`` spec's
+          filters and energy-scaled scores — validated with errors
+          that name the argument, refused when no scoring is armed.
         """
         # Poisoned check FIRST: a corrupt engine must refuse with the
         # resume-from-checkpoint error whatever else is wrong.
@@ -953,7 +1155,7 @@ class PumiTally:
                 "CopyInitialPosition must be called before MoveToNextLocation "
                 "(reference invariant, PumiTallyImpl.cpp:437-438)"
             )
-        t0 = time.perf_counter()
+        t0 = _perf_counter()
         dests_host = self._as_positions_host(particle_destinations, size,
                                              what="destinations")
         # Convert the origins buffer at most once (a list / non-f64
@@ -1030,9 +1232,16 @@ class PumiTally:
                 if self.config.auto_continue:
                     self._last_weights_host = w_cast
                     self._last_weights_dev = w
+        # Scoring validation/staging BEFORE the flying-zeroing side
+        # effect: a refused move (missing/invalid energy=/time=) must
+        # leave the caller's buffers untouched — zeroing first would
+        # make the caller's corrected retry silently transport nothing
+        # (the streaming facade validates before any staging for the
+        # same reason).
+        sbin, sfac = self._resolve_move_scoring(energy, time)
         zero_flying_side_effect(flying, n)
 
-        found_all = self._dispatch_move(origins, dests, fly, w)
+        found_all = self._dispatch_move(origins, dests, fly, w, sbin, sfac)
         if origins_h is not None and self._retain_echo_snapshots():
             # _as_positions_host returned OWNED memory, so these
             # snapshots cannot alias a caller buffer that gets recycled
@@ -1052,12 +1261,14 @@ class PumiTally:
             print("ERROR: Not all particles are found. May need more loops in search")
         if self.config.fenced_timing:
             jax.block_until_ready(self.flux)
-        self.tally_times.total_time_to_tally += time.perf_counter() - t0
+        self.tally_times.total_time_to_tally += _perf_counter() - t0
         self._resilience_note_move()  # drain/timer-cadence safe point
 
-    def _dispatch_move(self, origins, dests, fly, w):
+    def _dispatch_move(self, origins, dests, fly, w, sbin=None, sfac=None):
         """Run one tallied move from [n]-shaped staged inputs
-        (origins may be None: continue mode). Returns found_all (lazy)."""
+        (origins may be None: continue mode; ``sbin``/``sfac`` are the
+        capacity-padded scoring operands, None with scoring off).
+        Returns found_all (lazy)."""
         dests = self._pad_particles(dests, self.x)
         fly = self._pad_particles(fly, jnp.zeros((self._cap,), jnp.int8))
         w = self._pad_particles(w, jnp.zeros((self._cap,), self.dtype))
@@ -1067,6 +1278,22 @@ class PumiTally:
             # Pre-move committed state + staged inputs: everything
             # intersection_points() needs to replay this move.
             self._xpoint_stash = (self.x, self.elem, origins, dests, fly)
+        score_kw = {}
+        if self._scoring is not None:
+            sbin = self._pad_particles(
+                sbin, jnp.zeros((self._cap,), jnp.int32)
+            )
+            sfac = self._pad_particles(
+                sfac,
+                jnp.zeros(
+                    (self._cap, self._scoring.spec.n_scores), self.dtype
+                ),
+            )
+            self._last_score_ops = (sbin, sfac)  # the ladder's operands
+            score_kw = {
+                "score_kinds": self._scoring.spec.kinds,
+                "score_ops": (self._score_bank, sbin, sfac),
+            }
         if self.device_mesh is not None:
             from pumiumtally_tpu.parallel.sharded import (
                 sharded_move_step,
@@ -1092,10 +1319,13 @@ class PumiTally:
                 _move_step, self.mesh, self.x, self.elem, origins, dests
             )
         x_prev = self.x  # phase-B start in continue mode (sentinel)
-        self.x, self.elem, self.flux, done, s_b = step(
+        res = step(
             fly, w, self.flux, tol=self._tol, max_iters=self._max_iters,
-            walk_kw=self._walk_kw,
+            walk_kw=self._walk_kw, **score_kw,
         )
+        self.x, self.elem, self.flux, done, s_b = res[:5]
+        if self._scoring is not None:
+            self._score_bank = res[5]
         if self._sentinel is None:
             return done
         return self._sentinel_post_move(
@@ -1116,6 +1346,19 @@ class PumiTally:
             self.batch_statistics(), np.asarray(self.mesh.volumes)
         )
 
+    def _scoring_vtk_cell_data(self) -> dict:
+        """Optional ``<score>_bin<k>`` cell arrays (round 10): every
+        lane volume-normalized like flux, empty with scoring off so the
+        default payload stays byte-identical."""
+        if self._scoring is None:
+            return {}
+        from pumiumtally_tpu.scoring.binding import score_cell_data
+
+        return score_cell_data(
+            self._scoring.spec, np.asarray(self.score_bank),
+            np.asarray(self.mesh.volumes),
+        )
+
     def WriteTallyResults(self, filename: Optional[str] = None) -> None:
         """Normalize flux by element volume and write VTK
         (reference PumiTallyImpl.cpp:151-157, 382-416). With batch
@@ -1126,15 +1369,20 @@ class PumiTally:
         t0 = time.perf_counter()
         out = filename or self.config.output_filename
         normalized = self.normalized_flux()
+        from pumiumtally_tpu.io.vtk import merge_cell_data
+
         write_vtk(
             out,
             np.asarray(self.mesh.coords),
             np.asarray(self.mesh.tet2vert),
-            cell_data={
-                "flux": np.asarray(normalized),
-                "volume": np.asarray(self.mesh.volumes),
-                **self._stats_vtk_cell_data(),
-            },
+            cell_data=merge_cell_data(
+                {
+                    "flux": np.asarray(normalized),
+                    "volume": np.asarray(self.mesh.volumes),
+                },
+                self._stats_vtk_cell_data(),
+                self._scoring_vtk_cell_data(),
+            ),
             field_data=self._vtk_field_data(),
         )
         self.tally_times.vtk_file_write_time += time.perf_counter() - t0

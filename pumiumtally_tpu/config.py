@@ -112,6 +112,17 @@ RETRACE_BUDGETS: dict = {
     #   Measured tier-1 max 3 + 1 headroom.
     "audit_pack": 3,
     "straggler_retry": 4,
+    # Filtered-scoring bin resolution (r10, pumiumtally_tpu/scoring
+    # "score_bins"): ONE cache key per (n, dtype, spec static key) —
+    # filter-edge VALUES are operands, never keys. Measured tier-1 max
+    # 2 (the overflow-policy tests drive a drop and a clamp spec in
+    # one test; facade suites add chunk-shape keys) + 1 headroom
+    # (PUMIUMTALLY_RETRACE_RECORD over tests/test_scoring.py). The
+    # scoring-ARMED walk/phase variants ride the existing walk_*/
+    # cascade_phase budgets: re-measured maxima (cascade_phase 6,
+    # sharded_walk_continue 4, walk_continue 2) all stay inside the
+    # r9 budgets, so none were raised.
+    "score_bins": 3,
     # The resilience subsystem (r8, pumiumtally_tpu/resilience) is
     # deliberately host-side only — checkpoint serialization, autosave
     # cadence, signal handling, and fault injection never touch the
@@ -367,6 +378,25 @@ class TallyConfig:
     # evaluates when the caller passes none; None = close_batch
     # returns no verdict unless handed a spec.
     batch_stats_trigger: Optional[Any] = None
+    # Filtered multi-score tallies (pumiumtally_tpu/scoring,
+    # docs/DESIGN.md "Filtered scoring"): a scoring.ScoringSpec arms
+    # energy/time-binned scoring lanes on this tally — every facade
+    # then allocates a flattened [E·B·S] on-device lane bank, accepts
+    # per-particle ``energy=``/``time=`` arrays on MoveToNextLocation
+    # (validated with argument-naming errors), resolves each
+    # particle's bin ONCE per move (branchless searchsorted over edge
+    # arrays passed as device operands — edge VALUES never enter any
+    # jit cache key), and scatters every score's segment contribution
+    # at the same commit point as the flux lane with ONE fused
+    # deterministic scatter-add. ``score_bank`` / ``score_array()``
+    # read the lanes; WriteTallyResults adds ``<score>_bin<k>`` cell
+    # arrays; checkpoints round-trip the bank; with batch_stats=True
+    # the bank gets its own per-batch statistics lanes. None
+    # (default): no scoring code runs anywhere and every engine is
+    # bitwise- and allocation-identical to a scoring-less build;
+    # scoring-ON leaves flux/positions/elements bitwise too (the flux
+    # scatter is untouched) — both pinned in tests/test_scoring.py.
+    scoring: Optional[Any] = None
     # Fault tolerance (pumiumtally_tpu/resilience, docs/DESIGN.md
     # "Fault tolerance"): a resilience.CheckpointPolicy arms autosave +
     # graceful drain on this tally. Every facade then writes atomic,
@@ -487,6 +517,14 @@ class TallyConfig:
                 raise ValueError(
                     "batch_stats_trigger needs batch_stats=True (no "
                     "lanes are accumulated otherwise)"
+                )
+        if self.scoring is not None:
+            from pumiumtally_tpu.scoring.binding import ScoringSpec
+
+            if not isinstance(self.scoring, ScoringSpec):
+                raise ValueError(
+                    "scoring must be a scoring.ScoringSpec, "
+                    f"got {self.scoring!r}"
                 )
         if self.checkpoint is not None:
             from pumiumtally_tpu.resilience.policy import CheckpointPolicy

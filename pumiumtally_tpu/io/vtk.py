@@ -78,6 +78,32 @@ def stats_cell_data(stats, volumes: np.ndarray) -> Dict[str, np.ndarray]:
     return out
 
 
+def merge_cell_data(*groups: Optional[Dict[str, np.ndarray]]) -> dict:
+    """Merge cell-data dicts for the tally writers, REFUSING name
+    collisions: a plain ``{**a, **b}`` silently lets a later group
+    shadow an earlier one — a scoring lane named ``flux_mean`` would
+    overwrite the statistics array and the file would carry wrong data
+    under a trusted name. Raises a ValueError naming the colliding
+    array and both groups' positions instead. ``None`` groups are
+    skipped."""
+    out: dict = {}
+    owner: dict = {}
+    for gi, g in enumerate(groups):
+        if not g:
+            continue
+        for name, arr in g.items():
+            if name in out:
+                raise ValueError(
+                    f"cell-data array name collision: {name!r} appears "
+                    f"in payload group {owner[name]} and again in group "
+                    f"{gi} — rename one (a silent overwrite would ship "
+                    "wrong data under a trusted array name)"
+                )
+            out[name] = arr
+            owner[name] = gi
+    return out
+
+
 def health_field_data(report) -> Dict[str, np.ndarray]:
     """Sentinel health report as VTK FIELD arrays (``report`` is a
     ``pumiumtally_tpu.sentinel.HealthReport``): campaign-level scalars

@@ -174,7 +174,27 @@ def _resolve_perm_mode(mode: str) -> str:
     return mode
 
 
-def fused_tally_body(step, cond_every: int, tally: bool):
+def score_pair(kinds, stride: int, elem, bin_off, fac, contrib, crossed):
+    """One crossing group's scoring-lane update pair (docs/DESIGN.md
+    "Filtered scoring"): ``sidx[w, k] = elem·stride + bin_off + k``
+    (row-major ravel → particle-major, score-minor — the deterministic
+    order every engine shares) and per-score values from the two
+    segment bases: ``contrib`` — bitwise the flux lane's own
+    ``(s_new − s)·eff_w`` update, so the track scores' factor-1 lanes
+    telescope to the flux lane exactly — and ``crossed`` — the
+    committed-face-crossing indicator for count scores. DROP-sentinel
+    ``bin_off`` rows index past the bank and die in the scatter's
+    ``mode="drop"``. Shared by the replicated walk and the partitioned
+    ``walk_local`` so the lane semantics cannot drift between
+    engines."""
+    base = elem.astype(jnp.int32) * stride + bin_off
+    sidx = base[:, None] + jnp.arange(len(kinds), dtype=jnp.int32)[None, :]
+    cols = [contrib if k == "track" else crossed for k in kinds]
+    return sidx, jnp.stack(cols, axis=1) * fac
+
+
+def fused_tally_body(step, cond_every: int, tally: bool,
+                     scoring: bool = False):
     """Build a while_loop body running ``cond_every`` masked iterations
     of ``step`` per step, fusing the group's (element, contribution)
     tally pairs into ONE scatter-add of k·W values (fewer scatter
@@ -185,23 +205,41 @@ def fused_tally_body(step, cond_every: int, tally: bool):
     Shared by the replicated walk below and the partitioned
     ``walk_local`` (parallel/partition.py) so the unroll/fuse machinery
     cannot drift between engines.
+
+    ``scoring=True`` (implies ``tally``): pairs carry two extra
+    entries ``(sidx [W,S], sval [W,S])`` from ``score_pair`` and the
+    state ends ``(*core, flux, bank)`` — the group's lane updates fuse
+    into ONE separate deterministic scatter-add on the bank. The flux
+    scatter below is byte-for-byte the scoring-off code path, which is
+    what keeps scoring-on flux bitwise.
     """
     cond_every = max(1, int(cond_every))
 
     def body(state):
-        *core, flux = state
+        if scoring:
+            *core, flux, bank = state
+        else:
+            *core, flux = state
         pairs = []
         for _ in range(cond_every):
             core, pair = step(*core)
             pairs.append(pair)
         if tally:
             if cond_every == 1:
-                e0, c0 = pairs[0]
+                e0, c0 = pairs[0][0], pairs[0][1]
                 flux = flux.at[e0].add(c0, mode="drop")
             else:
                 flux = flux.at[jnp.concatenate([p[0] for p in pairs])].add(
                     jnp.concatenate([p[1] for p in pairs]), mode="drop"
                 )
+        if scoring:
+            if cond_every == 1:
+                si, sv = pairs[0][2].reshape(-1), pairs[0][3].reshape(-1)
+            else:
+                si = jnp.concatenate([p[2].reshape(-1) for p in pairs])
+                sv = jnp.concatenate([p[3].reshape(-1) for p in pairs])
+            bank = bank.at[si].add(sv, mode="drop")
+            return (*core, flux, bank)
         return (*core, flux)
 
     return body
@@ -233,6 +271,9 @@ class WalkResult(NamedTuple):
     flux: jnp.ndarray  # [E] accumulated track-length tally
     iters: jnp.ndarray  # [] int32: iterations taken
     s: jnp.ndarray = None  # [N] final ray coordinate (see above)
+    # Accumulated scoring lane bank (round 10) — None unless the walk
+    # was handed a ``scoring`` operand bundle.
+    score_bank: jnp.ndarray = None
 
 
 def _gather_walk_row(mesh: TetMesh, elem: jnp.ndarray):
@@ -403,6 +444,7 @@ def walk(
     partition_method: str = "rank",
     table_dtype: str = "auto",
     s_init: jnp.ndarray = None,
+    scoring=None,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -454,8 +496,27 @@ def walk(
     the documented benign divergence; conservation is preserved by the
     s-telescoping tally). "auto" resolves via
     ``PUMIUMTALLY_WALK_TABLE_DTYPE`` (default "float32").
+
+    ``scoring`` (a ``scoring.ScoreOps``, tally walks only) arms the
+    segment-commit scoring hook: at every crossing the group's lane
+    updates (``score_pair``) fuse into ONE deterministic scatter-add
+    on the bundle's flattened bank, returned as
+    ``WalkResult.score_bank``. The per-particle bin offsets and
+    factor rows are walk-constant: the cascade never permutes them —
+    each stage gathers its window's rows ONCE through the carried
+    original-slot index. The flux scatter is the byte-identical
+    scoring-off path, so scoring-on flux stays bitwise.
     """
     lo_select = _resolve_lo_select(mesh, table_dtype)
+    score_on = scoring is not None
+    if score_on and not tally:
+        raise ValueError("scoring requires a tallying walk (tally=True)")
+    if score_on:
+        s_kinds = scoring.kinds
+        # Lanes per element — static (shape-derived) like every other
+        # piece of the hook; the bank length is a multiple of [E].
+        s_stride = scoring.bank.shape[0] // flux.shape[0]
+        sb0, sf0, bank = scoring.bin_off, scoring.fac, scoring.bank
     fdtype = x.dtype
     n_total = x.shape[0]
     one = jnp.asarray(1.0, fdtype)
@@ -480,11 +541,14 @@ def walk(
     # change, ~1 ulp).
     eff_w = jnp.where(in_flight.astype(bool), weight * seg_len, 0.0)
 
-    def advance(s, elem, dest, d0, eff_w, done):
+    def advance(s, elem, dest, d0, eff_w, done, sb=None, sf=None):
         """One lock-step iteration over a (possibly windowed) batch.
         Returns the advanced (s, elem, done) plus this crossing's tally
         pair (element indexed, contribution) — the caller decides how
-        to scatter (per iteration, or fused across an unrolled group)."""
+        to scatter (per iteration, or fused across an unrolled group).
+        ``sb``/``sf`` (scoring only) are the window's walk-constant bin
+        offsets / factor rows; the pair then carries the lane update
+        too (``score_pair``)."""
         active = ~done
         s_new, reached, next_elem, hit_boundary = _advance_geometry(
             mesh, s, elem, dest, d0, tol, one, lo_select
@@ -492,7 +556,14 @@ def walk(
 
         if tally:
             contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
-            pair = (elem, contrib)
+            if score_on:
+                crossed = (active & ~reached).astype(contrib.dtype)
+                sidx, sval = score_pair(
+                    s_kinds, s_stride, elem, sb, sf, contrib, crossed
+                )
+                pair = (elem, contrib, sidx, sval)
+            else:
+                pair = (elem, contrib)
         else:
             pair = None
 
@@ -503,11 +574,17 @@ def walk(
         return (s, elem, done), pair
 
     def step(it, s, elem, dest, d0, eff_w, done):
-        (s, elem, done), pair = advance(s, elem, dest, d0, eff_w, done)
+        (s, elem, done), pair = advance(
+            s, elem, dest, d0, eff_w, done,
+            sb0 if score_on else None, sf0 if score_on else None,
+        )
         return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
     it0 = jnp.asarray(0, jnp.int32)
-    body = fused_tally_body(step, cond_every, tally)
+    # NOTE: valid for FULL-batch loops only when scoring is armed (the
+    # step closes over the full-size sb0/sf0); the cascade builds
+    # per-stage bodies with windowed scoring rows instead.
+    body = fused_tally_body(step, cond_every, tally, scoring=score_on)
 
     def final_x(s, done, exited, dest, d0):
         """Materialize positions from the ray coordinate — exactly once.
@@ -525,20 +602,29 @@ def walk(
             f"got {partition_method!r}"
         )
     min_window = max(1, min_window)
+    # Position of ``done`` from the END of the loop state: the bank
+    # rides after flux when scoring is armed.
+    dpos = -3 if score_on else -2
     if not compact or n_total <= min_window:
         def cond(state):
             it = state[0]
-            done = state[-2]
+            done = state[dpos]
             return (it < max_iters) & jnp.any(~done)
 
-        it, s, elem, _, _, _, done, flux = lax.while_loop(
-            cond, body,
-            (it0, s0, elem, dest, d0, eff_w, done0, flux),
-        )
+        carry = (it0, s0, elem, dest, d0, eff_w, done0, flux)
+        if score_on:
+            it, s, elem, _, _, _, done, flux, bank = lax.while_loop(
+                cond, body, carry + (bank,)
+            )
+        else:
+            it, s, elem, _, _, _, done, flux = lax.while_loop(
+                cond, body, carry
+            )
         exited = done & (s < one)
         return WalkResult(
             x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
             exited=exited, flux=flux, iters=it, s=s,
+            score_bank=bank if score_on else None,
         )
 
     # ---- compaction cascade --------------------------------------------
@@ -580,34 +666,66 @@ def walk(
 
         def cond(state, _nxt=nxt):
             it = state[0]
-            done = state[-2]
+            done = state[dpos]
             n_active = jnp.sum(~done)
             return (it < max_iters) & (n_active > _nxt)
 
         head = lambda a, _w=w: a[:_w]  # noqa: E731 — static-size window slice
+        if score_on:
+            # Scoring rows are walk-constant and NEVER permuted: gather
+            # this stage's window ONCE through the carried original-slot
+            # index (loop-invariant closures — one [w] + [w,S] gather
+            # per stage, zero changes to the permutation machinery).
+            sb_w, sf_w = sb0[head(idx)], sf0[head(idx)]
+        else:
+            sb_w = sf_w = None
         if mode == "indirect":
             idx_w = head(idx)
 
-            def step_ind(it, s, elem, done, _idx=idx_w):
+            def step_ind(it, s, elem, done, _idx=idx_w, _sb=sb_w,
+                         _sf=sf_w):
                 r = ray[_idx]
                 (s, elem, done), pair = advance(
-                    s, elem, r[:, 0:3], r[:, 3:6], r[:, 6], done
+                    s, elem, r[:, 0:3], r[:, 3:6], r[:, 6], done, _sb, _sf
                 )
                 return (it + 1, s, elem, done), pair
 
-            body_i = fused_tally_body(step_ind, cond_every, tally)
-            it, sh, eh, dh, flux = lax.while_loop(
-                cond, body_i, (it, head(s), head(elem), head(done), flux)
-            )
+            body_i = fused_tally_body(step_ind, cond_every, tally,
+                                      scoring=score_on)
+            carry_i = (it, head(s), head(elem), head(done), flux)
+            if score_on:
+                it, sh, eh, dh, flux, bank = lax.while_loop(
+                    cond, body_i, carry_i + (bank,)
+                )
+            else:
+                it, sh, eh, dh, flux = lax.while_loop(
+                    cond, body_i, carry_i
+                )
         else:
-            it, sh, eh, _, _, _, dh, flux = lax.while_loop(
-                cond,
-                body,
-                (
-                    it, head(s), head(elem), head(dest), head(d0),
-                    head(eff_w), head(done), flux,
-                ),
+            if score_on:
+                def step_w(it, s, elem, dest, d0, eff_w, done, _sb=sb_w,
+                           _sf=sf_w):
+                    (s, elem, done), pair = advance(
+                        s, elem, dest, d0, eff_w, done, _sb, _sf
+                    )
+                    return (it + 1, s, elem, dest, d0, eff_w, done), pair
+
+                body_w = fused_tally_body(step_w, cond_every, tally,
+                                          scoring=True)
+            else:
+                body_w = body
+            carry_w = (
+                it, head(s), head(elem), head(dest), head(d0),
+                head(eff_w), head(done), flux,
             )
+            if score_on:
+                it, sh, eh, _, _, _, dh, flux, bank = lax.while_loop(
+                    cond, body_w, carry_w + (bank,)
+                )
+            else:
+                it, sh, eh, _, _, _, dh, flux = lax.while_loop(
+                    cond, body_w, carry_w
+                )
         # NOTE: these window write-backs deliberately use concatenate,
         # NOT `a.at[:w].set(a[:w][perm])`: the in-place form miscompiles
         # under jit when the dynamic-update-slice is fused with a gather
@@ -678,6 +796,7 @@ def walk(
         return WalkResult(
             x=final_x(s, done, exited, dest, d0), elem=elem, done=done,
             exited=exited, flux=flux, iters=it, s=s,
+            score_bank=bank if score_on else None,
         )
     exited = done & (s < one)
     x_fin = final_x(s, done, exited, dest, d0)
@@ -685,6 +804,7 @@ def walk(
         x=unpermute(x_fin, idx), elem=unpermute(elem, idx),
         done=unpermute(done, idx), exited=unpermute(exited, idx),
         flux=flux, iters=it, s=unpermute(s, idx),
+        score_bank=bank if score_on else None,
     )
 
 

@@ -85,9 +85,11 @@ from pumiumtally_tpu.ops.walk import (
     COND_EVERY_DEFAULT,
     fused_tally_body,
     refine_face_hi,
+    score_pair,
     select_faces_lo,
 )
 from pumiumtally_tpu.parallel.sharded import _axis_name, shard_map_check_kwargs
+from pumiumtally_tpu.scoring.binding import ScoreOps
 from pumiumtally_tpu.utils.profiling import phase_timer, register_entry_point
 
 try:  # jax >= 0.8
@@ -350,10 +352,20 @@ def walk_local(
     min_window: int = _MIN_WINDOW,
     partition_method: str = "rank",
     table_hi: Optional[jnp.ndarray] = None,  # [L*4,5] two-tier refinement
+    scoring=None,  # ScoreOps over THIS slice's [L·B·S] bank
 ) -> Tuple[jnp.ndarray, ...]:
     """Ownership-restricted walk: like ops.walk.walk but pauses (sets
     ``pending = glid``) when the exit face's neighbor lives on another
-    chip. Returns (x, lelem, done, exited, pending, flux, iters).
+    chip. Returns (x, lelem, done, exited, pending, flux, iters), plus
+    the accumulated score bank as an EIGHTH element when ``scoring``
+    (a ``scoring.ScoreOps`` whose bank/bin_off/fac are this slice's
+    local views) is armed — the same segment-commit hook as the
+    replicated walk (ops/walk.py ``score_pair``), scattering each
+    crossing group's lane updates in ONE fused deterministic
+    scatter-add beside the untouched flux scatter. A pause at a
+    partition face commits its crossing (and its event count) exactly
+    once: the resumed round continues from the pause point and never
+    recounts it, so binned scores agree with the replicated engines.
 
     ``table_hi`` switches to the two-tier path (docs/PERF_NOTES.md
     "Table precision tiers"): ``table`` is then the bf16 SELECT tier
@@ -417,8 +429,16 @@ def walk_local(
     # Derived from an input so it carries the varying type under
     # shard_map (a literal constant would break the while carry).
     pending0 = (lelem - lelem) - 1
+    score_on = scoring is not None
+    if score_on:
+        if not tally:
+            raise ValueError("scoring requires a tallying walk")
+        s_kinds = scoring.kinds
+        s_stride = scoring.bank.shape[0] // flux.shape[0]
+        sb0, sf0, bank = scoring.bin_off, scoring.fac, scoring.bank
 
-    def advance(s, lelem, done, exited, pending, x0_c, d0_c, eff_c):
+    def advance(s, lelem, done, exited, pending, x0_c, d0_c, eff_c,
+                sb=None, sf=None):
         active = ~done & (pending < 0)
         if table_hi is not None:
             # Two-tier: bf16 select + full-precision single-face refine
@@ -462,7 +482,17 @@ def walk_local(
 
         if tally:
             contrib = jnp.where(active, (s_new - s) * eff_c, 0.0)
-            pair = (lelem, contrib)
+            if score_on:
+                # A committed crossing here includes the partition-face
+                # pause (goes_remote): the face IS crossed, exactly
+                # once across the migration.
+                crossed = (active & ~reached).astype(contrib.dtype)
+                sidx, sval = score_pair(
+                    s_kinds, s_stride, lelem, sb, sf, contrib, crossed
+                )
+                pair = (lelem, contrib, sidx, sval)
+            else:
+                pair = (lelem, contrib)
         else:
             pair = None
 
@@ -479,21 +509,30 @@ def walk_local(
     min_window = max(1, int(min_window))  # same clamp as ops/walk.py
     if not compact or n_slots <= min_window:
         def step(it, s, lelem, done, exited, pending):
-            st, pair = advance(s, lelem, done, exited, pending, x0, d0, eff_w)
+            st, pair = advance(
+                s, lelem, done, exited, pending, x0, d0, eff_w,
+                sb0 if score_on else None, sf0 if score_on else None,
+            )
             return (it + 1, *st), pair
 
         def cond(state):
-            it, _s, _lelem, done, _exited, pending, _flux = state
+            it, done, pending = state[0], state[3], state[5]
             return (it < max_iters) & jnp.any(~done & (pending < 0))
 
-        body = fused_tally_body(step, cond_every, tally)
-        it, s, lelem, done, exited, pending, flux = lax.while_loop(
-            cond, body, (it0, s0, lelem, done, exited, pending0, flux)
-        )
+        body = fused_tally_body(step, cond_every, tally, scoring=score_on)
+        carry = (it0, s0, lelem, done, exited, pending0, flux)
+        if score_on:
+            (it, s, lelem, done, exited, pending, flux,
+             bank) = lax.while_loop(cond, body, carry + (bank,))
+        else:
+            it, s, lelem, done, exited, pending, flux = lax.while_loop(
+                cond, body, carry
+            )
         x_fin = jnp.where(
             (done & ~exited)[:, None], dest, x0 + s[:, None] * d0
         )
-        return x_fin, lelem, done, exited, pending, flux, it
+        out = (x_fin, lelem, done, exited, pending, flux, it)
+        return out + (bank,) if score_on else out
 
     # ---- compaction cascade (indirect form) ----------------------------
     # NOTE: deliberately parallel to ops/walk.py's cascade (different
@@ -517,11 +556,17 @@ def walk_local(
         nxt_w = windows[si + 1] if si + 1 < len(windows) else 0
         head = lambda a, _w=w: a[:_w]  # noqa: E731 — static window slice
         idx_w = head(idx)
+        # Scoring rows are per-slot walk-constants like the ray pack:
+        # never permuted, gathered once per stage through idx.
+        sb_w = sb0[idx_w] if score_on else None
+        sf_w = sf0[idx_w] if score_on else None
 
-        def step(it, s, lelem, done, exited, pending, _idx=idx_w):
+        def step(it, s, lelem, done, exited, pending, _idx=idx_w,
+                 _sb=sb_w, _sf=sf_w):
             r = ray[_idx]
             st, pair = advance(
-                s, lelem, done, exited, pending, r[:, 0:3], r[:, 3:6], r[:, 6]
+                s, lelem, done, exited, pending, r[:, 0:3], r[:, 3:6],
+                r[:, 6], _sb, _sf,
             )
             return (it + 1, *st), pair
 
@@ -530,12 +575,17 @@ def walk_local(
             done, pending = state[3], state[5]
             return (it < max_iters) & (jnp.sum(~done & (pending < 0)) > _nxt)
 
-        body = fused_tally_body(step, cond_every, tally)
-        it, sh, eh, dh, exh, ph, flux = lax.while_loop(
-            cond, body,
-            (it, head(s), head(lelem), head(done), head(exited),
-             head(pending), flux),
-        )
+        body = fused_tally_body(step, cond_every, tally, scoring=score_on)
+        carry = (it, head(s), head(lelem), head(done), head(exited),
+                 head(pending), flux)
+        if score_on:
+            it, sh, eh, dh, exh, ph, flux, bank = lax.while_loop(
+                cond, body, carry + (bank,)
+            )
+        else:
+            it, sh, eh, dh, exh, ph, flux = lax.while_loop(
+                cond, body, carry
+            )
         # Window write-backs use concatenate, not at[].set — see the
         # miscompile note in ops/walk.py's cascade.
         if nxt_w:
@@ -573,7 +623,8 @@ def walk_local(
     done, exited = unpermute(done, idx), unpermute(exited, idx)
     pending = unpermute(pending, idx)
     x_fin = jnp.where((done & ~exited)[:, None], dest, x0 + s[:, None] * d0)
-    return x_fin, lelem, done, exited, pending, flux, it
+    out = (x_fin, lelem, done, exited, pending, flux, it)
+    return out + (bank,) if score_on else out
 
 
 # ---------------------------------------------------------------------------
@@ -1083,6 +1134,7 @@ class PartitionedEngine:
         partition_method: str = "rank",
         table_dtype: str = "float32",
         cap_frontier: Optional[int] = None,
+        scoring=None,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -1111,7 +1163,18 @@ class PartitionedEngine:
         keeps the full-capacity migrate every round (historical
         behavior, bitwise-stable); ``0`` forces the fallback every
         round (testing hook). Localization and revival always use the
-        full migrate — their frontier IS the whole population."""
+        full migrate — their frontier IS the whole population.
+
+        ``scoring`` (a ``scoring.ScoringSpec``, round 10): arms the
+        binned scoring lanes — the engine grows an OWNED padded lane
+        bank (``score_padded [nparts·L·B·S]``, sharded like
+        ``flux_padded``) plus two migrating per-slot state rows
+        (``sbin``/``sfac``, staged per move via ``move(sbin_n=,
+        sfac_n=)``), and every tallying phase threads the bank through
+        its round programs. The VMEM one-hot block kernel has no
+        scoring lowering; a scoring-armed engine routes blocked walks
+        through the gather kernel (same reroute as the bf16 tier) and
+        never uses the vmem walk."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -1147,7 +1210,15 @@ class PartitionedEngine:
             )
         self.table_dtype = table_dtype
         block_kernel = resolve_block_kernel(block_kernel, table_dtype)
+        if scoring is not None and block_kernel == "vmem":
+            # No scoring lowering in the one-hot Pallas kernel — same
+            # reroute as the bf16 tier (resolve_block_kernel).
+            block_kernel = "gather"
         self.block_kernel = block_kernel
+        self.scoring = scoring
+        self.score_stride = (
+            0 if scoring is None else scoring.n_bins * scoring.n_scores
+        )
         self.partition_method = partition_method
         if block_kernel == "vmem":
             from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
@@ -1215,11 +1286,12 @@ class PartitionedEngine:
         self.cond_every = int(cond_every)
         self.min_window = int(min_window)
         self.use_vmem_walk = (
-            block_kernel == "vmem"  # bf16 tiers never resolve to vmem
+            block_kernel == "vmem"  # bf16/scoring never resolve to vmem
             and vmem_walk_max_elems is not None
             and self.part.L <= int(vmem_walk_max_elems)
             and self.part.adj_int is None
             and not self.two_tier
+            and scoring is None
         )
         if self.blocks_per_chip > 1 and not self.use_vmem_walk and (
             block_kernel != "gather"
@@ -1235,6 +1307,13 @@ class PartitionedEngine:
             )
         dtype = mesh.coords.dtype
         self.flux_padded = jnp.zeros((self.nparts * self.part.L,), dtype)
+        # Owned scoring lane bank, padded-glid layout like flux_padded:
+        # rows [g·B·S, (g+1)·B·S) hold padded element g's lanes.
+        self.score_padded = (
+            None if scoring is None else jnp.zeros(
+                (self.nparts * self.part.L * self.score_stride,), dtype
+            )
+        )
         # Initial layout: particle pid occupies slot pid (chips get
         # contiguous pid blocks); lelem/pending meaningless until the
         # first localization.
@@ -1273,6 +1352,16 @@ class PartitionedEngine:
             "fly": jnp.zeros((self.cap,), jnp.int8),
             "w": jnp.zeros((self.cap,), dtype),
         }
+        if scoring is not None:
+            # Per-slot scoring rows MIGRATE with the particle (the
+            # generic state-dict pack/scatter machinery handles them):
+            # the bin-lane offset staged each move and the [S] factor
+            # row. Scoring-off engines never carry these keys — the
+            # bitwise/allocation-free off contract.
+            self.state["sbin"] = jnp.zeros((self.cap,), jnp.int32)
+            self.state["sfac"] = jnp.zeros(
+                (self.cap, scoring.n_scores), dtype
+            )
 
     # -- staged input routing -------------------------------------------
     def _by_pid(self, arr_n: jnp.ndarray, fill) -> jnp.ndarray:
@@ -1540,6 +1629,22 @@ class PartitionedEngine:
             )
         return self._n_lost_cache
 
+    def _fx_in(self, tally: bool):
+        """The phase programs' ``fx`` operand: the owned flux, bundled
+        with the scoring lane bank as one pytree on scoring-armed
+        TALLY phases (non-tally phases never score, like the flux
+        lane)."""
+        if tally and self.scoring is not None:
+            return (self.flux_padded, self.score_padded)
+        return self.flux_padded
+
+    def _fx_commit(self, tally: bool, fx) -> None:
+        """Commit a phase's ``fx`` result (see ``_fx_in``)."""
+        if tally and self.scoring is not None:
+            self.flux_padded, self.score_padded = fx
+        else:
+            self.flux_padded = fx
+
     def _make_round_sm(self, tally: bool, max_iters: Optional[int] = None):
         """The shard_mapped one-walk-round kernel, shared by the fused
         phase program (``_phase_program``) and the profiled per-round
@@ -1559,6 +1664,11 @@ class PartitionedEngine:
         has_adj = self.part.adj_int is not None
         pmethod = self.partition_method
         two_tier = self.two_tier
+        # Scoring rides TALLYING rounds only (phase A / localization
+        # walks never score — exactly like the flux lane).
+        score_on = tally and self.scoring is not None
+        s_kinds = self.scoring.kinds if score_on else None
+        stride = self.score_stride
 
         use_vmem = self.use_vmem_walk
 
@@ -1566,7 +1676,12 @@ class PartitionedEngine:
             rest = list(rest)
             adj = rest.pop(0) if has_adj else None
             hi = rest.pop(0) if two_tier else None
-            x, lelem, dest, fly, w, done, exited, flux, n_act = rest
+            if score_on:
+                (x, lelem, dest, fly, w, done, exited, sbin, sfac, flux,
+                 bank, n_act) = rest
+            else:
+                x, lelem, dest, fly, w, done, exited, flux, n_act = rest
+                sbin = sfac = bank = None
             if use_vmem:
                 from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
 
@@ -1626,7 +1741,12 @@ class PartitionedEngine:
                     return c[0] < n_occ
 
                 def blk_body(c):
-                    t, x, lelem, done, exited, pending, flux, n_act = c
+                    if score_on:
+                        (t, x, lelem, done, exited, pending, flux, bank,
+                         n_act) = c
+                    else:
+                        t, x, lelem, done, exited, pending, flux, n_act = c
+                        bank = None
                     b = order[t]
                     po = b * cb  # first particle slot of block b
                     eo = b * part_L  # first element row of block b
@@ -1641,7 +1761,22 @@ class PartitionedEngine:
                         )
                         if two_tier else None
                     )
-                    xb, leb, dnb, exb, pb, fxb, _ = walk_local(
+                    sc_b = None
+                    if score_on:
+                        # Block b's lane rows sit at [eo·stride,
+                        # (eo+part_L)·stride) — the same contiguous-
+                        # per-element layout as the flux slice.
+                        sc_b = ScoreOps(
+                            s_kinds,
+                            lax.dynamic_slice(
+                                bank, (eo * stride,), (part_L * stride,)
+                            ),
+                            lax.dynamic_slice(sbin, (po,), (cb,)),
+                            lax.dynamic_slice(
+                                sfac, (po, z), (cb, len(s_kinds))
+                            ),
+                        )
+                    res = walk_local(
                         lax.dynamic_slice(
                             table, (eo, z), (part_L, twidth)
                         ),
@@ -1656,12 +1791,17 @@ class PartitionedEngine:
                         tally=tally, tol=tol, max_iters=max_iters,
                         adj_int=a_b, cond_every=cond_every,
                         min_window=min_window, partition_method=pmethod,
-                        table_hi=hi_b,
+                        table_hi=hi_b, scoring=sc_b,
                     )
+                    xb, leb, dnb, exb, pb, fxb = res[:6]
+                    if score_on:
+                        bank = lax.dynamic_update_slice(
+                            bank, res[7], (eo * stride,)
+                        )
                     n_act = n_act.at[b].set(
                         jnp.sum(~dnb, dtype=jnp.int32)
                     )
-                    return (
+                    out = (
                         t + 1,
                         lax.dynamic_update_slice(x, xb, (po, z)),
                         lax.dynamic_update_slice(lelem, leb, (po,)),
@@ -1669,23 +1809,38 @@ class PartitionedEngine:
                         lax.dynamic_update_slice(exited, exb, (po,)),
                         lax.dynamic_update_slice(pending, pb, (po,)),
                         lax.dynamic_update_slice(flux, fxb, (eo,)),
-                        n_act,
                     )
+                    if score_on:
+                        return out + (bank, n_act)
+                    return out + (n_act,)
 
-                (_, x, lelem, done, exited, pending, flux,
-                 n_act) = lax.while_loop(
-                    blk_cond, blk_body,
-                    (jnp.sum(jnp.zeros_like(lelem)), x, lelem, done,
-                     exited, pending, flux, n_act),
-                )
+                carry0 = (jnp.sum(jnp.zeros_like(lelem)), x, lelem, done,
+                          exited, pending, flux)
+                if score_on:
+                    (_, x, lelem, done, exited, pending, flux, bank,
+                     n_act) = lax.while_loop(
+                        blk_cond, blk_body, carry0 + (bank, n_act)
+                    )
+                else:
+                    (_, x, lelem, done, exited, pending, flux,
+                     n_act) = lax.while_loop(
+                        blk_cond, blk_body, carry0 + (n_act,)
+                    )
                 n_disp = n_occ
             else:
-                x, lelem, done, exited, pending, flux, _ = walk_local(
+                sc = (
+                    ScoreOps(s_kinds, bank, sbin, sfac) if score_on
+                    else None
+                )
+                res = walk_local(
                     table, x, lelem, dest, fly, w, done, exited, flux,
                     tally=tally, tol=tol, max_iters=max_iters, adj_int=adj,
                     cond_every=cond_every, min_window=min_window,
-                    partition_method=pmethod, table_hi=hi,
+                    partition_method=pmethod, table_hi=hi, scoring=sc,
                 )
+                x, lelem, done, exited, pending, flux = res[:6]
+                if score_on:
+                    bank = res[7]
                 # One whole-partition walk per chip per round.
                 n_disp = jnp.sum(jnp.zeros_like(lelem)) + 1
                 n_act = jnp.sum(~done, dtype=jnp.int32).reshape(1)
@@ -1697,10 +1852,14 @@ class PartitionedEngine:
             n_pending = lax.psum(jnp.sum(pending >= 0), ax)
             n_not_done = lax.psum(jnp.sum(~done), ax)
             n_disp = lax.psum(n_disp, ax)
+            if score_on:
+                return (x, lelem, done, exited, pending, flux, bank,
+                        n_act, n_pending, n_not_done, n_disp)
             return (x, lelem, done, exited, pending, flux, n_act,
                     n_pending, n_not_done, n_disp)
 
-        n_in = 10 + int(has_adj) + int(two_tier)
+        n_in = 10 + int(has_adj) + int(two_tier) + 3 * int(score_on)
+        n_out_pp = 8 if score_on else 7
         # Output-type checking (check_vma on current jax, check_rep on
         # jax 0.4.x — shard_map_check_kwargs resolves the spelling) is
         # disabled ONLY for the vmem-kernel variant: the pallas
@@ -1714,7 +1873,7 @@ class PartitionedEngine:
             round_kernel,
             mesh=self.device_mesh,
             in_specs=(pp,) * n_in,
-            out_specs=(pp,) * 7 + (P(), P(), P()),
+            out_specs=(pp,) * n_out_pp + (P(), P(), P()),
             **shard_map_check_kwargs(not use_vmem),
         )
 
@@ -1733,6 +1892,7 @@ class PartitionedEngine:
                 self.max_iters, self.tol, self.cond_every,
                 self.min_window, self.use_vmem_walk, self.blocks_per_chip,
                 self.partition_method, self.cap_frontier, id(self.part),
+                None if self.scoring is None else self.scoring.static_key(),
                 variant)
 
     def _phase_program(self, tally: bool, resume: bool = False,
@@ -1764,6 +1924,11 @@ class PartitionedEngine:
         has_adj = self.part.adj_int is not None
         pmethod = self.partition_method
         two_tier = self.two_tier
+        # Scoring-armed TALLY phases carry ``fx`` as a (flux, bank)
+        # pytree through the round loop — the loop/cond/overflow
+        # machinery below is pytree-agnostic, so the scoring-off trace
+        # is byte-identical to pre-scoring builds.
+        score_on = tally and self.scoring is not None
         cap_frontier = (
             None if force_full_migrate else self.cap_frontier
         )
@@ -1788,17 +1953,28 @@ class PartitionedEngine:
                 )
 
             def call_round(st, fx, n_act):
+                if score_on:
+                    flux_i, bank_i = fx
+                    tail = (st["sbin"], st["sfac"], flux_i, bank_i, n_act)
+                else:
+                    tail = (fx, n_act)
                 args = (
                     (table,)
                     + ((adj,) if has_adj else ())
                     + ((hi,) if two_tier else ())
                     + (
                         st["x"], st["lelem"], st["dest"], st["fly"],
-                        st["w"], st["done"], st["exited"], fx, n_act,
+                        st["w"], st["done"], st["exited"],
                     )
+                    + tail
                 )
-                (x, lelem, done, exited, pending, fx, n_act, n_p, n_nd,
-                 n_disp) = round_sm(*args)
+                if score_on:
+                    (x, lelem, done, exited, pending, flux_o, bank_o,
+                     n_act, n_p, n_nd, n_disp) = round_sm(*args)
+                    fx = (flux_o, bank_o)
+                else:
+                    (x, lelem, done, exited, pending, fx, n_act, n_p,
+                     n_nd, n_disp) = round_sm(*args)
                 return (
                     dict(st, x=x, lelem=lelem, done=done, exited=exited,
                          pending=pending),
@@ -1876,22 +2052,34 @@ class PartitionedEngine:
             return self._jit_cache[key]
         has_adj = self.part.adj_int is not None
         two_tier = self.two_tier
+        score_on = tally and self.scoring is not None
         round_sm = self._make_round_sm(tally)
 
         @jax.jit
         def round1(table, adj, hi, state, flux, n_act):
             st = dict(state)
+            if score_on:
+                flux_i, bank_i = flux
+                tail = (st["sbin"], st["sfac"], flux_i, bank_i, n_act)
+            else:
+                tail = (flux, n_act)
             args = (
                 (table,)
                 + ((adj,) if has_adj else ())
                 + ((hi,) if two_tier else ())
                 + (
                     st["x"], st["lelem"], st["dest"], st["fly"],
-                    st["w"], st["done"], st["exited"], flux, n_act,
+                    st["w"], st["done"], st["exited"],
                 )
+                + tail
             )
-            (x, lelem, done, exited, pending, fx, n_act, n_p, n_nd,
-             n_disp) = round_sm(*args)
+            if score_on:
+                (x, lelem, done, exited, pending, flux_o, bank_o, n_act,
+                 n_p, n_nd, n_disp) = round_sm(*args)
+                fx = (flux_o, bank_o)
+            else:
+                (x, lelem, done, exited, pending, fx, n_act, n_p, n_nd,
+                 n_disp) = round_sm(*args)
             return (
                 dict(st, x=x, lelem=lelem, done=done, exited=exited,
                      pending=pending),
@@ -1972,7 +2160,7 @@ class PartitionedEngine:
             n_act = occp(st, zero_counts, zero_counts, zero_counts,
                          jnp.asarray(True))
             jax.block_until_ready(n_act)
-        fx = self.flux_padded
+        fx = self._fx_in(tally)
         tbl, adj, hi = self.part.table, self.part.adj_int, self.part.table_hi
         with phase_timer(prof, "walk_s"):
             st, fx, n_act, n_p, n_nd, disp = round1(
@@ -1996,7 +2184,7 @@ class PartitionedEngine:
                 # commit it and hand the phase to the recovery ladder
                 # (mirrors _run_phase's fused path).
                 self.state = st
-                self.flux_padded = fx
+                self._fx_commit(tally, fx)
                 return self._recover_overflow(tally)
             if self.cap_frontier is not None and bool(fb):
                 prof.fallback_rounds += 1
@@ -2016,7 +2204,7 @@ class PartitionedEngine:
         with phase_timer(prof, "bookkeeping_s"):
             found_all = (int(n_nd) == 0) and n_p_h == 0
             self.state = st
-            self.flux_padded = fx
+            self._fx_commit(tally, fx)
             # The last_* diagnostics keep their "most recent phase"
             # contract under profiling: the profiled driver already
             # holds the host values, so the caches are set directly
@@ -2063,7 +2251,7 @@ class PartitionedEngine:
         phase = self._phase_program(tally)
         st, fx, found_all, ovf, rounds, disp, fmax, fsum, nfb = phase(
             self.part.table, self.part.adj_int, self.part.table_hi,
-            self.state, self.flux_padded,
+            self.state, self._fx_in(tally),
         )
         # Lazy device scalars; fetched only if someone reads the
         # last_walk_rounds / last_block_dispatches diagnostics (a fetch
@@ -2081,14 +2269,14 @@ class PartitionedEngine:
         self._last_fallback_cache = None
         if defer_sync:
             self.state = st
-            self.flux_padded = fx
+            self._fx_commit(tally, fx)
             return found_all, ovf
         ovf_v, found_v = jax.device_get((ovf, found_all))
         # Overflow-safe migrate: the committed state on overflow is the
         # intact pre-migrate snapshot of the failed round — safe to
         # commit, then recover instead of raise.
         self.state = st
-        self.flux_padded = fx
+        self._fx_commit(tally, fx)
         if bool(ovf_v):
             return self._recover_overflow(tally)
         return bool(found_v)
@@ -2108,11 +2296,11 @@ class PartitionedEngine:
         )
         st, fx, found_all, ovf, rounds, disp, fmax, fsum, nfb = phase(
             self.part.table, self.part.adj_int, self.part.table_hi,
-            self.state, self.flux_padded,
+            self.state, self._fx_in(tally),
         )
         ovf_v, found_v = jax.device_get((ovf, found_all))
         self.state = st
-        self.flux_padded = fx
+        self._fx_commit(tally, fx)
         self._last_rounds_dev = rounds
         self._last_rounds_cache = None
         self._last_disp_dev = disp
@@ -2298,6 +2486,8 @@ class PartitionedEngine:
         w_n: jnp.ndarray,
         defer_sync: bool = False,
         profile: Optional[PhaseProfile] = None,
+        sbin_n: Optional[jnp.ndarray] = None,
+        sfac_n: Optional[jnp.ndarray] = None,
     ):
         """Full (or continue-mode) tallied move.
 
@@ -2305,7 +2495,16 @@ class PartitionedEngine:
         (found_all, overflow) pair — see ``_run_phase``. ``profile``
         accumulates a per-component budget of every phase this move
         runs into the given ``PhaseProfile`` (measurement mode — one
-        sync per section per round)."""
+        sync per section per round). ``sbin_n``/``sfac_n`` (scoring-
+        armed engines only) are the move's caller-order bin-lane
+        offsets and factor rows (scoring.ScoringRuntime.resolve) —
+        routed to slots by pid like fly/w, then MIGRATED with their
+        particles through every round."""
+        if self.scoring is not None and (sbin_n is None or sfac_n is None):
+            raise ValueError(
+                "scoring-armed engine needs sbin_n/sfac_n each move "
+                "(scoring.ScoringRuntime.resolve)"
+            )
         if origins_n is not None and self._n_lost:
             # Revival: a resampled origin inside the mesh re-locates a
             # lost particle (mirrors the single-chip engine, where
@@ -2318,6 +2517,15 @@ class PartitionedEngine:
         # fly: an undefined start element must not produce tallies.
         st["fly"] = jnp.where(st["lost"], jnp.asarray(0, jnp.int8), st["fly"])
         st["w"] = self._by_pid(w_n, jnp.asarray(0.0, st["w"].dtype))
+        if self.scoring is not None:
+            # Dead-slot fill is irrelevant (done slots never cross);
+            # zeros keep the rows cheap to compare in tests.
+            st["sbin"] = self._by_pid(
+                jnp.asarray(sbin_n, jnp.int32), jnp.asarray(0, jnp.int32)
+            )
+            st["sfac"] = self._by_pid(
+                sfac_n, jnp.asarray(0.0, st["sfac"].dtype)
+            )
         ok_a = True
         ovf_a = None
         if origins_n is not None:
@@ -2412,3 +2620,15 @@ class PartitionedEngine:
 
     def flux_original(self) -> jnp.ndarray:
         return self.part.flux_to_original(self.flux_padded)
+
+    def score_original(self) -> jnp.ndarray:
+        """Owned scoring lanes reordered into the CANONICAL flattened
+        ``[E·B·S]`` layout (original element order) — the same
+        per-element row gather as ``flux_to_original``, over ``B·S``
+        lanes per element."""
+        if self.score_padded is None:
+            raise RuntimeError("engine has no scoring lanes configured")
+        rows = self.score_padded.reshape(
+            self.nparts * self.part.L, self.score_stride
+        )
+        return rows[self.part.glid_of_orig].reshape(-1)

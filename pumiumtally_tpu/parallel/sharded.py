@@ -170,7 +170,8 @@ def sharded_locate(
 
 
 def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux,
-                        tol, max_iters, walk_kw=()):
+                        tol, max_iters, walk_kw=(), score_kinds=(),
+                        score_ops=None):
     """Common shard_map scaffold for the tallied move variants.
 
     ``particle_args`` are sharded over the particle axis; the tet mesh
@@ -183,33 +184,65 @@ def _sharded_tally_step(device_mesh, step_fn, mesh, particle_args, flux,
     reduces the mask for the found-all check and the sentinel's
     straggler ladder consumes both (round 9: every tallied step
     returns the mask + s, not a pre-reduced scalar).
+
+    ``score_ops = (bank, bin_off, fac)`` (round 10): bin offsets /
+    factor rows shard with the particles, the lane bank replicates
+    like flux — each chip's bank delta psum's over ICI the same way,
+    so scoring inherits the flux lane's determinism; the accumulated
+    bank returns as a SIXTH output.
     """
     ax = _axis_name(device_mesh)
     pp = P(ax)
+    scoring = score_ops is not None
+    extra_in = (pp, pp) if scoring else ()
+    extra_tail = (P(),) if scoring else ()
 
     @partial(
         shard_map,
         mesh=device_mesh,
-        in_specs=(P(),) + (pp,) * len(particle_args) + (P(),),
-        out_specs=(pp, pp, P(), pp, pp),
+        in_specs=(
+            (P(),) + (pp,) * len(particle_args) + extra_in + (P(),)
+            + extra_tail
+        ),
+        out_specs=(pp, pp, P(), pp, pp) + extra_tail,
         **shard_map_check_kwargs(),
     )
     def step(mesh_, *rest):
-        *pargs, flux_ = rest
+        if scoring:
+            *pargs, sbin_, sfac_, flux_, bank_ = rest
+        else:
+            *pargs, flux_ = rest
         zero_flux = _pvary(jnp.zeros_like(flux_), ax)
-        x2, elem2, dflux, local_done, local_s = step_fn(
+        kw = {}
+        if scoring:
+            kw = {
+                "score_kinds": score_kinds,
+                "score_ops": (
+                    _pvary(jnp.zeros_like(bank_), ax), sbin_, sfac_
+                ),
+            }
+        res = step_fn(
             mesh_, *pargs, zero_flux, tol=tol, max_iters=max_iters,
-            walk_kw=walk_kw,
+            walk_kw=walk_kw, **kw,
         )
+        x2, elem2, dflux, local_done, local_s = res[:5]
         flux_out = flux_ + lax.psum(dflux, ax)
+        if scoring:
+            return (x2, elem2, flux_out, local_done, local_s,
+                    bank_ + lax.psum(res[5], ax))
         return x2, elem2, flux_out, local_done, local_s
 
+    if scoring:
+        bank, sbin, sfac = score_ops
+        return step(mesh, *particle_args, sbin, sfac, flux, bank)
     return step(mesh, *particle_args, flux)
 
 
 @partial(
     jax.jit,
-    static_argnames=("device_mesh", "tol", "max_iters", "walk_kw"),
+    static_argnames=(
+        "device_mesh", "tol", "max_iters", "walk_kw", "score_kinds",
+    ),
 )
 def sharded_move_step(
     device_mesh: Mesh,
@@ -225,6 +258,8 @@ def sharded_move_step(
     tol: float,
     max_iters: int,
     walk_kw: tuple = (),
+    score_kinds: tuple = (),
+    score_ops=None,
 ):
     """One two-phase MoveToNextLocation over the device mesh."""
     from pumiumtally_tpu.api.tally import move_step
@@ -232,13 +267,15 @@ def sharded_move_step(
     return _sharded_tally_step(
         device_mesh, move_step, mesh,
         (x, elem, origins, dests, flying, weights), flux, tol, max_iters,
-        walk_kw=walk_kw,
+        walk_kw=walk_kw, score_kinds=score_kinds, score_ops=score_ops,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("device_mesh", "tol", "max_iters", "walk_kw"),
+    static_argnames=(
+        "device_mesh", "tol", "max_iters", "walk_kw", "score_kinds",
+    ),
 )
 def sharded_move_step_continue(
     device_mesh: Mesh,
@@ -253,6 +290,8 @@ def sharded_move_step_continue(
     tol: float,
     max_iters: int,
     walk_kw: tuple = (),
+    score_kinds: tuple = (),
+    score_ops=None,
 ):
     """Phase-B-only sharded move: transport straight from the committed
     (sharded) state — the ``origins=None`` fast path of the API (see
@@ -262,7 +301,7 @@ def sharded_move_step_continue(
     return _sharded_tally_step(
         device_mesh, move_step_continue, mesh,
         (x, elem, dests, flying, weights), flux, tol, max_iters,
-        walk_kw=walk_kw,
+        walk_kw=walk_kw, score_kinds=score_kinds, score_ops=score_ops,
     )
 
 

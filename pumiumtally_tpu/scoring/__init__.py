@@ -1,0 +1,51 @@
+"""Filtered multi-score tallies: energy/time-binned scoring lanes.
+
+The reference accumulates exactly ONE score — track-length x weight
+flux per element (reference PumiTallyImpl.cpp:352-380) — but its host
+code's tally system (OpenMC, Romano et al. 2015) is built around
+FILTERS and MULTIPLE SCORES: energy bins, time bins, flux/heating/
+event-count scores per bin. This package adds that layer as a
+segment-commit hook riding the existing walk:
+
+- ``filters.EnergyFilter`` / ``filters.TimeFilter`` — bin-edge
+  filters over new per-particle ``energy=`` / ``time=`` move inputs;
+- ``scores`` — the score registry: what each score's per-segment
+  contribution is (``flux`` = s·w, ``heating`` = s·w·E,
+  ``events`` = face-crossing count);
+- ``binding.ScoringSpec`` — the user-facing configuration
+  (``TallyConfig.scoring``): filters x scores + the out-of-range
+  policy knob (``drop``/``clamp``);
+- ``binding.ScoringRuntime`` — the per-facade runtime: filter edges
+  as DEVICE OPERANDS (edge values never enter any jit cache key —
+  only the bin counts do, through array shapes), the jitted
+  branchless-searchsorted bin resolution (entry point
+  ``score_bins``), and the flattened ``[E·B·S]`` lane-bank layout.
+
+The hook itself lives in ``ops/walk.py`` (and ``walk_local`` in
+``parallel/partition.py``): at the same point where track-length x
+weight is scattered into the flux lane, each score's segment
+contribution scatters into the lane bank with ONE fused deterministic
+scatter-add — the same scatter-order class as the flux lane, no
+atomics. Scoring-off constructs nothing and every engine is bitwise
+identical to a scoring-less build; scoring-on leaves the flux lane
+bitwise too (the flux scatter is untouched) — both pinned across all
+five facades in tests/test_scoring.py. docs/DESIGN.md "Filtered
+scoring (round 10)".
+"""
+
+from pumiumtally_tpu.scoring.binding import (
+    ScoreOps,
+    ScoringRuntime,
+    ScoringSpec,
+)
+from pumiumtally_tpu.scoring.filters import EnergyFilter, TimeFilter
+from pumiumtally_tpu.scoring.scores import SCORES
+
+__all__ = [
+    "EnergyFilter",
+    "TimeFilter",
+    "SCORES",
+    "ScoreOps",
+    "ScoringRuntime",
+    "ScoringSpec",
+]

@@ -1,0 +1,266 @@
+"""ScoringSpec (configuration) + ScoringRuntime (per-facade binding).
+
+Lane-bank layout (docs/DESIGN.md "Filtered scoring"): one flattened
+``[E · B · S]`` device array per engine, score-minor —
+``lane(e, b, k) = e·(B·S) + b·S + k`` with ``B`` the bin count
+(product over filters, time-minor) and ``S`` the score count. The
+walk hook only ever needs the per-particle ``bin_off = b·S`` (or the
+DROP sentinel) and the per-particle ``[S]`` factor row: both are
+walk-constant, resolved ONCE per move by the jitted ``score_bins``
+entry point below — a branchless ``searchsorted`` per filter over
+edge arrays passed as device OPERANDS, so edge values never enter any
+jit cache key (only bin counts do, through shapes).
+
+Out-of-range policy (``ScoringSpec.overflow``, one knob for every
+filter):
+
+- ``"drop"`` (default; the OpenMC convention): values below
+  ``edges[0]`` or at/above ``edges[-1]`` score into no bin — the bin
+  offset becomes a sentinel ``>= bank_size`` and the lane scatter's
+  ``mode="drop"`` discards it deterministically;
+- ``"clamp"``: out-of-range values land in the nearest edge bin.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu.scoring.filters import (
+    EnergyFilter,
+    TimeFilter,
+    _EdgeFilter,
+)
+from pumiumtally_tpu.scoring.scores import SCORES
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+OVERFLOW_POLICIES = ("drop", "clamp")
+
+
+class ScoringSpec:
+    """User-facing scoring configuration (``TallyConfig.scoring``).
+
+    Args:
+      filters: at most one ``EnergyFilter`` and one ``TimeFilter``
+        (empty = one unfiltered bin).
+      scores: names from the ``scoring.scores.SCORES`` registry, no
+        duplicates, at least one.
+      overflow: the out-of-range policy knob, ``"drop"``/``"clamp"``
+        (module docstring).
+    """
+
+    def __init__(
+        self,
+        filters: Sequence[_EdgeFilter] = (),
+        scores: Sequence[str] = ("flux",),
+        overflow: str = "drop",
+    ):
+        self.energy_filter: Optional[EnergyFilter] = None
+        self.time_filter: Optional[TimeFilter] = None
+        for f in filters:
+            if isinstance(f, EnergyFilter):
+                if self.energy_filter is not None:
+                    raise ValueError("at most one EnergyFilter per spec")
+                self.energy_filter = f
+            elif isinstance(f, TimeFilter):
+                if self.time_filter is not None:
+                    raise ValueError("at most one TimeFilter per spec")
+                self.time_filter = f
+            else:
+                raise ValueError(
+                    f"filters must be EnergyFilter/TimeFilter, got {f!r}"
+                )
+        scores = tuple(scores)
+        if not scores:
+            raise ValueError("ScoringSpec needs at least one score")
+        if len(set(scores)) != len(scores):
+            raise ValueError(f"duplicate scores in {scores!r}")
+        for s in scores:
+            if s not in SCORES:
+                raise ValueError(
+                    f"unknown score {s!r}; available: {sorted(SCORES)}"
+                )
+        if overflow not in OVERFLOW_POLICIES:
+            raise ValueError(
+                f"overflow must be one of {OVERFLOW_POLICIES}, "
+                f"got {overflow!r}"
+            )
+        self.scores = scores
+        self.overflow = overflow
+
+    @property
+    def n_ebins(self) -> int:
+        return 0 if self.energy_filter is None else self.energy_filter.n_bins
+
+    @property
+    def n_tbins(self) -> int:
+        return 0 if self.time_filter is None else self.time_filter.n_bins
+
+    @property
+    def n_bins(self) -> int:
+        """Combined bin count (product over filters, time-minor)."""
+        return max(1, self.n_ebins) * max(1, self.n_tbins)
+
+    @property
+    def n_scores(self) -> int:
+        return len(self.scores)
+
+    @property
+    def kinds(self) -> Tuple[str, ...]:
+        """Per-score segment basis ("track"/"count") — the STATIC half
+        of the walk hook's contract."""
+        return tuple(SCORES[s][0] for s in self.scores)
+
+    @property
+    def fac_kinds(self) -> Tuple[str, ...]:
+        """Per-score factor source ("one"/"energy") for bin
+        resolution."""
+        return tuple(SCORES[s][1] for s in self.scores)
+
+    @property
+    def needs_energy(self) -> bool:
+        return self.energy_filter is not None or "energy" in self.fac_kinds
+
+    @property
+    def needs_time(self) -> bool:
+        return self.time_filter is not None
+
+    def static_key(self) -> tuple:
+        """The hashable spec identity for engine jit-cache keys — the
+        edge VALUES are deliberately absent (they are operands of the
+        ``score_bins`` program only)."""
+        return (self.scores, self.overflow, self.n_ebins, self.n_tbins)
+
+    def __repr__(self) -> str:
+        fs = [f for f in (self.energy_filter, self.time_filter) if f]
+        return (
+            f"ScoringSpec(filters={fs!r}, scores={self.scores!r}, "
+            f"overflow={self.overflow!r})"
+        )
+
+
+class ScoreOps(NamedTuple):
+    """The walk hook's operand bundle (ops/walk.py ``walk(scoring=)``
+    and ``walk_local(scoring=)``): ``kinds`` is static (a python
+    tuple); the arrays are traced.
+
+    ``bank`` is the (engine-local) flattened lane bank the walk
+    accumulates into; ``bin_off`` the per-particle ``b·S`` lane offset
+    (or a ``>= bank_size`` DROP sentinel); ``fac`` the per-particle
+    ``[S]`` factor row."""
+
+    kinds: Tuple[str, ...]
+    bank: Any
+    bin_off: Any
+    fac: Any
+
+
+@partial(jax.jit, static_argnames=("fac_kinds", "clamp", "sentinel"))
+def _bins_and_factors(e_edges, t_edges, energy, time_, ones, *,
+                      fac_kinds, clamp, sentinel):
+    """Branchless per-particle bin resolution + factor rows.
+
+    ``ones`` is an all-ones [n] template in the working dtype (fixes n
+    and the dtype even when no attribute array is staged). Edge arrays
+    are operands: one compile per (n, dtype, spec static key)."""
+    n_scores = len(fac_kinds)
+    bin_idx = jnp.zeros_like(ones, dtype=jnp.int32)
+    bad = jnp.zeros(ones.shape, dtype=bool)
+    for edges, vals in ((e_edges, energy), (t_edges, time_)):
+        if edges is None:
+            continue
+        nb = edges.shape[0] - 1
+        b = (
+            jnp.searchsorted(edges, vals.astype(edges.dtype), side="right")
+            .astype(jnp.int32) - 1
+        )
+        bad = bad | (b < 0) | (b >= nb)
+        bin_idx = bin_idx * nb + jnp.clip(b, 0, nb - 1)
+    bin_off = bin_idx * n_scores
+    if not clamp:
+        bin_off = jnp.where(bad, jnp.asarray(sentinel, jnp.int32), bin_off)
+    cols = [ones if k == "one" else energy.astype(ones.dtype)
+            for k in fac_kinds]
+    return bin_off, jnp.stack(cols, axis=1)
+
+
+_bins_and_factors = register_entry_point("score_bins", _bins_and_factors)
+
+
+class ScoringRuntime:
+    """Per-facade scoring binding: the spec's device-side edge arrays,
+    the bank geometry, and the per-move bin/factor resolution.
+
+    ``bank_size`` is the facade's OWN flattened lane-bank length —
+    ``E·B·S`` for the replicated-mesh engines, the PADDED
+    ``nparts·L·B·S`` for the partitioned ones. The DROP sentinel is
+    ``bank_size`` itself: every lane index built from it lands at or
+    past the end of any (sub-)bank slice the walk scatters into, and
+    ``mode="drop"`` discards it."""
+
+    def __init__(self, spec: ScoringSpec, nelems: int, dtype: Any,
+                 bank_size: Optional[int] = None):
+        self.spec = spec
+        self.nelems = int(nelems)
+        self.dtype = dtype
+        self.stride = spec.n_bins * spec.n_scores  # lanes per element
+        self.bank_size = (
+            self.nelems * self.stride if bank_size is None
+            else int(bank_size)
+        )
+        ef, tf = spec.energy_filter, spec.time_filter
+        self.e_edges = (
+            None if ef is None else jnp.asarray(ef.edges, dtype)
+        )
+        self.t_edges = (
+            None if tf is None else jnp.asarray(tf.edges, dtype)
+        )
+
+    def resolve(self, energy, time_, n: int):
+        """(bin_off [n] int32, fac [n,S]) for one staged move.
+
+        ``energy``/``time_`` are [n] device (or host) arrays, or None
+        when the spec does not consume the attribute; presence is the
+        FACADE's contract (it validates with argument-naming errors
+        before anything is staged)."""
+        ones = jnp.ones((n,), self.dtype)
+        return _bins_and_factors(
+            self.e_edges, self.t_edges,
+            None if energy is None else jnp.asarray(energy),
+            None if time_ is None else jnp.asarray(time_),
+            ones,
+            fac_kinds=self.spec.fac_kinds,
+            clamp=self.spec.overflow == "clamp",
+            sentinel=self.bank_size,
+        )
+
+    def zero_bank(self) -> jnp.ndarray:
+        return jnp.zeros((self.bank_size,), self.dtype)
+
+    def ops(self, bank, bin_off, fac) -> ScoreOps:
+        return ScoreOps(self.spec.kinds, bank, bin_off, fac)
+
+
+def score_cell_data(spec: ScoringSpec, bank: np.ndarray,
+                    volumes: np.ndarray) -> dict:
+    """``<score>_bin<k>`` cell arrays for the VTK writers from a
+    CANONICAL (original-element-order) flattened bank — every lane
+    volume-normalized exactly like the flux array, so the 1-filter
+    flux lanes sum to the written ``flux`` array (bin-partition
+    telescoping). Returns {} for a None spec so scoring-off files keep
+    the reference payload byte-identical."""
+    if spec is None:
+        return {}
+    vol = np.asarray(volumes, dtype=np.float64)
+    arr = np.asarray(bank, dtype=np.float64).reshape(
+        vol.shape[0], spec.n_bins, spec.n_scores
+    ) / vol[:, None, None]
+    out = {}
+    for b in range(spec.n_bins):
+        for j, name in enumerate(spec.scores):
+            out[f"{name}_bin{b}"] = arr[:, b, j]
+    return out

@@ -1,0 +1,39 @@
+"""The score registry: what each score contributes per committed walk
+segment.
+
+Every score is described by two static pieces the walk hook consumes:
+
+- ``basis`` — what geometric quantity of the segment the score is
+  proportional to:
+  * ``"track"``: the segment's track length x weight — exactly the
+    flux lane's per-crossing contribution ``(s_new − s)·‖d0‖·w``, so
+    the ``flux`` score's lane values are BITWISE the flux lane's
+    update stream (the bin-partition telescoping contract,
+    tests/test_scoring.py);
+  * ``"count"``: 1 per committed face crossing (interior neighbor
+    advance, partition-face pause, or the boundary exit — the same
+    event set the reference's ``inter_points`` records) — exact small
+    integers, so cross-engine equality is exact, not rounding-class.
+- ``factor`` — a per-particle walk-constant multiplier resolved once
+  per move (scoring/binding.py): ``"one"`` (no scaling) or
+  ``"energy"`` (the staged per-particle energy).
+
+Shipped scores:
+
+- ``flux``    — track x 1: the reference's own tally, per (bin).
+- ``heating`` — track x energy: the KERMA-shaped linear-in-energy
+  deposition placeholder (a production host folds its material
+  response into the staged energies/weights; the lane layout is what
+  this subsystem provides).
+- ``events``  — crossings x 1: per-bin face-crossing counts, the
+  collision-density analogue for a track-length engine.
+"""
+
+from __future__ import annotations
+
+# name -> (basis, factor); see module docstring.
+SCORES: dict = {
+    "flux": ("track", "one"),
+    "heating": ("track", "energy"),
+    "events": ("count", "one"),
+}

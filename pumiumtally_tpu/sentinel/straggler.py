@@ -49,24 +49,35 @@ def padded_size(k: int, floor: int = 8) -> int:
     return m
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw"))
-def _retry_step(mesh, x, elem, dest, fly, w, flux, k, s_init=None, *,
-                tol, max_iters, walk_kw=()):
+@partial(jax.jit, static_argnames=("tol", "max_iters", "walk_kw",
+                                   "score_kinds"))
+def _retry_step(mesh, x, elem, dest, fly, w, flux, k, s_init=None,
+                score_ops=None, *, tol, max_iters, walk_kw=(),
+                score_kinds=()):
     """Tallied retry walk over one compacted straggler batch. ``k``
     (traced) marks the real rows; pad rows are forced inert
     (``fly=0, dest=x`` — the walk's hold contract) so duplicated pad
     indices can never double-tally. ``s_init`` (with ``x`` = the
     ORIGINAL phase start) continues the interrupted parametrization —
-    see ops.walk.WalkResult.s."""
+    see ops.walk.WalkResult.s. ``score_ops`` (round 10) continues the
+    interrupted move's SCORING lanes the same way: the compacted
+    rows' bin offsets / factor rows plus the facade's bank — the
+    retry's remaining crossings score into the same lanes an
+    uninterrupted walk would have."""
     valid = (jnp.cumsum(jnp.ones_like(elem)) - 1) < k
     fly_v = jnp.where(valid, fly, 0).astype(jnp.int8)
     dest_v = jnp.where((fly_v == 1)[:, None], dest, x)
+    sc = None
+    if score_ops is not None:
+        from pumiumtally_tpu.scoring.binding import ScoreOps
+
+        sc = ScoreOps(score_kinds, *score_ops)
     r = walk(
         mesh, x, elem, dest_v, fly_v, w, flux,
         tally=True, tol=tol, max_iters=max_iters, s_init=s_init,
-        **dict(walk_kw),
+        scoring=sc, **dict(walk_kw),
     )
-    return r.x, r.elem, r.done, r.flux, r.s
+    return r.x, r.elem, r.done, r.flux, r.s, r.score_bank
 
 
 _retry_step = register_entry_point("straggler_retry", _retry_step)
@@ -98,7 +109,9 @@ def run_ladder(
     two_tier: bool = False,
     x_start: jnp.ndarray = None,
     s_init: jnp.ndarray = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, np.ndarray, np.ndarray]:
+    scoring=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, np.ndarray, np.ndarray,
+           jnp.ndarray]:
     """Run the escalation ladder over the ``unfinished`` host mask.
 
     Arrays are the facade's committed caller-order state ([cap]-shaped,
@@ -108,10 +121,13 @@ def run_ladder(
     remaining crossing computes bit-identically to an uninterrupted
     walk, so recovered flux is bitwise; without them (the non-tallying
     localization ladder) rungs restart from the committed partial
-    positions. Returns ``(x, elem, flux, recovered_idx, lost_idx)``
-    with the straggler rows updated in place (scattered back) and the
-    index sets as host int arrays. The caller must only invoke this
-    when ``unfinished.any()``.
+    positions. ``scoring = (kinds, bank, sbin, sfac)`` (round 10, the
+    interrupted move's staged operands) continues the scoring lanes the
+    same way. Returns ``(x, elem, flux, recovered_idx, lost_idx,
+    bank)`` — ``bank`` None without scoring — with the straggler rows
+    updated in place (scattered back) and the index sets as host int
+    arrays. The caller must only invoke this when
+    ``unfinished.any()``.
     """
     idx = np.flatnonzero(unfinished)
     k = idx.size
@@ -124,6 +140,11 @@ def run_ladder(
     ss = s_init[idx_dev] if continuing else None
     ds, fs, ws = dests[idx_dev], fly[idx_dev], w[idx_dev]
     k_dev = jnp.asarray(k, jnp.int32)
+    s_kinds: tuple = ()
+    bank = sb_r = sf_r = None
+    if scoring is not None:
+        s_kinds, bank, sbin, sfac = scoring
+        sb_r, sf_r = sbin[idx_dev], sfac[idx_dev]
 
     # The retry budget: retry_factor x the engine budget, floored at
     # the mesh-derived safe bound (config.resolved_max_iters'
@@ -144,9 +165,11 @@ def run_ladder(
     x_out, e_out = xs, es
     done_acc = None
     for max_iters, kw in rungs:
-        xr, er, done_r, flux, sr = _retry_step(
+        xr, er, done_r, flux, sr, bank = _retry_step(
             mesh, xs, es, ds, fs, ws, flux, k_dev, ss,
+            None if scoring is None else (bank, sb_r, sf_r),
             tol=tol, max_iters=max_iters, walk_kw=kw,
+            score_kinds=s_kinds,
         )
         if done_acc is None:
             x_out, e_out, done_acc = xr, er, done_r
@@ -172,4 +195,4 @@ def run_ladder(
     x = x.at[idx_dev[:k]].set(x_out[:k])
     elem = elem.at[idx_dev[:k]].set(e_out[:k])
     done_h = np.asarray(done_acc)[:k]
-    return x, elem, flux, idx[done_h], idx[~done_h]
+    return x, elem, flux, idx[done_h], idx[~done_h], bank

@@ -49,14 +49,11 @@ import numpy as np
 # (just not bitwise-layout-exact) state.
 _FORMAT_VERSION = 3
 
-# Slot-state rows saved verbatim for layout-exact partitioned restore;
-# must stay in sync with PartitionedEngine.state (a missing key makes
-# the loader fall back to the canonical restore, so drift degrades
-# gracefully).
-_ENGINE_STATE_KEYS = (
-    "x", "lelem", "pending", "pid", "alive", "done", "exited", "lost",
-    "dest", "fly", "w",
-)
+# Slot-state rows are saved verbatim for layout-exact partitioned
+# restore by iterating the ENGINE's own state dict (round 10 — the
+# optional scoring rows sbin/sfac ride exactly when present); a key
+# the checkpoint lacks makes the loader fall back to the canonical
+# restore, so drift degrades gracefully.
 
 
 class CorruptCheckpointError(ValueError):
@@ -87,9 +84,16 @@ def _engine_kind(tally) -> str:
 
 def _engine_layout_arrays(eng, prefix: str) -> dict:
     """One PartitionedEngine's exact slot state, key-prefixed for the
-    checkpoint payload (layout-exact restore; module docstring)."""
-    out = {prefix + k: np.asarray(eng.state[k]) for k in _ENGINE_STATE_KEYS}
+    checkpoint payload (layout-exact restore; module docstring).
+    Iterates the engine's OWN state keys so optional rows (the
+    scoring ``sbin``/``sfac``, round 10) ride along exactly when the
+    engine carries them — a scoring-less engine's payload stays
+    byte-identical to pre-scoring builds; old readers ignore the extra
+    keys (no format bump)."""
+    out = {prefix + k: np.asarray(v) for k, v in eng.state.items()}
     out[prefix + "flux_padded"] = np.asarray(eng.flux_padded)
+    if eng.score_padded is not None:
+        out[prefix + "score_padded"] = np.asarray(eng.score_padded)
     out[prefix + "cap"] = np.int64(eng.cap)
     out[prefix + "nparts"] = np.int64(eng.nparts)
     out[prefix + "L"] = np.int64(eng.part.L)
@@ -130,6 +134,35 @@ def collect_tally_state(tally) -> dict:
                 else np.asarray(stats.open_flux)
             ),
         }
+    scoring = getattr(tally, "_scoring", None)
+    if scoring is not None:
+        # Scoring lanes (round 10): the CANONICAL flattened bank; the
+        # per-chunk / per-engine layout extras ride below. Extra keys
+        # only — scoring-less saves stay byte-identical and old
+        # readers ignore them (no format bump, like the round-8
+        # layout extras).
+        extra["score_bank"] = np.asarray(tally.score_bank)
+        # The saving spec's static identity (scores/overflow/bin
+        # counts): the restore refuses a bank whose lane layout does
+        # not match the target spec (lane values under a different
+        # (bin, score) interpretation would be silently wrong data).
+        extra["score_spec"] = np.str_(repr(scoring.spec.static_key()))
+        sstats = getattr(tally, "_score_stats", None)
+        if sstats is not None:
+            extra.update({
+                "sstats_flux_sum": np.asarray(sstats.flux_sum),
+                "sstats_flux_sq_sum": np.asarray(sstats.flux_sq_sum),
+                "sstats_num_batches": np.int64(sstats.num_batches),
+                "sstats_moves_in_batch": np.int64(sstats.moves_in_batch),
+                "sstats_batch_open": np.bool_(
+                    sstats.open_flux is not None
+                ),
+                "sstats_open_flux": (
+                    np.zeros((sstats.nelems,), np.float64)
+                    if sstats.open_flux is None
+                    else np.asarray(sstats.open_flux)
+                ),
+            })
     # Layout-exact extras (round 8): the saving engine's own slot/chunk
     # arrangement, so a same-configured target resumes bit-for-bit.
     # The monolithic/sharded facade's canonical arrays ARE its layout.
@@ -138,6 +171,10 @@ def collect_tally_state(tally) -> dict:
             [np.asarray(f) for f in tally._flux]
         )
         extra["chunk_size"] = np.int64(tally.chunk_size)
+        if scoring is not None:
+            extra["chunk_score"] = np.stack(
+                [np.asarray(b) for b in tally._score]
+            )
     elif kind == "partitioned":
         extra["eng_count"] = np.int64(1)
         extra.update(_engine_layout_arrays(tally.engine, "eng0_"))
@@ -359,14 +396,20 @@ def _apply_tally_state_inner(tally, z: dict) -> None:
             tally.iter_count = int(z["iter_count"])
             tally.is_initialized = bool(z["is_initialized"])
             _restore_stats(tally, z)
+            _restore_scoring(tally, kind, z, layout_done=False)
             return
     if saved_kind == kind and _restore_layout_exact(tally, kind, z):
         tally.iter_count = int(z["iter_count"])
         tally.is_initialized = bool(z["is_initialized"])
         _restore_stats(tally, z)
+        # Layout-exact restore already placed the per-engine / per-
+        # chunk banks verbatim; only the scoring statistics (and the
+        # no-bank / dropped-bank corners) remain.
+        _restore_scoring(tally, kind, z, layout_done=True)
         return
     _restore_canonical(tally, kind, x, elem, flux, z)
     _restore_stats(tally, z)
+    _restore_scoring(tally, kind, z, layout_done=False)
 
 
 def _restore_stats(tally, z) -> None:
@@ -407,18 +450,153 @@ def _restore_stats(tally, z) -> None:
     )
 
 
+def _restore_scoring(tally, kind, z, layout_done: bool) -> None:
+    """Scoring-lane restore (round 10), mirroring the statistics
+    version-skew contract:
+
+    - scoring-armed target + scoring-carrying checkpoint: exact bank
+      restore (the layout-exact path already placed per-engine/chunk
+      banks; the canonical path scatters the flattened bank here) and
+      exact scoring-statistics restore;
+    - scoring-armed target + pre-scoring checkpoint: zero banks (a
+      restored campaign gains scoring lanes from the restore point);
+    - scoring-armed target + a bank saved under a DIFFERENT spec
+      (other scores/bins/overflow, or a different lane count): zero
+      banks with a warning — installing lane values under the wrong
+      (bin, score) interpretation would be silently wrong data;
+    - scoring-less target + scoring-carrying checkpoint: lanes dropped
+      with a warning (flux restores unchanged)."""
+    import jax.numpy as jnp
+
+    scoring = getattr(tally, "_scoring", None)
+    has = "score_bank" in z
+    if scoring is None:
+        if has:
+            warnings.warn(
+                "checkpoint carries scoring lanes but the target "
+                "engine has no TallyConfig.scoring; scoring lanes "
+                "dropped (flux restored unchanged)"
+            )
+        return
+    want_spec = repr(scoring.spec.static_key())
+    want_size = tally.mesh.nelems * scoring.stride
+    if has and (
+        str(z.get("score_spec", want_spec)) != want_spec
+        or np.asarray(z["score_bank"]).size != want_size
+    ):
+        warnings.warn(
+            "checkpoint scoring lanes were saved under a different "
+            f"ScoringSpec ({z.get('score_spec')!s} vs {want_spec}); "
+            "banks zeroed — scoring restarts at the restore point "
+            "(flux restored unchanged)"
+        )
+        has = False  # treat as a pre-scoring checkpoint below
+    if not has:
+        _zero_scoring_banks(tally, kind)
+        # Statistics over the old spec's lanes are as stale as the
+        # lanes themselves: reset at the (zeroed) bank.
+        sstats = getattr(tally, "_score_stats", None)
+        if sstats is not None:
+            sstats.reset(
+                open_flux=jnp.asarray(tally.score_bank, dtype=tally.dtype)
+            )
+        return
+    if not layout_done:
+        _restore_scoring_canonical(
+            tally, kind, np.asarray(z["score_bank"], np.float64)
+        )
+    sstats = getattr(tally, "_score_stats", None)
+    if sstats is None:
+        return
+    if "sstats_flux_sum" in z:
+        sstats.restore(
+            z["sstats_flux_sum"],
+            z["sstats_flux_sq_sum"],
+            int(z["sstats_num_batches"]),
+            int(z["sstats_moves_in_batch"]),
+            z["sstats_open_flux"] if bool(z["sstats_batch_open"]) else None,
+        )
+    else:
+        sstats.reset(
+            open_flux=jnp.asarray(tally.score_bank, dtype=tally.dtype)
+        )
+
+
+def _zero_scoring_banks(tally, kind) -> None:
+    import jax.numpy as jnp
+
+    if kind == "streaming":
+        tally._score = [jnp.zeros_like(b) for b in tally._score]
+    elif kind == "partitioned":
+        tally.engine.score_padded = jnp.zeros_like(
+            tally.engine.score_padded
+        )
+    elif kind == "streaming_partitioned":
+        for eng in tally.engines:
+            eng.score_padded = jnp.zeros_like(eng.score_padded)
+    else:
+        tally._score_bank = tally._scoring.zero_bank()
+
+
+def _restore_partitioned_score(eng, bank: np.ndarray) -> None:
+    """Canonical [E·B·S] bank → the engine's padded-glid lane layout
+    (the inverse of ``score_original``)."""
+    import jax.numpy as jnp
+
+    stride = eng.score_stride
+    rows = np.zeros((eng.nparts * eng.part.L, stride), np.float64)
+    rows[np.asarray(eng.part.glid_of_orig)] = bank.reshape(-1, stride)
+    eng.score_padded = jnp.asarray(
+        rows.reshape(-1), dtype=eng.flux_padded.dtype
+    )
+
+
+def _restore_scoring_canonical(tally, kind, bank: np.ndarray) -> None:
+    import jax.numpy as jnp
+
+    if kind == "streaming":
+        # Whole bank into chunk 0 (the flux convention: the sum over
+        # chunks reproduces the canonical total).
+        tally._score = [jnp.asarray(bank, dtype=tally.dtype)] + [
+            jnp.zeros_like(tally._score[0])
+            for _ in range(tally.nchunks - 1)
+        ]
+    elif kind == "partitioned":
+        _restore_partitioned_score(tally.engine, bank)
+    elif kind == "streaming_partitioned":
+        for k, eng in enumerate(tally.engines):
+            if k == 0:
+                _restore_partitioned_score(eng, bank)
+            else:
+                eng.score_padded = jnp.zeros_like(eng.score_padded)
+    else:
+        tally._score_bank = jnp.asarray(bank, dtype=tally.dtype)
+
+
 def _engine_layout_matches(eng, z, prefix: str) -> bool:
     """The saved layout fits this engine verbatim: same slot geometry
-    and every state row present."""
+    and every state row THIS engine carries present (a scoring-armed
+    target needs the saved sbin/sfac + bank; a pre-scoring checkpoint
+    then falls back to the canonical restore)."""
     for key, want in (
         ("cap", eng.cap), ("nparts", eng.nparts),
         ("L", eng.part.L), ("n", eng.n),
     ):
         if prefix + key not in z or int(z[prefix + key]) != int(want):
             return False
-    return all(prefix + k in z for k in _ENGINE_STATE_KEYS) and (
-        prefix + "flux_padded" in z
-    )
+    if eng.score_padded is not None and (
+        prefix + "score_padded" not in z
+        or z[prefix + "score_padded"].size != eng.score_padded.size
+    ):
+        return False
+    # Shape equality per row (not just presence): a scoring spec with
+    # a different score count changes the sfac row width even at equal
+    # slot geometry — installing it verbatim would poison the engine.
+    return all(
+        prefix + k in z
+        and tuple(z[prefix + k].shape) == tuple(eng.state[k].shape)
+        for k in eng.state
+    ) and prefix + "flux_padded" in z
 
 
 def _restore_engine_layout(eng, z, prefix: str) -> None:
@@ -426,11 +604,15 @@ def _restore_engine_layout(eng, z, prefix: str) -> None:
 
     eng.state = {
         k: jnp.asarray(z[prefix + k], dtype=eng.state[k].dtype)
-        for k in _ENGINE_STATE_KEYS
+        for k in eng.state
     }
     eng.flux_padded = jnp.asarray(
         z[prefix + "flux_padded"], dtype=eng.flux_padded.dtype
     )
+    if eng.score_padded is not None:
+        eng.score_padded = jnp.asarray(
+            z[prefix + "score_padded"], dtype=eng.score_padded.dtype
+        )
     eng._n_lost_dev = jnp.sum(eng.state["lost"])
     eng._n_lost_cache = None
 
@@ -444,11 +626,17 @@ def _restore_layout_exact(tally, kind, z) -> bool:
 
     if kind == "streaming":
         cf = z.get("chunk_flux")
+        cs = z.get("chunk_score")
+        scoring_armed = getattr(tally, "_scoring", None) is not None
         if (
             cf is None
             or "chunk_size" not in z
             or int(z["chunk_size"]) != tally.chunk_size
             or cf.shape[0] != tally.nchunks
+            or (scoring_armed and (
+                cs is None or cs.shape[0] != tally.nchunks
+                or cs.shape[1] != tally._scoring.bank_size
+            ))
         ):
             return False
         # Positions/elements restore through the canonical staging
@@ -467,6 +655,11 @@ def _restore_layout_exact(tally, kind, z) -> bool:
             jnp.asarray(cf[k], dtype=tally.dtype)
             for k in range(tally.nchunks)
         ]
+        if scoring_armed:
+            tally._score = [
+                jnp.asarray(cs[k], dtype=tally.dtype)
+                for k in range(tally.nchunks)
+            ]
         return True
     if kind == "partitioned":
         eng = tally.engine

@@ -210,6 +210,29 @@ def test_batch_stats_row(bench):
     assert res["compiles"]["trigger_eval"] == 1
 
 
+def test_scoring_row(bench):
+    """The filtered-scoring component row: schema keys present, the
+    BITWISE flux-parity and bin-telescoping gates asserted (the tool
+    raises otherwise), positive rates in both arms, and the
+    compiles-healthy contract — ``compiles.timed == 0``: the
+    scoring-armed walk and the score_bins resolution compile once
+    each in the warmup moves."""
+    res = bench.run_scoring()
+    for key in ("on_moves_per_sec", "off_moves_per_sec",
+                "scoring_overhead_pct", "scoring_ms_per_move",
+                "flux_parity_bitwise", "telescoping_bitwise",
+                "events_total", "lanes", "compiles", "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["telescoping_bitwise"] is True
+    assert res["on_moves_per_sec"] > 0 and res["off_moves_per_sec"] > 0
+    assert res["events_total"] > 0
+    assert res["lanes"] == {"n_bins": 2, "n_scores": 3,
+                            "bank_elems": 6 * bench.MESH_DIV**3 * 6}
+    assert res["compiles"]["timed"] == 0
+    assert res["compiles"].get("score_bins", 0) == 1
+
+
 def test_resilience_row(bench):
     """The fault-tolerance component row: schema keys present, bitwise
     flux parity between the autosave-on/off arms asserted (the tool

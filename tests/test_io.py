@@ -872,3 +872,62 @@ def test_cli_aot_check_verb(capsys):
         raise AssertionError(out.out + out.err) from e
     out = capsys.readouterr()
     assert "[OK] walk kernel" in out.out
+
+
+# ---------------------------------------------------------------------------
+# Multi-array cell data: ordering + the name-collision guard (round 10)
+# ---------------------------------------------------------------------------
+
+def test_multi_array_ordering_round_trip_all_formats(tmp_path):
+    """MANY cell arrays written together must each read back by NAME
+    with their own values — in the legacy .vtk (binary AND ascii), in
+    .vtu, and in every .pvtu piece. Guards the writer/reader pairing
+    against array-order mixups when the payload grows (the scoring
+    lanes add a dozen arrays beside flux+volume)."""
+    from pumiumtally_tpu.io.vtk import (
+        read_vtk_cell_scalars,
+        write_pvtu,
+        write_vtk,
+    )
+
+    coords, tets = box_arrays(1, 1, 1, 2, 2, 2)
+    ne = tets.shape[0]
+    rng = np.random.default_rng(10)
+    arrays = {
+        name: rng.uniform(size=ne)
+        for name in ("flux", "volume", "flux_bin0", "flux_bin1",
+                     "heating_bin0", "events_bin1", "rel_err")
+    }
+    for fname, kw in (("a.vtk", {}), ("a_ascii.vtk", {"ascii": True}),
+                      ("a.vtu", {})):
+        path = str(tmp_path / fname)
+        write_vtk(path, coords, tets, cell_data=arrays, **kw)
+        for name, want in arrays.items():
+            got = read_vtk_cell_scalars(path, name)
+            np.testing.assert_allclose(got, want, rtol=0, atol=0)
+    owner = rng.integers(0, 3, ne)
+    ppath = str(tmp_path / "a.pvtu")
+    write_pvtu(ppath, coords, tets, owner, cell_data=arrays)
+    for r in range(3):
+        piece = str(tmp_path / f"a_p{r}.vtu")
+        sel = owner == r
+        for name, want in arrays.items():
+            np.testing.assert_array_equal(
+                read_vtk_cell_scalars(piece, name), want[sel]
+            )
+
+
+def test_merge_cell_data_refuses_collisions():
+    """A user-facing array name colliding with an existing payload
+    array (e.g. a scoring lane named ``flux_mean`` beside the stats
+    arrays) must raise a clear ValueError, never silently shadow."""
+    from pumiumtally_tpu.io.vtk import merge_cell_data
+
+    a = {"flux": np.ones(3), "volume": np.ones(3)}
+    b = {"flux_mean": np.ones(3)}
+    merged = merge_cell_data(a, b, None, {})
+    assert set(merged) == {"flux", "volume", "flux_mean"}
+    with pytest.raises(ValueError, match="flux_mean"):
+        merge_cell_data(a, b, {"flux_mean": np.zeros(3)})
+    with pytest.raises(ValueError, match="collision"):
+        merge_cell_data(a, {"flux": np.zeros(3)})

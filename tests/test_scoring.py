@@ -1,0 +1,561 @@
+"""Filtered multi-score tallies (pumiumtally_tpu/scoring): spec/filter
+validation, the scoring-off and scoring-on bitwise parity contracts on
+every engine, bin-partition telescoping, score semantics (heating /
+events), out-of-range policy, checkpoint round-trips, the VTK payload,
+and the scoring statistics lanes.
+"""
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    EnergyFilter,
+    PartitionedPumiTally,
+    PumiTally,
+    ScoringSpec,
+    StreamingPartitionedTally,
+    StreamingTally,
+    TallyConfig,
+    TimeFilter,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh
+
+N = 240
+MESH_ARGS = (1, 1, 1, 4, 4, 4)
+E = 6 * 4**3
+
+ENGINE_NAMES = (
+    "monolithic", "sharded", "streaming", "partitioned",
+    "streaming_partitioned",
+)
+
+
+def _spec2():
+    """The canonical 2-energy-bin, 3-score spec of this suite."""
+    return ScoringSpec(
+        filters=[EnergyFilter([0.0, 1.0, 2.0])],
+        scores=["flux", "heating", "events"],
+    )
+
+
+def _make_engine(name: str, spec, **cfg_kw):
+    cfg = lambda **kw: TallyConfig(scoring=spec, **cfg_kw, **kw)
+    mesh = build_box(*MESH_ARGS)
+    if name == "monolithic":
+        return PumiTally(mesh, N, cfg())
+    if name == "sharded":
+        return PumiTally(mesh, N, cfg(device_mesh=make_device_mesh(2)))
+    if name == "streaming":
+        return StreamingTally(mesh, N, chunk_size=120, config=cfg())
+    if name == "partitioned":
+        return PartitionedPumiTally(
+            mesh, N,
+            cfg(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+        )
+    return StreamingPartitionedTally(
+        mesh, N, chunk_size=120,
+        config=cfg(device_mesh=make_device_mesh(4), capacity_factor=4.0),
+    )
+
+
+def _corridor_workload(rng, moves: int = 2):
+    """Disjoint-corridor batches: group A (energies in bin 0) transports
+    strictly inside x < 0.5, group B (bin 1) strictly inside x > 0.5 —
+    a cell-boundary plane of the 4^3 box, so every ELEMENT only ever
+    sees one bin's particles. That single-bin-per-element structure is
+    what makes the bin-partition telescoping claim BITWISE (mixed-bin
+    elements would reassociate the scatter sums)."""
+    half = N // 2
+    def pts():
+        p = np.empty((N, 3))
+        p[:half] = rng.uniform(
+            [0.05, 0.05, 0.05], [0.45, 0.95, 0.95], (half, 3)
+        )
+        p[half:] = rng.uniform(
+            [0.55, 0.05, 0.05], [0.95, 0.95, 0.95], (N - half, 3)
+        )
+        return p
+    energy = np.where(np.arange(N) < half, 0.5, 1.5)
+    return pts(), [pts() for _ in range(moves)], energy
+
+
+def _drive(t, src, dests, **move_kw):
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    for d in dests:
+        t.MoveToNextLocation(None, d.reshape(-1).copy(), **move_kw)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Spec / filter validation
+# ---------------------------------------------------------------------------
+
+def test_filter_validation():
+    with pytest.raises(ValueError, match="at least 2 edges"):
+        EnergyFilter([1.0])
+    with pytest.raises(ValueError, match="strictly increasing"):
+        EnergyFilter([0.0, 1.0, 1.0])
+    with pytest.raises(ValueError, match="finite"):
+        TimeFilter([0.0, np.inf])
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown score"):
+        ScoringSpec(scores=["flux", "dose"])
+    with pytest.raises(ValueError, match="duplicate"):
+        ScoringSpec(scores=["flux", "flux"])
+    with pytest.raises(ValueError, match="at least one score"):
+        ScoringSpec(scores=[])
+    with pytest.raises(ValueError, match="overflow"):
+        ScoringSpec(overflow="wrap")
+    with pytest.raises(ValueError, match="one EnergyFilter"):
+        ScoringSpec(filters=[EnergyFilter([0, 1]), EnergyFilter([0, 1])])
+    with pytest.raises(ValueError, match="EnergyFilter/TimeFilter"):
+        ScoringSpec(filters=[object()])
+    with pytest.raises(ValueError, match="ScoringSpec"):
+        TallyConfig(scoring=0.5)
+    spec = ScoringSpec(
+        filters=[EnergyFilter([0, 1, 2, 3]), TimeFilter([0, 1, 2])],
+        scores=["flux", "events"],
+    )
+    assert spec.n_bins == 6 and spec.n_scores == 2
+    assert spec.needs_energy and spec.needs_time
+    # Edge VALUES never appear in the static identity.
+    assert spec.static_key() == (("flux", "events"), "drop", 3, 2)
+
+
+def test_scoring_disabled_surface():
+    t = PumiTally(build_box(*MESH_ARGS), N)
+    with pytest.raises(RuntimeError, match="scoring.ScoringSpec"):
+        t.score_bank
+    with pytest.raises(RuntimeError, match="scoring.ScoringSpec"):
+        t.score_array()
+    rng = np.random.default_rng(0)
+    src, dests, en = _corridor_workload(rng, 1)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    with pytest.raises(ValueError, match="energy=/time= require"):
+        t.MoveToNextLocation(None, dests[0].reshape(-1).copy(), energy=en)
+
+
+# ---------------------------------------------------------------------------
+# The parity contracts + bin-partition telescoping, on every engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ENGINE_NAMES)
+def test_scoring_parity_and_telescoping(name):
+    """The acceptance contract on every engine: scoring-ON leaves
+    flux, positions and element ids BITWISE identical to the
+    scoring-off run (the flux scatter is untouched), and on the
+    single-bin-per-element corridor workload the 2-bin flux lanes sum
+    to the unfiltered flux lane BITWISE (bin-partition telescoping)."""
+    rng = np.random.default_rng(7)
+    src, dests, en = _corridor_workload(rng, 2)
+    t_off = _drive(_make_engine(name, None), src, dests)
+    t_on = _drive(_make_engine(name, _spec2()), src, dests, energy=en)
+    f_off = np.asarray(t_off.flux)
+    np.testing.assert_array_equal(np.asarray(t_on.flux), f_off)
+    np.testing.assert_array_equal(t_on.positions, t_off.positions)
+    np.testing.assert_array_equal(t_on.elem_ids, t_off.elem_ids)
+    arr = np.asarray(t_on.score_bank).reshape(E, 2, 3)
+    # Telescoping: flux lanes over bins == the flux lane, bitwise.
+    np.testing.assert_array_equal(arr[:, :, 0].sum(axis=1), f_off)
+    # Both bins genuinely populated (the telescoping is not vacuous).
+    assert arr[:, 0, 0].sum() > 0 and arr[:, 1, 0].sum() > 0
+
+
+def test_scoring_off_constructs_nothing():
+    """Scoring-off allocates no runtime, no bank, no extra state keys
+    (partitioned), and the checkpoint payload carries no scoring keys
+    — today's format, byte-compatible."""
+    from pumiumtally_tpu.utils.checkpoint import collect_tally_state
+
+    t = _make_engine("partitioned", None)
+    assert t._scoring is None and t._score_bank is None
+    assert "sbin" not in t.engine.state and t.engine.score_padded is None
+    rng = np.random.default_rng(1)
+    src, dests, _ = _corridor_workload(rng, 1)
+    _drive(t, src, dests)
+    z = collect_tally_state(t)
+    assert not [k for k in z if "score" in k or "sbin" in k or "sfac" in k]
+
+
+# ---------------------------------------------------------------------------
+# Score semantics
+# ---------------------------------------------------------------------------
+
+def test_heating_is_energy_scaled_flux_bitwise():
+    """heating = track x energy: with every particle at energy 2.0
+    (a power of two — exact float scaling), the heating lane is
+    BITWISE 2x the flux lane."""
+    spec = ScoringSpec(filters=[EnergyFilter([0.0, 4.0])],
+                       scores=["flux", "heating"])
+    rng = np.random.default_rng(9)
+    src, dests, _ = _corridor_workload(rng, 2)
+    t = _drive(_make_engine("monolithic", spec), src, dests,
+               energy=np.full(N, 2.0))
+    arr = np.asarray(t.score_array())  # [E,1,2]
+    np.testing.assert_array_equal(arr[:, 0, 1], 2.0 * arr[:, 0, 0])
+    np.testing.assert_array_equal(arr[:, 0, 0], np.asarray(t.flux))
+
+
+@pytest.mark.parametrize("name", [n for n in ENGINE_NAMES
+                                  if n != "monolithic"])
+def test_events_exact_across_engines(name):
+    """Face-crossing counts are exact small integers, so every engine
+    must agree EXACTLY with the monolithic reference — a partition-face
+    pause commits its crossing exactly once across the migration."""
+    rng = np.random.default_rng(11)
+    src, dests, en = _corridor_workload(rng, 2)
+    base = _drive(_make_engine("monolithic", _spec2()), src, dests,
+                  energy=en)
+    t = _drive(_make_engine(name, _spec2()), src, dests, energy=en)
+    ev_base = np.asarray(base.score_array())[:, :, 2]
+    ev = np.asarray(t.score_array())[:, :, 2]
+    assert np.array_equal(ev, np.round(ev)) and ev.sum() > 0
+    np.testing.assert_array_equal(ev, ev_base)
+
+
+def test_time_filter_and_product_binning():
+    """Energy x time filters bin into the product layout (time-minor):
+    a particle at (e-bin i, t-bin j) scores lane i*n_tbins + j."""
+    spec = ScoringSpec(
+        filters=[EnergyFilter([0.0, 1.0, 2.0]), TimeFilter([0.0, 1.0, 2.0])],
+        scores=["flux"],
+    )
+    rng = np.random.default_rng(13)
+    src, dests, en = _corridor_workload(rng, 1)
+    tm = np.where(np.arange(N) % 2 == 0, 0.5, 1.5)
+    t = _drive(_make_engine("monolithic", spec), src, dests,
+               energy=en, time=tm)
+    arr = np.asarray(t.score_array())  # [E, 4, 1]
+    half = N // 2
+    # Group A (bin-0 energy) has both time bins -> lanes 0 and 1;
+    # group B (bin-1 energy) -> lanes 2 and 3. All four populated,
+    # and the total telescopes to the flux (allclose: time bins mix
+    # within elements).
+    for b in range(4):
+        assert arr[:, b, 0].sum() > 0, b
+    np.testing.assert_allclose(
+        arr[:, :, 0].sum(axis=1), np.asarray(t.flux), rtol=1e-12
+    )
+    # time-minor: the x<0.5 corridor's elements hold lanes 0/1 only.
+    a_elems = arr[:, 0, 0] + arr[:, 1, 0] > 0
+    assert np.all(arr[a_elems][:, 2:, 0] == 0)
+
+
+# ---------------------------------------------------------------------------
+# Out-of-range policy (drop vs clamp), on every engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ENGINE_NAMES)
+def test_overflow_policy_drop_vs_clamp(name):
+    """Energies below edges[0] / at-or-above edges[-1]: ``drop``
+    scores them into NO bin (deterministically discarded by the
+    scatter's drop mode — the flux lane is untouched either way);
+    ``clamp`` lands them in the nearest edge bin. One knob, pinned on
+    every facade."""
+    rng = np.random.default_rng(17)
+    src, dests, _ = _corridor_workload(rng, 1)
+    en = np.where(np.arange(N) < N // 2, -3.0, 9.0)  # all out of range
+
+    def spec(policy):
+        return ScoringSpec(filters=[EnergyFilter([0.0, 1.0, 2.0])],
+                           scores=["flux"], overflow=policy)
+
+    t_drop = _drive(_make_engine(name, spec("drop")), src, dests,
+                    energy=en)
+    flux = np.asarray(t_drop.flux)
+    assert flux.sum() > 0  # transport happened
+    assert np.asarray(t_drop.score_bank).sum() == 0.0  # nothing scored
+    t_clamp = _drive(_make_engine(name, spec("clamp")), src, dests,
+                     energy=en)
+    arr = np.asarray(t_clamp.score_bank).reshape(E, 2, 1)
+    # Below-range -> bin 0, above-range -> bin 1; single-bin elements
+    # (the corridors) make the telescoping bitwise again.
+    assert arr[:, 0, 0].sum() > 0 and arr[:, 1, 0].sum() > 0
+    np.testing.assert_array_equal(arr.sum(axis=(1, 2)), flux)
+
+
+# ---------------------------------------------------------------------------
+# Attribute validation (narrow prevalidator arm)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ("monolithic", "streaming"))
+def test_energy_time_validation_names_argument(name):
+    t = _make_engine(name, _spec2())
+    rng = np.random.default_rng(19)
+    src, dests, en = _corridor_workload(rng, 1)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    d = dests[0].reshape(-1)
+    with pytest.raises(ValueError, match="pass energy="):
+        t.MoveToNextLocation(None, d.copy())
+    with pytest.raises(ValueError, match="energy buffer has 3 values"):
+        t.MoveToNextLocation(None, d.copy(), energy=np.ones(3))
+    bad = en.copy()
+    bad[7] = np.nan
+    with pytest.raises(ValueError, match="energy contains 1 non-finite"):
+        t.MoveToNextLocation(None, d.copy(), energy=bad)
+    with pytest.raises(ValueError, match="no TimeFilter"):
+        t.MoveToNextLocation(None, d.copy(), energy=en, time=np.ones(N))
+    # The refused moves left the engine clean: a good move still runs.
+    t.MoveToNextLocation(None, d.copy(), energy=en)
+    assert np.asarray(t.score_bank).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round-trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ENGINE_NAMES)
+def test_checkpoint_roundtrip_scoring_bitwise(name, tmp_path):
+    """Mid-campaign save -> restore into a fresh same-configured
+    engine -> continue: final flux AND scoring lanes bitwise-equal to
+    the uninterrupted run, on every facade."""
+    from pumiumtally_tpu.utils.checkpoint import (
+        load_tally_state,
+        save_tally_state,
+    )
+
+    rng = np.random.default_rng(23)
+    src, dests, en = _corridor_workload(rng, 4)
+    ref = _drive(_make_engine(name, _spec2()), src, dests, energy=en)
+
+    t1 = _make_engine(name, _spec2())
+    t1.CopyInitialPosition(src.reshape(-1).copy())
+    for d in dests[:2]:
+        t1.MoveToNextLocation(None, d.reshape(-1).copy(), energy=en)
+    path = str(tmp_path / f"score_{name}.npz")
+    save_tally_state(t1, path)
+
+    t2 = _make_engine(name, _spec2())
+    load_tally_state(t2, path)
+    for d in dests[2:]:
+        t2.MoveToNextLocation(None, d.reshape(-1).copy(), energy=en)
+    np.testing.assert_array_equal(
+        np.asarray(t2.flux), np.asarray(ref.flux)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t2.score_bank), np.asarray(ref.score_bank)
+    )
+
+
+def test_checkpoint_scoring_version_skew(tmp_path):
+    """Both skew directions: a scoring save restored into a
+    scoring-less target drops the lanes with a warning (flux intact);
+    a scoring-less save restored into a scoring-armed target zeroes
+    the bank (scoring starts at the restore point)."""
+    from pumiumtally_tpu.utils.checkpoint import (
+        load_tally_state,
+        save_tally_state,
+    )
+
+    rng = np.random.default_rng(29)
+    src, dests, en = _corridor_workload(rng, 1)
+    t_on = _drive(_make_engine("monolithic", _spec2()), src, dests,
+                  energy=en)
+    p_on = str(tmp_path / "on.npz")
+    save_tally_state(t_on, p_on)
+    t_off = _make_engine("monolithic", None)
+    with pytest.warns(UserWarning, match="scoring lanes"):
+        load_tally_state(t_off, p_on)
+    np.testing.assert_array_equal(
+        np.asarray(t_off.flux), np.asarray(t_on.flux)
+    )
+
+    t_plain = _drive(_make_engine("monolithic", None), src, dests)
+    p_off = str(tmp_path / "off.npz")
+    save_tally_state(t_plain, p_off)
+    t_armed = _make_engine("monolithic", _spec2())
+    load_tally_state(t_armed, p_off)
+    np.testing.assert_array_equal(
+        np.asarray(t_armed.flux), np.asarray(t_plain.flux)
+    )
+    assert np.asarray(t_armed.score_bank).sum() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# VTK payload
+# ---------------------------------------------------------------------------
+
+def test_write_tally_results_score_arrays(tmp_path):
+    """<score>_bin<k> cell arrays beside flux+volume, every lane
+    volume-normalized like flux — so the flux lanes' sum reproduces
+    the written flux array bitwise on the corridor workload."""
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    rng = np.random.default_rng(31)
+    src, dests, en = _corridor_workload(rng, 2)
+    t = _drive(_make_engine("monolithic", _spec2()), src, dests,
+               energy=en)
+    out = str(tmp_path / "scored.vtk")
+    t.WriteTallyResults(out)
+    flux = read_vtk_cell_scalars(out, "flux")
+    arr = np.asarray(t.score_array())
+    vol = np.asarray(t.mesh.volumes)
+    total = np.zeros(E)
+    for b in range(2):
+        for j, s in enumerate(("flux", "heating", "events")):
+            got = read_vtk_cell_scalars(out, f"{s}_bin{b}")
+            np.testing.assert_array_equal(got, arr[:, b, j] / vol)
+        total += read_vtk_cell_scalars(out, f"flux_bin{b}")
+    np.testing.assert_array_equal(total, flux)
+
+
+def test_write_pvtu_score_arrays(tmp_path):
+    """The partitioned .pvtu path splits the scoring arrays per piece
+    like every other cell array."""
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    rng = np.random.default_rng(37)
+    src, dests, en = _corridor_workload(rng, 1)
+    t = _drive(_make_engine("partitioned", _spec2()), src, dests,
+               energy=en)
+    out = str(tmp_path / "scored.pvtu")
+    t.WriteTallyResults(out)
+    owner = t.engine.part.owner // t.engine.blocks_per_chip
+    arr = np.asarray(t.score_array())
+    vol = np.asarray(t.mesh.volumes)
+    for r in range(4):
+        sel = np.flatnonzero(owner == r)
+        piece = str(tmp_path / f"scored_p{r}.vtu")
+        np.testing.assert_array_equal(
+            read_vtk_cell_scalars(piece, "flux_bin1"),
+            (arr[:, 1, 0] / vol)[sel],
+        )
+
+
+def test_scoring_off_payload_unchanged(tmp_path):
+    from pumiumtally_tpu.io.vtk import read_vtk_cell_scalars
+
+    rng = np.random.default_rng(41)
+    src, dests, _ = _corridor_workload(rng, 1)
+    t = _drive(_make_engine("monolithic", None), src, dests)
+    out = str(tmp_path / "plain.vtk")
+    t.WriteTallyResults(out)
+    with pytest.raises(KeyError):
+        read_vtk_cell_scalars(out, "flux_bin0")
+
+
+# ---------------------------------------------------------------------------
+# Scoring statistics lanes (stats accumulators gain scoring lanes)
+# ---------------------------------------------------------------------------
+
+def test_score_statistics_lanes():
+    """With batch_stats=True the scoring bank gets its own per-batch
+    (sum, sq-sum) lanes: the per-lane mean over closed batches matches
+    the numpy statistics of the actual bank deltas."""
+    rng = np.random.default_rng(43)
+    t = _make_engine("monolithic", _spec2(), batch_stats=True)
+    deltas = []
+    prev = np.zeros(E * 6)
+    for _ in range(3):
+        src, dests, en = _corridor_workload(rng, 1)
+        _drive(t, src, dests, energy=en)
+        now = np.asarray(t.score_bank, np.float64)
+        deltas.append(now - prev)
+        prev = now
+        t.close_batch()
+    st = t.score_statistics()
+    assert st.num_batches == 3
+    x = np.stack(deltas)
+    np.testing.assert_allclose(
+        np.asarray(st.mean), x.mean(0), rtol=1e-12, atol=1e-300
+    )
+    # The flux statistics ride unchanged beside the scoring ones.
+    assert t.batch_statistics().num_batches == 3
+
+
+# ---------------------------------------------------------------------------
+# Sentinel interplay: the straggler ladder continues the lanes
+# ---------------------------------------------------------------------------
+
+def test_straggler_recovery_keeps_scoring_bitwise():
+    """A forced-tiny iteration budget truncates particles mid-flight;
+    the sentinel ladder re-walks the residue CONTINUING the original
+    parametrization — recovered flux AND scoring lanes must be bitwise
+    what an unconstrained run produces."""
+    from pumiumtally_tpu import SentinelPolicy
+
+    rng = np.random.default_rng(47)
+    src, dests, en = _corridor_workload(rng, 2)
+    mesh = build_box(*MESH_ARGS)
+    free = PumiTally(mesh, N, TallyConfig(scoring=_spec2()))
+    _drive(free, src, dests, energy=en)
+    t = PumiTally(
+        mesh, N,
+        TallyConfig(scoring=_spec2(), max_iters=2,
+                    sentinel=SentinelPolicy(on_anomaly="record")),
+    )
+    _drive(t, src, dests, energy=en)
+    rep = t.health_report()
+    assert rep.stragglers_recovered > 0 and rep.stragglers_lost == 0
+    np.testing.assert_array_equal(
+        np.asarray(t.flux), np.asarray(free.flux)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t.score_bank), np.asarray(free.score_bank)
+    )
+
+
+def test_refused_move_leaves_flying_buffer_intact():
+    """A move refused for a missing/invalid scoring attribute must not
+    have executed the flying-zeroing side effect: the caller's
+    corrected retry would otherwise silently transport nothing
+    (review finding, round 10)."""
+    rng = np.random.default_rng(53)
+    src, dests, en = _corridor_workload(rng, 1)
+    t = _make_engine("monolithic", _spec2())
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    fly = np.ones(N, np.int8)
+    d = dests[0].reshape(-1)
+    with pytest.raises(ValueError, match="pass energy="):
+        t.MoveToNextLocation(None, d.copy(), fly)
+    np.testing.assert_array_equal(fly, np.ones(N, np.int8))
+    bad = en.copy()
+    bad[0] = np.inf
+    with pytest.raises(ValueError, match="energy"):
+        t.MoveToNextLocation(None, d.copy(), fly, energy=bad)
+    np.testing.assert_array_equal(fly, np.ones(N, np.int8))
+    # The good retry actually transports.
+    t.MoveToNextLocation(None, d.copy(), fly, energy=en)
+    assert np.asarray(t.flux).sum() > 0
+    assert np.all(fly == 0)  # NOW the side effect fired
+
+
+@pytest.mark.parametrize("name", ("monolithic", "partitioned"))
+def test_checkpoint_scoring_spec_mismatch_zeroes_banks(name, tmp_path):
+    """A bank saved under a DIFFERENT ScoringSpec must never restore
+    under the wrong (bin, score) interpretation: the target warns,
+    zeroes its banks (scoring restarts at the restore point), and the
+    flux restores unchanged (review finding, round 10)."""
+    from pumiumtally_tpu.utils.checkpoint import (
+        load_tally_state,
+        save_tally_state,
+    )
+
+    rng = np.random.default_rng(59)
+    src, dests, en = _corridor_workload(rng, 1)
+    saver = _drive(_make_engine(name, _spec2()), src, dests, energy=en)
+    path = str(tmp_path / f"mismatch_{name}.npz")
+    save_tally_state(saver, path)
+    # Same lane COUNT (6 per element: 3 bins x 2 scores vs 2 bins x 3
+    # scores) — the nastiest case, where a size check alone passes.
+    other = ScoringSpec(
+        filters=[EnergyFilter([0.0, 1.0, 2.0, 3.0])],
+        scores=["flux", "heating"],
+    )
+    target = _make_engine(name, other)
+    with pytest.warns(UserWarning, match="different"):
+        load_tally_state(target, path)
+    np.testing.assert_array_equal(
+        np.asarray(target.flux), np.asarray(saver.flux)
+    )
+    assert np.asarray(target.score_bank).sum() == 0.0
+    # The restored engine still scores cleanly under ITS spec — a
+    # FRESH destination set (the saved one is already committed; a
+    # re-move there would be a zero-length no-op).
+    target.MoveToNextLocation(
+        None, src.reshape(-1).copy(), energy=en
+    )
+    assert np.asarray(target.score_bank).sum() > 0

@@ -188,13 +188,15 @@ def test_straggler_recovery_bf16_f32_rung():
     real = straggler._retry_step
     calls = []
 
-    def capped_first_rung(mesh_, x, e, d, f, w, fx, k, s=None, *,
-                          tol, max_iters, walk_kw=()):
+    def capped_first_rung(mesh_, x, e, d, f, w, fx, k, s=None,
+                          score_ops=None, *, tol, max_iters, walk_kw=(),
+                          score_kinds=()):
         calls.append(dict(walk_kw).get("table_dtype"))
         if len(calls) == 1:
             max_iters = 1  # starve rung 1: rung 2 must do the work
-        return real(mesh_, x, e, d, f, w, fx, k, s, tol=tol,
-                    max_iters=max_iters, walk_kw=walk_kw)
+        return real(mesh_, x, e, d, f, w, fx, k, s, score_ops, tol=tol,
+                    max_iters=max_iters, walk_kw=walk_kw,
+                    score_kinds=score_kinds)
 
     straggler._retry_step = capped_first_rung
     try:
@@ -230,10 +232,11 @@ def test_unrecoverable_straggler_quarantined_and_counted(tmp_path):
     n = src.shape[0]
     real = straggler._retry_step
 
-    def useless(mesh_, x, e, d, f, w, fx, k, s=None, *, tol,
-                max_iters, walk_kw=()):
-        return real(mesh_, x, e, d, f, w, fx, k, s, tol=tol,
-                    max_iters=1, walk_kw=walk_kw)
+    def useless(mesh_, x, e, d, f, w, fx, k, s=None, score_ops=None, *,
+                tol, max_iters, walk_kw=(), score_kinds=()):
+        return real(mesh_, x, e, d, f, w, fx, k, s, score_ops, tol=tol,
+                    max_iters=1, walk_kw=walk_kw,
+                    score_kinds=score_kinds)
 
     straggler._retry_step = useless
     try:

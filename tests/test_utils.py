@@ -742,3 +742,52 @@ def test_tally_entry_points_registered():
                              dst.reshape(-1).copy(),
                              np.ones(n, np.int8), np.ones(n))
     assert report.compiles.get("walk") == 1
+
+
+# ---------------------------------------------------------------------------
+# Narrow prevalidator: per-particle move-attribute arrays (round 10)
+# ---------------------------------------------------------------------------
+
+def test_host_scalar_field_names_argument():
+    """Wrong-shape energy/time buffers must raise with the ARGUMENT
+    NAME in the message — without this narrow prevalidation the shape
+    error surfaces later as an opaque jit broadcast failure."""
+    from pumiumtally_tpu.api.tally import host_scalar_field
+
+    with pytest.raises(ValueError, match="energy buffer has 3 values, "
+                                         "need 10"):
+        host_scalar_field(np.ones(3), 10, "energy")
+    with pytest.raises(ValueError, match="time buffer has"):
+        host_scalar_field([1.0], 2, "time")
+    # Longer buffers truncate like every other staged input.
+    assert host_scalar_field(np.arange(12.0), 10, "energy").shape == (10,)
+    # 2-D inputs flatten (the host protocol is flat buffers).
+    assert host_scalar_field(np.ones((5, 2)), 10, "time").shape == (10,)
+
+
+def test_stage_move_attr_nonfinite_names_argument():
+    """NaN/inf in energy/time refuse BEFORE anything dispatches, with
+    the argument name and the flat index — including the narrow-dtype
+    corner where a finite f64 value overflows the f32 working dtype
+    to inf (checked AFTER the cast, like positions/weights)."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import EnergyFilter, ScoringSpec
+
+    spec = ScoringSpec(filters=[EnergyFilter([0.0, 1.0])])
+    mesh = build_box(1, 1, 1, 2, 2, 2)
+    t = PumiTally(mesh, N, TallyConfig(scoring=spec, dtype=jnp.float32))
+    bad = np.ones(N)
+    bad[3] = np.nan
+    with pytest.raises(ValueError, match=r"energy contains 1 non-finite"
+                                         r".*index 3"):
+        t._stage_move_attr(bad, "energy")
+    overflow = np.ones(N)
+    overflow[5] = 1e300  # finite f64, inf in the f32 working dtype
+    with pytest.raises(ValueError, match="energy contains 1 non-finite"):
+        t._stage_move_attr(overflow, "energy")
+    # validate_inputs=False opts out of the finite check (shape checks
+    # still apply — they are free).
+    t2 = PumiTally(mesh, N, TallyConfig(scoring=spec, dtype=jnp.float32,
+                                        validate_inputs=False))
+    assert t2._stage_move_attr(overflow, "energy").shape == (N,)

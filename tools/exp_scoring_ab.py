@@ -1,0 +1,164 @@
+"""Filtered-scoring overhead A/B (r10).
+
+Two arms over the IDENTICAL disjoint-corridor box workload (same mesh,
+same seeds, continue-mode moves; half the particles transport in
+x < 0.5 at bin-0 energies, half in x > 0.5 at bin-1 energies — the
+single-bin-per-element structure that makes the bin telescoping check
+BITWISE, tests/test_scoring.py):
+
+- ``off``: the default engine (TallyConfig() — no scoring code runs);
+- ``on``:  ``scoring=ScoringSpec(EnergyFilter(2 bins),
+  [flux, heating, events])`` with per-move ``energy=`` staging.
+
+Reported, non-interactively (one JSON line — the r9 suite's
+scoring_ab stage and bench.py's scoring row both consume it):
+
+- both arms' moves/s and the relative scoring overhead;
+- the fenced per-move cost delta (``scoring_ms_per_move``) — the
+  whole hook: attribute staging + jitted bin resolution + the fused
+  lane scatter riding every walk group;
+- the BITWISE flux parity gate (scoring-on flux == scoring-off flux:
+  the flux scatter is untouched by the hook) and the BITWISE bin
+  telescoping gate (2-bin flux lanes sum == the flux lane), both
+  asserted before any number is reported;
+- the compiles-healthy contract: ``compiles.timed == 0`` — the
+  scoring-armed walk and the ``score_bins`` resolution compile once
+  each in the warmup moves, never inside the timed window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _corridor_batches(rng, n: int, moves: int):
+    """(src, [dests...], energy): disjoint half-box corridors with
+    bin-disjoint energies (module docstring)."""
+    half = n // 2
+
+    def pts():
+        p = np.empty((n, 3))
+        p[:half] = rng.uniform(
+            [0.05, 0.05, 0.05], [0.45, 0.95, 0.95], (half, 3)
+        )
+        p[half:] = rng.uniform(
+            [0.55, 0.05, 0.05], [0.95, 0.95, 0.95], (n - half, 3)
+        )
+        return p
+
+    energy = np.where(np.arange(n) < half, 0.5, 1.5)
+    return pts(), [pts() for _ in range(moves)], energy
+
+
+def run_ab(n: int = 100_000, div: int = 20, moves: int = 6,
+           warmup: int = 2) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import (
+        EnergyFilter,
+        PumiTally,
+        ScoringSpec,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(10)
+    src, dests, energy = _corridor_batches(rng, n, warmup + moves)
+    spec = ScoringSpec(
+        filters=[EnergyFilter([0.0, 1.0, 2.0])],
+        scores=["flux", "heating", "events"],
+    )
+
+    def build(scoring) -> PumiTally:
+        return PumiTally(
+            mesh, n,
+            TallyConfig(scoring=scoring, check_found_all=False,
+                        fenced_timing=False),
+        )
+
+    def drive(t, ds, scored: bool):
+        for d in ds:
+            t.MoveToNextLocation(
+                None, d.reshape(-1).copy(),
+                energy=energy if scored else None,
+            )
+
+    t_on = build(spec)
+    with retrace_guard(raise_on_exceed=False) as guard:
+        t_on.CopyInitialPosition(src.reshape(-1).copy())
+        drive(t_on, dests[:warmup], True)
+        jax.block_until_ready((t_on.flux, t_on.score_bank))
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            t0 = time.perf_counter()
+            drive(t_on, dests[warmup:], True)
+            jax.block_until_ready((t_on.flux, t_on.score_bank))
+            on_s = time.perf_counter() - t0
+
+    t_off = build(None)
+    t_off.CopyInitialPosition(src.reshape(-1).copy())
+    drive(t_off, dests[:warmup], False)
+    jax.block_until_ready(t_off.flux)
+    t0 = time.perf_counter()
+    drive(t_off, dests[warmup:], False)
+    jax.block_until_ready(t_off.flux)
+    off_s = time.perf_counter() - t0
+
+    # Parity gates, enforced where the measurement happens
+    # (RuntimeError, not sys.exit — bench.py wraps this row in a
+    # best-effort except, exp_stats_ab precedent).
+    if not bool(jnp.all(t_on.flux == t_off.flux)):
+        raise RuntimeError(
+            "scoring-on flux diverged bitwise from scoring-off flux"
+        )
+    arr = np.asarray(t_on.score_bank).reshape(mesh.nelems, 2, 3)
+    if not np.array_equal(arr[:, :, 0].sum(axis=1),
+                          np.asarray(t_on.flux)):
+        raise RuntimeError(
+            "2-bin flux lanes do not telescope bitwise to the flux lane"
+        )
+
+    moves_total = n * moves
+    return {
+        "row": "scoring",
+        "on_moves_per_sec": moves_total / on_s,
+        "off_moves_per_sec": moves_total / off_s,
+        "scoring_overhead_pct": (on_s - off_s) / off_s * 100.0,
+        "scoring_ms_per_move": (on_s - off_s) / moves * 1e3,
+        "flux_parity_bitwise": True,
+        "telescoping_bitwise": True,
+        "events_total": float(arr[:, :, 2].sum()),
+        "lanes": {"n_bins": 2, "n_scores": 3,
+                  "bank_elems": int(mesh.nelems * 6)},
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div**3, "moves": moves,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 6))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves), default=float))
+
+
+if __name__ == "__main__":
+    main()
