@@ -521,6 +521,28 @@ def run_sentinel_ab() -> dict | None:
     )
 
 
+def run_service_ab() -> dict | None:
+    """Component row: the multi-session service layer's cost
+    (tools/exp_service_ab.py run_ab) — a 1-session service vs the
+    direct facade on the identical workload (flux parity asserted
+    BITWISE inside the tool: the single-session corner of the
+    determinism-under-concurrency contract), the fenced-vs-pipelined
+    served throughput spread (the measured value of cross-move
+    overlap through the futures pipeline), and the compiles-healthy
+    contract — ``compiles.timed == 0``: the service adds NO jitted
+    entry points, every compile is the facade's own in warmup.
+    Reduced shape (100k particles) like the other component rows;
+    best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_service_ab
+
+    return exp_service_ab.run_ab(
+        n=min(N, 100_000), div=MESH_DIV, moves=2, batches=8
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -944,6 +966,12 @@ def _measure_and_report() -> None:
             sentinel = run_sentinel_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# sentinel A/B failed: {e}", file=sys.stderr)
+    service = None
+    if os.environ.get("PUMIUMTALLY_BENCH_SERVICE", "1") != "0":
+        try:
+            service = run_service_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# service A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -1092,6 +1120,12 @@ def _measure_and_report() -> None:
         # per-move audit cost, the on-arm health report, and the
         # compiles-healthy contract (compiles.timed == 0).
         "sentinel": sentinel,
+        # Multi-session service layer cost: 1-session service vs the
+        # direct facade (flux parity bitwise inside the tool), the
+        # fenced-vs-pipelined served throughput spread, and the
+        # compiles-healthy contract (compiles.timed == 0: the service
+        # adds no jitted entry points).
+        "service": service,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

@@ -36,6 +36,13 @@ from pumiumtally_tpu.sentinel import (
     HealthReport,
     SentinelPolicy,
 )
+from pumiumtally_tpu.service import (
+    ServiceBusyError,
+    ServiceDrainingError,
+    SessionClosedError,
+    SessionState,
+    TallyService,
+)
 
 __version__ = "0.1.0"
 
@@ -61,4 +68,9 @@ __all__ = [
     "EnginePoisonedError",
     "HealthReport",
     "SentinelPolicy",
+    "ServiceBusyError",
+    "ServiceDrainingError",
+    "SessionClosedError",
+    "SessionState",
+    "TallyService",
 ]

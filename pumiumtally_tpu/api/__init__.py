@@ -1,3 +1,22 @@
-from pumiumtally_tpu.api.tally import PumiTally, TallyTimes
+from pumiumtally_tpu.api.tally import (
+    PumiTally,
+    TallyTimes,
+    check_finite,
+    host_positions,
+    host_scalar_field,
+    zero_flying_side_effect,
+)
 
-__all__ = ["PumiTally", "TallyTimes"]
+# The host-staging helpers are re-exported for layers that prepack
+# caller buffers OUTSIDE a protocol call (the service's submit-time
+# staging, service/staging.py) — they are the single source of the
+# buffer-shape and finite-validation rules, so a prepacked move
+# refuses with exactly the errors a direct facade call would raise.
+__all__ = [
+    "PumiTally",
+    "TallyTimes",
+    "check_finite",
+    "host_positions",
+    "host_scalar_field",
+    "zero_flying_side_effect",
+]

@@ -173,6 +173,46 @@ def cmd_autotune(args) -> None:
               f"TallyConfig({settings})")
 
 
+def cmd_serve(args) -> None:
+    """Run the multi-session campaign service with the NDJSON socket
+    front end (service/server.py): external host codes attach as
+    independent sessions over TCP, each with its own facade, flux,
+    and checkpoint stream. SIGTERM drains: intake stops, in-flight
+    moves finish, every autosave-armed session writes one generation,
+    and the process exits 0 (preemption-safe serving)."""
+    import json as _json
+    import time as _time
+
+    from pumiumtally_tpu.mesh.tetmesh import TetMesh
+    from pumiumtally_tpu.service import SocketFrontend, TallyService
+
+    default_mesh = None
+    if args.mesh is not None:
+        coords, tets = _load(args.mesh)
+        default_mesh = TetMesh.from_arrays(coords, tets)
+    service = TallyService(handle_signals=True)
+    frontend = SocketFrontend(
+        service, host=args.host, port=args.port,
+        default_mesh=default_mesh, default_particles=args.particles,
+        allow_mesh_paths=args.allow_mesh_paths,
+        allow_write=args.allow_write,
+    )
+    frontend.start()
+    # One parseable line so drivers/tests can discover the bound port
+    # (--port 0 binds an ephemeral one).
+    print(_json.dumps({"serving": {"host": frontend.host,
+                                   "port": frontend.port}}), flush=True)
+    try:
+        while not service.drain_requested:
+            _time.sleep(0.1)
+        print("serve: drain requested; checkpointing open sessions",
+              flush=True)
+    finally:
+        frontend.stop()
+        service.shutdown(drain=True)
+    raise SystemExit(0)
+
+
 def _subproc_timeout() -> float:
     """Helper-subprocess timeout in seconds (default 1800). Deployments
     with slow toolchains raise it via PUMIUMTALLY_SUBPROC_TIMEOUT; the
@@ -321,6 +361,26 @@ def main(argv=None) -> None:
     c.add_argument("--particles", type=int, default=200_000)
     c.add_argument("--moves", type=int, default=3)
     c.set_defaults(fn=cmd_autotune)
+
+    c = sub.add_parser(
+        "serve",
+        help="run the multi-session campaign service (NDJSON over TCP)",
+    )
+    c.add_argument("--mesh", default=None,
+                   help="default mesh (.msh/.osh) for open requests "
+                        "that pass none")
+    c.add_argument("--particles", type=int, default=100_000,
+                   help="default num_particles for open requests")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (the bound port is printed as "
+                        "one JSON line)")
+    c.add_argument("--allow-mesh-paths", action="store_true",
+                   help="let open requests load meshes by filesystem "
+                        "path")
+    c.add_argument("--allow-write", action="store_true",
+                   help="let sessions write VTK output files")
+    c.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(
         "aot-check",

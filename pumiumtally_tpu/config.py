@@ -130,6 +130,17 @@ RETRACE_BUDGETS: dict = {
     # compiles.timed == 0 contract (tools/exp_resilience_ab.py) pins
     # that an autosave-armed engine compiles exactly what a bare one
     # does.
+    #
+    # The multi-session service (r11, pumiumtally_tpu/service) holds
+    # the same contract: threads, queues, and prepacked numpy buffers
+    # only — every device program a served session runs is its
+    # facade's own entry point, keyed exactly as a direct call would
+    # key it (sessions share the process jit cache, so N same-shaped
+    # sessions compile ONCE, not N times). No new entry points, no
+    # budget changes; re-measured over the r11 tier-1 with
+    # PUMIUMTALLY_RETRACE_RECORD — every per-test maximum stayed
+    # inside the r10 budgets — and pinned by the service bench row's
+    # compiles.timed == 0 (tools/exp_service_ab.py).
 }
 
 

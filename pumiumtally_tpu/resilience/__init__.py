@@ -24,7 +24,12 @@ from pumiumtally_tpu.resilience.generations import (
     ResumeInfo,
     resume_latest,
 )
-from pumiumtally_tpu.resilience.policy import AutosaveRunner, CheckpointPolicy
+from pumiumtally_tpu.resilience.policy import (
+    AutosaveRunner,
+    CheckpointPolicy,
+    install_drain_owner,
+    release_drain_owner,
+)
 from pumiumtally_tpu.utils.checkpoint import CorruptCheckpointError
 
 __all__ = [
@@ -35,6 +40,8 @@ __all__ = [
     "FaultSpec",
     "GenerationStore",
     "ResumeInfo",
+    "install_drain_owner",
     "parse_fault",
+    "release_drain_owner",
     "resume_latest",
 ]

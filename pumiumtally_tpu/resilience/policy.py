@@ -130,6 +130,29 @@ def _restore_signal_originals() -> None:
     _signal_originals.clear()
 
 
+def install_drain_owner(owner: Any) -> None:
+    """Hand the process-wide SIGTERM/SIGINT drain dispatch to ``owner``
+    — any object with a writable ``_drain`` flag (duck-typed: an
+    ``AutosaveRunner``, or the multi-session service, which drains
+    EVERY open session when its flag trips). Newest owner wins, the
+    second-signal escalation still restores the original dispositions
+    and re-delivers, and the originals are captured exactly once —
+    the same single-dispatcher invariant the runners rely on.
+    Idempotent: re-installing the current owner is a no-op (no
+    duplicate capture, no spurious warnings)."""
+    if _active_runner is owner:
+        return
+    _install_signal_dispatch(owner)
+
+
+def release_drain_owner(owner: Any) -> None:
+    """Detach ``owner`` from the drain dispatch (restores the original
+    signal dispositions iff ``owner`` is the current owner; a stale
+    release after a newer owner took over is a no-op)."""
+    if _active_runner is owner:
+        _restore_signal_originals()
+
+
 class AutosaveRunner:
     """Per-tally autosave engine (built by the facades from
     ``TallyConfig.checkpoint``; one per tally instance). The newest

@@ -168,6 +168,19 @@ class HealthReport:
     overflow_recoveries: int = 0
     capacity_escalations: int = 0
 
+    def as_dict(self) -> dict:
+        """Plain JSON-serializable summary (builtin ints/floats only)
+        — what the service layer embeds in drain-checkpoint metadata
+        and returns over the NDJSON socket's ``health`` op, and what
+        the A/B tools report. ``dataclasses.asdict`` would work too;
+        this pins the field set as API."""
+        import dataclasses
+
+        return {
+            k: (float(v) if isinstance(v, float) else int(v))
+            for k, v in dataclasses.asdict(self).items()
+        }
+
     def as_field_data(self) -> dict:
         """Scalar FIELD arrays for the VTK writers (float64 — legacy
         VTK field blocks are typed, and every writer already emits

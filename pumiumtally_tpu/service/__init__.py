@@ -1,0 +1,58 @@
+"""Multi-session campaign service (round 11, docs/DESIGN.md
+"Multi-session service").
+
+The five facades reproduce the reference's synchronous single-client
+protocol; this package is the serving layer over them — one device,
+many concurrent client sessions:
+
+- ``TallySession`` (session.py) — one facade per client, so flux /
+  scoring banks / statistics / sentinel health / autosave generations
+  stay per-session; bounded FIFO queue with ``ServiceBusyError``
+  backpressure; OPEN → DRAINING → CLOSED lifecycle.
+- ``DeficitRoundRobinScheduler`` (scheduler.py) — fair, work-
+  proportional interleaving of ready sessions; one hot client cannot
+  starve the rest (O(1) unfairness bound).
+- staging (staging.py) — double-buffered host-side prepack +
+  validation at submit time: clients get futures, never block on
+  device compute, and may recycle their buffers immediately.
+- ``TallyService`` / ``SessionHandle`` / ``SocketFrontend``
+  (server.py) — the in-process async client API, the NDJSON socket
+  front end (``pumiumtally serve``), and the process-wide SIGTERM
+  drain that checkpoints every open session through the resilience
+  dispatcher.
+
+Core contract — determinism under concurrency: each session's output
+is BITWISE the solo run of the same campaign, regardless of how the
+scheduler interleaves sessions (pinned by tests/test_service.py).
+Everything here is host-side Python (threads, queues, numpy buffers)
+— no jitted code, no new trace entry points
+(config.RETRACE_BUDGETS unchanged, same contract as resilience/).
+"""
+
+from pumiumtally_tpu.service.scheduler import DeficitRoundRobinScheduler
+from pumiumtally_tpu.service.session import (
+    DEFAULT_QUEUE_DEPTH,
+    ServiceBusyError,
+    SessionClosedError,
+    SessionState,
+    TallySession,
+)
+from pumiumtally_tpu.service.server import (
+    ServiceDrainingError,
+    SessionHandle,
+    SocketFrontend,
+    TallyService,
+)
+
+__all__ = [
+    "DEFAULT_QUEUE_DEPTH",
+    "DeficitRoundRobinScheduler",
+    "ServiceBusyError",
+    "ServiceDrainingError",
+    "SessionClosedError",
+    "SessionHandle",
+    "SessionState",
+    "SocketFrontend",
+    "TallyService",
+    "TallySession",
+]

@@ -1,0 +1,154 @@
+"""Deficit-round-robin scheduling over ready sessions.
+
+The service owns ONE device and many sessions; something must decide
+whose staged op runs next. Plain round-robin is fair in op COUNT but
+not in work: a session moving 500k particles per op would get 500k
+particle-moves for every 4k a small session gets per turn. Deficit
+round robin (Shreedhar & Varghese) fixes that with one counter per
+session:
+
+- sessions sit on a ring, visited in registration order;
+- each visit credits the session's deficit counter with a QUANTUM of
+  cost units; its head op runs iff its cost fits the accumulated
+  deficit (cost = particles touched for transport ops, 1 for reads —
+  staging.StagedOp.cost);
+- a served op's cost is debited; the visit continues on the same
+  session while further heads fit, then moves on;
+- a session whose queue empties forfeits its deficit (the classic DRR
+  reset — idle time banks no credit, so a bursty client cannot starve
+  the ring with saved-up quantum).
+
+Fairness contract (docs/DESIGN.md "Multi-session service"): over any
+window in which a set of sessions stays backlogged, the cost served to
+any two of them differs by at most one quantum plus one maximal op
+cost — O(1) unfairness, independent of queue depths, so one hot client
+cannot starve the rest. With the default AUTO quantum (the largest
+head cost currently queued) every visited backlogged session serves at
+least one op per ring pass, which also makes ``pick`` work-conserving
+in a single pass.
+
+The scheduler is a plain synchronous data structure — the service
+calls it under its own lock; nothing here blocks, allocates device
+memory, or touches jax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+
+class DeficitRoundRobinScheduler:
+    """DRR picker over registered session keys.
+
+    Args:
+      quantum: cost units credited per visit. None (default) = auto:
+        the largest head cost among currently backlogged sessions,
+        re-derived each pick — guarantees one-pass work conservation
+        while keeping service work-proportional when op costs differ.
+    """
+
+    def __init__(self, quantum: Optional[int] = None):
+        if quantum is not None and int(quantum) < 1:
+            raise ValueError(f"quantum must be >= 1, got {quantum!r}")
+        self._quantum = None if quantum is None else int(quantum)
+        self._keys: List[str] = []
+        self._deficit: dict = {}
+        self._cursor = 0
+        self._visiting: Optional[str] = None
+
+    # -- membership ------------------------------------------------------
+    def register(self, key: str) -> None:
+        if key in self._deficit:
+            raise ValueError(f"session {key!r} already registered")
+        self._keys.append(key)
+        self._deficit[key] = 0
+
+    def unregister(self, key: str) -> None:
+        idx = self._keys.index(key)
+        self._keys.pop(idx)
+        del self._deficit[key]
+        if self._visiting == key:
+            self._visiting = None
+        if idx < self._cursor:
+            self._cursor -= 1
+        if self._keys:
+            self._cursor %= len(self._keys)
+        else:
+            self._cursor = 0
+
+    @property
+    def keys(self) -> tuple:
+        return tuple(self._keys)
+
+    def deficit(self, key: str) -> int:
+        return self._deficit[key]
+
+    # -- picking ---------------------------------------------------------
+    def pick(
+        self, head_cost: Callable[[str], Optional[int]]
+    ) -> Optional[str]:
+        """The key whose head op should run next, charging its cost.
+
+        ``head_cost(key)`` returns the session's head-op cost, or None
+        when it has nothing queued. Returns None iff no session has
+        work. The caller must then actually pop and run that head op —
+        pick() has already debited it.
+        """
+        n = len(self._keys)
+        if n == 0:
+            return None
+        costs = {k: head_cost(k) for k in self._keys}
+        backlogged = [c for c in costs.values() if c is not None]
+        if not backlogged:
+            self._visiting = None
+            return None
+        quantum = self._quantum
+        if quantum is None:
+            quantum = max(1, max(backlogged))
+        # Continue the in-progress visit first: classic DRR serves one
+        # queue until its deficit is spent, THEN moves the ring.
+        if self._visiting is not None:
+            k = self._visiting
+            c = costs.get(k)
+            if c is not None and c <= self._deficit[k]:
+                self._deficit[k] -= c
+                return k
+            if c is None and k in self._deficit:
+                self._deficit[k] = 0  # emptied: forfeit banked credit
+            self._visiting = None
+        # Ring scan. With auto quantum the first backlogged session
+        # serves immediately; with a small manual quantum the deficit
+        # accumulates across passes until a head fits. An unserved
+        # full pass jumps the deficit clock ARITHMETICALLY (every
+        # backlogged session is about to receive the same m quanta
+        # anyway, in ring order — crediting m-1 of them in bulk
+        # changes nothing but skips O(cost/quantum) spin passes under
+        # the service lock).
+        while True:
+            served_none = True
+            for _ in range(n):
+                k = self._keys[self._cursor]
+                self._cursor = (self._cursor + 1) % n
+                c = costs[k]
+                if c is None:
+                    self._deficit[k] = 0
+                    continue
+                self._deficit[k] += quantum
+                if c <= self._deficit[k]:
+                    self._deficit[k] -= c
+                    self._visiting = k
+                    return k
+                served_none = False  # backlogged but not yet affordable
+            if served_none:
+                # Only emptied queues were seen this pass (cannot
+                # happen: backlogged was non-empty and costs are
+                # fixed for this pick) — guard against livelock.
+                return None
+            passes_needed = min(
+                -(-(costs[k] - self._deficit[k]) // quantum)
+                for k in self._keys if costs[k] is not None
+            )
+            if passes_needed > 1:
+                for k in self._keys:
+                    if costs[k] is not None:
+                        self._deficit[k] += (passes_needed - 1) * quantum

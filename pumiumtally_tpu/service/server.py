@@ -1,0 +1,890 @@
+"""The multi-tenant campaign service: device owner + client front ends.
+
+``TallyService`` owns the device on behalf of any number of concurrent
+client sessions. ONE worker thread executes every facade call — the
+serialization point that makes multi-tenancy deterministic:
+
+- per-session ops run in strict FIFO order (session.py), so each
+  session's campaign is the exact op sequence its client submitted;
+- sessions interleave under deficit round robin (scheduler.py), which
+  bounds cross-session unfairness by a constant but has NO influence
+  on values — sessions share nothing but the device and the jit cache
+  (compiled code, no state), so a session's flux is bitwise the solo
+  run of its campaign whatever the interleaving;
+- reads (flux, health, statistics) ride the same FIFO as transport
+  ops, so a read observes exactly the moves submitted before it.
+
+Clients never block on device compute: ``SessionHandle`` methods
+prepack + validate on the calling thread (staging.py), enqueue, and
+return a ``concurrent.futures.Future``. A full queue refuses with
+``ServiceBusyError`` at submit (admission control) — nothing partial
+ever enters the pipeline.
+
+Drain: the service registers with the resilience layer's process-wide
+signal dispatcher (resilience.install_drain_owner — the SAME
+single-owner mechanism a bare autosave-armed facade uses, so a second
+SIGTERM still escalates to an immediate kill). The first SIGTERM sets
+the drain flag: every session stops accepting work, in-flight and
+queued ops finish, and ``shutdown(drain=True)`` writes one checkpoint
+generation per autosave-armed session before the process exits 0.
+Per-session ``CheckpointPolicy``s should carry
+``handle_signals=False`` — the service owns the handler.
+
+The NDJSON socket front end (``SocketFrontend`` / the ``pumiumtally
+serve`` CLI verb) lets external host codes attach as independent
+sessions: one JSON object per line, arrays as base64 little-endian
+raw bytes (f64 positions/weights/energy/time, int8 flying). It trusts
+its network: no authentication, mesh-path loading disabled unless
+explicitly allowed — deploy it behind the same perimeter as the host
+codes it serves.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import os
+import socket
+import threading
+import warnings
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pumiumtally_tpu.service import staging
+from pumiumtally_tpu.service.scheduler import DeficitRoundRobinScheduler
+from pumiumtally_tpu.service.session import (
+    ServiceBusyError,
+    SessionClosedError,
+    SessionState,
+    TallySession,
+)
+
+
+class ServiceDrainingError(RuntimeError):
+    """The service received a drain request (SIGTERM or shutdown) and
+    accepts no new work. Distinct from ``ServiceBusyError`` on
+    purpose: busy means retry, draining means finish up and detach."""
+
+
+class TallyService:
+    """Multi-session campaign service (in-process API).
+
+    Args:
+      handle_signals: own the process's SIGTERM/SIGINT graceful-drain
+        handler via the resilience dispatcher (main thread only).
+      quantum: scheduler quantum in cost units (None = auto; see
+        scheduler.DeficitRoundRobinScheduler).
+      autostart: start the worker thread lazily on the first submit
+        (False = the caller starts it explicitly — the backpressure
+        tests stage against a stopped worker deterministically).
+    """
+
+    def __init__(self, *, handle_signals: bool = False,
+                 quantum: Optional[int] = None, autostart: bool = True):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._sessions: Dict[str, TallySession] = {}
+        self._sched = DeficitRoundRobinScheduler(quantum=quantum)
+        self._seq = itertools.count(1)
+        self._drain = False  # the resilience dispatcher's duck-typed flag
+        self._stop = False
+        self._inflight = 0
+        self._autostart = bool(autostart)
+        self._handle_signals = bool(handle_signals)
+        self._worker: Optional[threading.Thread] = None
+        if self._handle_signals:
+            from pumiumtally_tpu.resilience import install_drain_owner
+
+            install_drain_owner(self)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._worker is not None or self._stop:
+                return
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="pumiumtally-service",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def __enter__(self) -> "TallyService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.shutdown(drain=exc_type is None)
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain
+
+    def request_drain(self) -> None:
+        """What the SIGTERM handler effects: stop intake everywhere;
+        queued and in-flight work still completes. The controlling
+        loop (CLI serve / a driver) observes ``drain_requested`` and
+        calls ``shutdown(drain=True)``."""
+        with self._cv:
+            self._drain = True
+            for sess in self._sessions.values():
+                sess.begin_drain()
+            self._cv.notify_all()
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """Stop intake, finish every queued op, optionally write one
+        drain checkpoint per autosave-armed open session, stop the
+        worker. Returns ``{session_id: (generation, path) | None}``
+        for the sessions drained (empty when ``drain=False``)."""
+        self.request_drain()
+        with self._lock:
+            has_pending = bool(self._inflight) or any(
+                s.pending() for s in self._sessions.values()
+            )
+        if has_pending:
+            # Queued ops always complete before the service stops —
+            # even when the worker was never started (autostart=False
+            # and a shutdown before start()).
+            self.start()
+        saved: Dict[str, Any] = {}
+        with self._cv:
+            quiesced = self._cv.wait_for(
+                lambda: self._inflight == 0 and not any(
+                    s.pending() for s in self._sessions.values()
+                ),
+                timeout=timeout,
+            )
+            sessions = list(self._sessions.values())
+        if not quiesced:
+            # Never checkpoint while the worker may still be mutating
+            # facade state — a mid-move snapshot would break the
+            # bitwise-resume guarantee. The service stays draining;
+            # the caller can retry shutdown.
+            raise TimeoutError(
+                f"service did not quiesce within {timeout}s; no drain "
+                "checkpoints written — retry shutdown()"
+            )
+        # Checkpoints OUTSIDE the lock: saves fetch device arrays and
+        # fsync — nothing a submit (they all refuse now) can race.
+        # Per-session containment: one session's failing store (ENOSPC,
+        # EACCES) must not cost the OTHER sessions their generations,
+        # nor skip the worker-stop/handler-release below — the drained
+        # process still exits 0 for the sessions whose storage is
+        # healthy.
+        for sess in sessions:
+            if drain and sess.state is not SessionState.CLOSED:
+                try:
+                    saved[sess.id] = sess.drain_checkpoint()
+                except Exception as e:  # noqa: BLE001 — see above
+                    warnings.warn(
+                        f"session {sess.id!r}: drain checkpoint "
+                        f"failed ({e!r}); its state is lost but the "
+                        "drain continues"
+                    )
+                    saved[sess.id] = None
+            sess.mark_closed()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout=timeout)
+        if self._handle_signals:
+            from pumiumtally_tpu.resilience import release_drain_owner
+
+            release_drain_owner(self)
+        return saved
+
+    # -- sessions --------------------------------------------------------
+    def open_session(self, tally, *, session_id: Optional[str] = None,
+                     max_queue: Optional[int] = None) -> "SessionHandle":
+        """Admit one client: wrap its facade (any of the five kinds,
+        built by the caller so the client picks engine/config) in a
+        session and register it with the scheduler."""
+        with self._lock:
+            if self._drain or self._stop:
+                raise ServiceDrainingError(
+                    "service is draining: no new sessions"
+                )
+            sid = session_id
+            if sid is None:
+                # The generator must skip ids a caller claimed
+                # explicitly — open_session(session_id="s1") then
+                # open_session() would otherwise refuse the caller
+                # who passed nothing.
+                sid = f"s{next(self._seq)}"
+                while sid in self._sessions:
+                    sid = f"s{next(self._seq)}"
+            if sid in self._sessions:
+                raise ValueError(f"session id {sid!r} already open")
+            kw = {} if max_queue is None else {"max_queue": max_queue}
+            sess = TallySession(sid, tally, **kw)
+            self._sessions[sid] = sess
+            self._sched.register(sid)
+        if self._handle_signals and (
+            threading.current_thread() is threading.main_thread()
+        ):
+            # Newest owner wins in the dispatcher; re-assert ownership
+            # in case a session's facade installed its own runner.
+            # Main thread only: Python cannot (re)bind handlers
+            # elsewhere, and a socket-thread open would otherwise
+            # trigger the dispatcher's misleading not-main-thread
+            # warning (the handler installed at construction stays in
+            # force regardless).
+            from pumiumtally_tpu.resilience import install_drain_owner
+
+            install_drain_owner(self)
+        return SessionHandle(self, sess)
+
+    def session_ids(self) -> tuple:
+        with self._lock:
+            return tuple(self._sessions)
+
+    # -- submission (called by SessionHandle) -----------------------------
+    def _submit(self, sess: TallySession, op: staging.StagedOp) -> Future:
+        with self._cv:
+            if self._drain or self._stop:
+                raise ServiceDrainingError(
+                    "service is draining: no new work accepted"
+                )
+            sess.submit(op)
+            self._cv.notify_all()
+        if self._autostart:
+            self.start()
+        return op.future
+
+    def _close_session(self, sess: TallySession) -> Future:
+        """Queue the session-close sentinel: runs after every already
+        queued op, writes the drain checkpoint (if armed), closes the
+        session, releases its scheduler slot. Idempotent while the
+        sentinel is in flight: a repeated close returns the SAME
+        future (a second sentinel could never run once the first one
+        unregisters the session)."""
+        def _finalize(tally):
+            # finally: a failing session_close checkpoint still
+            # CLOSES the session (the exception reaches the client
+            # through the close future) — otherwise the facade would
+            # leak in the scheduler ring forever behind a cached
+            # failed future.
+            try:
+                return sess.drain_checkpoint(reason="session_close")
+            finally:
+                with self._cv:
+                    sess.mark_closed()
+                    self._sched.unregister(sess.id)
+                    self._sessions.pop(sess.id, None)
+                    self._cv.notify_all()
+
+        op = staging.stage_call("close", _finalize)
+        with self._cv:
+            if sess.close_future is not None:
+                return sess.close_future  # idempotent repeat close
+            if sess.state is SessionState.CLOSED:
+                raise SessionClosedError(
+                    f"session {sess.id!r} is already closed"
+                )
+            if self._drain or self._stop:
+                raise ServiceDrainingError(
+                    "service is draining: it closes every session "
+                    "itself at shutdown"
+                )
+            sess.begin_drain()
+            sess.submit_final(op)
+            sess.close_future = op.future
+            self._cv.notify_all()
+        if self._autostart:
+            self.start()
+        return op.future
+
+    # -- worker ----------------------------------------------------------
+    def _head_cost(self, sid: str) -> Optional[int]:
+        sess = self._sessions.get(sid)
+        return None if sess is None else sess.head_cost()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                sid = self._sched.pick(self._head_cost)
+                if sid is None:
+                    if self._stop:
+                        return
+                    # Every producer notifies this condition (_submit,
+                    # _close_session, request_drain, shutdown), so the
+                    # timeout is only a liveness safety net, not the
+                    # wake mechanism — long enough that an idle server
+                    # barely wakes, short enough that a missed notify
+                    # could never hang a drain.
+                    self._cv.wait(1.0)
+                    continue
+                sess = self._sessions[sid]
+                op = sess.pop()
+                self._inflight += 1
+            # Execute OUTSIDE the lock: device work must never block
+            # staging/submission on the client threads.
+            try:
+                result = staging.execute_op(sess.tally, op)
+            except SystemExit as e:
+                # A facade-level drain exit (e.g. checkpoint_now with a
+                # pending runner drain) must not kill the worker; fold
+                # it into a service-wide drain instead.
+                op.future.set_exception(e)
+                self.request_drain()
+            except BaseException as e:  # noqa: BLE001 — server boundary:
+                # one client's failing op must not take the worker (and
+                # every other session) down; the exception travels to
+                # exactly that client through its future.
+                op.future.set_exception(e)
+            else:
+                op.future.set_result(result)
+            with self._cv:
+                self._inflight -= 1
+                sess.note_completed(op)
+                self._cv.notify_all()
+
+
+class SessionHandle:
+    """A client's view of its session: the three-call protocol plus
+    reads, each returning a ``concurrent.futures.Future`` that resolves
+    when the op executes (in submission order). Prepack + validation
+    run synchronously on the caller's thread — errors raise HERE, and
+    the caller's buffers are free for reuse the moment a method
+    returns."""
+
+    def __init__(self, service: TallyService, session: TallySession):
+        self._service = service
+        self._session = session
+
+    @property
+    def id(self) -> str:
+        return self._session.id
+
+    @property
+    def state(self) -> SessionState:
+        return self._session.state
+
+    @property
+    def pending(self) -> int:
+        """Ops currently queued (staged but not yet executed)."""
+        return self._session.pending()
+
+    @property
+    def tally(self):
+        """The wrapped facade. Read-only inspection between resolved
+        futures only — mutating protocol calls MUST go through the
+        handle (the worker owns execution order)."""
+        return self._session.tally
+
+    # -- protocol --------------------------------------------------------
+    def copy_initial_position(self, positions, size: Optional[int] = None
+                              ) -> Future:
+        op = staging.stage_source(self._session.tally, positions, size)
+        return self._service._submit(self._session, op)
+
+    def move(self, particle_origin, particle_destinations, flying=None,
+             weights=None, size: Optional[int] = None, energy=None,
+             time=None) -> Future:
+        """Stage one ``MoveToNextLocation``. Flying-buffer semantics
+        mirror the direct protocol as far as an async API can: a
+        refusal HERE (validation error, ``ServiceBusyError``) leaves
+        the caller's flying buffer untouched, so the retry stages the
+        same bytes. But acceptance zeroes it immediately — submit is
+        the last moment the buffer is still the caller's to write —
+        so an op that later fails at EXECUTION (e.g. move before
+        source, poisoned facade; surfaced on the future) differs from
+        a direct call, which raises before zeroing: after an errored
+        future, re-stage ``flying`` explicitly rather than re-sending
+        the (now zeroed) buffer."""
+        op = staging.stage_move(
+            self._session.tally, particle_origin, particle_destinations,
+            flying, weights, size, energy, time,
+        )
+        fut = self._service._submit(self._session, op)
+        # The protocol's host side effect, applied only once the op is
+        # ACCEPTED: a ServiceBusyError above leaves the caller's
+        # buffers untouched, so the retry stages identical bytes (the
+        # staged int8 copy inside the op is what transports).
+        staging.zero_flying_side_effect(flying,
+                                        self._session.tally.num_particles)
+        return fut
+
+    def close_batch(self, trigger=None) -> Future:
+        return self._call("close_batch",
+                          lambda t: t.close_batch(trigger=trigger))
+
+    def finalize(self) -> Future:
+        return self._call("finalize", lambda t: t.finalize())
+
+    def write(self, filename: Optional[str] = None) -> Future:
+        return self._call("write", lambda t: t.WriteTallyResults(filename))
+
+    def checkpoint(self, **meta) -> Future:
+        return self._call("checkpoint", lambda t: t.checkpoint_now(**meta))
+
+    # -- reads (FIFO-consistent: they observe every prior submitted op) --
+    def flux(self) -> Future:
+        return self._call("flux", lambda t: np.asarray(t.flux))
+
+    def normalized_flux(self) -> Future:
+        return self._call("normalized_flux",
+                          lambda t: np.asarray(t.normalized_flux()))
+
+    def score_bank(self) -> Future:
+        return self._call("score_bank", lambda t: np.asarray(t.score_bank))
+
+    def health_report(self) -> Future:
+        return self._call("health", lambda t: t.health_report())
+
+    def batch_statistics(self) -> Future:
+        return self._call("batch_statistics",
+                          lambda t: t.batch_statistics())
+
+    def lost_particles(self) -> Future:
+        return self._call("lost_particles", lambda t: t.lost_particles)
+
+    def _call(self, label: str, fn) -> Future:
+        return self._service._submit(
+            self._session, staging.stage_call(label, fn)
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> Future:
+        """Drain this session: queued ops finish, one checkpoint
+        generation is written (when autosave is armed), the session
+        leaves the scheduler ring. The future resolves to the
+        ``(generation, path)`` saved, or None."""
+        return self._service._close_session(self._session)
+
+
+# ---------------------------------------------------------------------------
+# NDJSON socket front end
+# ---------------------------------------------------------------------------
+
+_WIRE_F64 = np.dtype("<f8")
+_WIRE_I8 = np.dtype("<i1")
+
+
+def _decode_array(payload: str, dtype) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(payload), dtype=dtype).copy()
+
+
+def _encode_array(a: np.ndarray) -> str:
+    # One conversion: ascontiguousarray handles dtype AND byte order
+    # (the explicit .astype('<f8') it replaces copied a second time
+    # even on little-endian hosts, where '<f8' IS float64).
+    return base64.b64encode(
+        np.ascontiguousarray(a, dtype=_WIRE_F64).tobytes()
+    ).decode("ascii")
+
+
+class SocketFrontend:
+    """Newline-delimited-JSON TCP front end over a ``TallyService``.
+
+    One request object per line, one response object per line. Ops:
+
+    - ``{"op": "open", "facade": "mono"|"stream"|"part",
+         "num_particles": n, "mesh": {"box": [lx,ly,lz,nx,ny,nz]}?,
+         "chunk_size": c?, "batch_stats": bool?, "sentinel": bool?,
+         "checkpoint_dir": path?}`` → ``{"ok": true, "session": id}``.
+      Omitted mesh = the server's default; ``{"path": ...}`` meshes
+      need ``allow_mesh_paths=True`` (the CLI's --allow-mesh-paths).
+      ``checkpoint_dir`` must be unique per open session (one
+      generation store per session); an in-use dir refuses.
+    - ``{"op": "source"|"move", "session": id, ...arrays...,
+         "wait": bool?}`` — arrays base64 little-endian (f64
+      positions/origins/dests/weights/energy/time, int8 flying).
+      ``wait`` false acks after staging (pipelining); surface errors
+      later via "sync". The direct protocol's host side effect —
+      ``MoveToNextLocation`` zeroes the caller's flying buffer in
+      place — cannot reach across the wire: the server zeroes only
+      its decoded copy, so a remote client porting from the in-process
+      API must zero its OWN flying buffer after any accepted move
+      (``"ok": true`` without ``"busy"``; a busy refusal means the
+      buffer is untouched and the retry resends the same bytes).
+    - ``{"op": "sync", "session": id}`` — wait for every pending op of
+      this connection's session, report the first failure.
+    - ``{"op": "flux"|"normalized_flux"|"health"|"lost", "session": id}``
+    - ``{"op": "close_batch"|"finalize"|"write"|"close", "session": id}``
+      ("write" takes "filename"; refused unless ``allow_write``).
+    - ``{"op": "ping"}`` → ``{"ok": true, "draining": bool}``.
+
+    Failures answer ``{"ok": false, "error": <class>, "message": ...}``
+    with ``"busy": true`` for backpressure refusals — the remote
+    client's retry signal.
+    """
+
+    def __init__(self, service: TallyService, host: str = "127.0.0.1",
+                 port: int = 0, *, default_mesh=None,
+                 default_particles: int = 100_000,
+                 allow_mesh_paths: bool = False, allow_write: bool = False):
+        self.service = service
+        self.default_mesh = default_mesh
+        self.default_particles = int(default_particles)
+        self.allow_mesh_paths = bool(allow_mesh_paths)
+        self.allow_write = bool(allow_write)
+        self._srv = socket.create_server((host, int(port)))
+        # Timeout-based accept: closing a listening socket does not
+        # reliably wake a blocked accept() on all platforms, so stop()
+        # would otherwise hang until its join timeout. The loop wakes
+        # every 250 ms to observe _closing.
+        self._srv.settimeout(0.25)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closing = False
+        # checkpoint_dir reservations, across ALL connections: two
+        # sessions sharing a directory would share one GenerationStore
+        # — keep-pruning then deletes the OTHER session's generations
+        # and "one drain generation per session" silently collapses.
+        # An open naming an in-use dir refuses with a structured error.
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_reserved: set = set()  # realpaths in use
+        self._ckpt_by_sid: Dict[str, str] = {}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self.service.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="pumiumtally-serve-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._srv.accept()
+            except TimeoutError:
+                continue  # periodic _closing check (see settimeout)
+            except OSError:
+                return  # socket closed
+            conn.settimeout(None)  # connections block; only accept polls
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+            )
+            t.start()
+            # Prune finished connection threads so a long-lived server
+            # handling many short connections stays bounded.
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    # -- checkpoint-dir reservations --------------------------------------
+    def _reserve_ckpt_dir(self, ck) -> Optional[str]:
+        """Reserve an open request's checkpoint_dir (realpath, so two
+        spellings of one directory collide); None when the request has
+        no checkpointing. Raises on a dir another open session holds."""
+        if not ck:
+            return None
+        ckreal = os.path.realpath(str(ck))
+        with self._ckpt_lock:
+            if ckreal in self._ckpt_reserved:
+                raise ValueError(
+                    f"checkpoint_dir {str(ck)!r} is already in use by "
+                    "an open session — give each session its own "
+                    "directory (a shared dir shares one generation "
+                    "store, whose pruning would delete the other "
+                    "session's checkpoints)"
+                )
+            self._ckpt_reserved.add(ckreal)
+        return ckreal
+
+    def _release_ckpt_dir(self, sid: str) -> None:
+        with self._ckpt_lock:
+            d = self._ckpt_by_sid.pop(sid, None)
+            if d is not None:
+                self._ckpt_reserved.discard(d)
+
+    # -- per-connection protocol -----------------------------------------
+    def _serve_conn(self, conn: socket.socket) -> None:
+        handles: Dict[str, SessionHandle] = {}
+        pending: Dict[str, List[Future]] = {}
+        dropped: Dict[str, int] = {}  # failures pruned past the cap
+        try:
+            with conn, conn.makefile("rwb") as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        reply = self._dispatch(
+                            json.loads(line.decode("utf-8")), handles,
+                            pending, dropped,
+                        )
+                    except Exception as e:  # noqa: BLE001 — protocol
+                        # boundary: EVERY malformed request (bad
+                        # base64, wrong types, unknown sessions, busy
+                        # queues) answers a structured error; only a
+                        # dead peer drops the connection.
+                        reply = {
+                            "ok": False,
+                            "error": type(e).__name__,
+                            "message": str(e),
+                            "busy": isinstance(e, ServiceBusyError),
+                        }
+                    f.write(json.dumps(reply, default=float)
+                            .encode("utf-8") + b"\n")
+                    f.flush()
+        except (OSError, json.JSONDecodeError):
+            pass  # peer went away / sent garbage: drop the connection
+        finally:
+            # Connection-scoped sessions: a client that vanishes
+            # without close must not leak its facades (device arrays)
+            # into the scheduler ring forever. Best-effort drain-close
+            # each one (writes the usual session_close checkpoint when
+            # autosave is armed).
+            for h in list(handles.values()):
+                try:
+                    fut = h.close()
+                except (ServiceDrainingError, SessionClosedError):
+                    # shutdown owns them now / already closed — the
+                    # drain (or the earlier close) writes the
+                    # checkpoint, so the reservation can go now.
+                    self._release_ckpt_dir(h.id)
+                else:
+                    # close() only QUEUES the sentinel that writes the
+                    # drain checkpoint — releasing the dir here would
+                    # let a new open reuse it while that write is
+                    # still in flight (two GenerationStores sharing a
+                    # dir = mutual keep-prune data loss). Release when
+                    # the close op actually resolves, either way.
+                    fut.add_done_callback(
+                        lambda _f, sid=h.id: self._release_ckpt_dir(sid)
+                    )
+
+    def _dispatch(self, req: dict, handles: Dict[str, SessionHandle],
+                  pending: Dict[str, List[Future]],
+                  dropped: Dict[str, int]) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "draining": self.service.drain_requested}
+        if op == "open":
+            ckreal = self._reserve_ckpt_dir(req.get("checkpoint_dir"))
+            try:
+                h = self.service.open_session(
+                    self._build_tally(req),
+                    max_queue=req.get("max_queue"),
+                )
+            except BaseException:
+                if ckreal is not None:
+                    with self._ckpt_lock:
+                        self._ckpt_reserved.discard(ckreal)
+                raise
+            if ckreal is not None:
+                with self._ckpt_lock:
+                    self._ckpt_by_sid[h.id] = ckreal
+            handles[h.id] = h
+            pending[h.id] = []
+            return {"ok": True, "session": h.id}
+        if op not in ("source", "move", "sync", "flux",
+                      "normalized_flux", "health", "lost", "close_batch",
+                      "finalize", "write", "close"):
+            raise ValueError(f"unknown op {op!r}")
+        h = handles[req["session"]]  # KeyError → error reply
+        waitlist = pending[h.id]
+        if op == "source":
+            fut = h.copy_initial_position(
+                _decode_array(req["positions"], _WIRE_F64)
+            )
+            return self._ack(fut, waitlist, dropped, h.id, req)
+        if op == "move":
+            def arr(key, dtype=_WIRE_F64):
+                return (
+                    None if key not in req
+                    else _decode_array(req[key], dtype)
+                )
+            fut = h.move(
+                arr("origins"), _decode_array(req["dests"], _WIRE_F64),
+                flying=arr("flying", _WIRE_I8), weights=arr("weights"),
+                energy=arr("energy"), time=arr("time"),
+            )
+            return self._ack(fut, waitlist, dropped, h.id, req)
+        if op == "sync":
+            return self._sync(waitlist, dropped, h.id)
+        if op == "flux":
+            return {"ok": True, "dtype": "float64",
+                    "flux": _encode_array(h.flux().result())}
+        if op == "normalized_flux":
+            return {"ok": True, "dtype": "float64",
+                    "flux": _encode_array(h.normalized_flux().result())}
+        if op == "health":
+            return {"ok": True, "health": h.health_report().result()
+                    .as_dict()}
+        if op == "lost":
+            return {"ok": True,
+                    "lost_particles": h.lost_particles().result()}
+        if op == "close_batch":
+            r = h.close_batch().result()
+            out = {"ok": True}
+            if r is not None:
+                out["trigger"] = {
+                    "converged": bool(r.converged),
+                    "value": float(r.value),
+                    "batches_remaining": r.batches_remaining,
+                }
+            return out
+        if op == "finalize":
+            h.finalize().result()
+            return {"ok": True}
+        if op == "write":
+            if not self.allow_write:
+                raise RuntimeError(
+                    "write is disabled on this server (start with "
+                    "allow_write / --allow-write to enable VTK output)"
+                )
+            h.write(req.get("filename")).result()
+            return {"ok": True}
+        # op == "close" (the allowlist above is exhaustive)
+        fut = h.close()
+        try:
+            saved = fut.result()
+        finally:
+            # The session is closed/unregistered even when its drain
+            # checkpoint failed (_finalize's finally) — drop the wire
+            # bookkeeping and the dir reservation either way, so a
+            # retry gets an honest "unknown session" instead of the
+            # cached failure forever, and the dir is reusable.
+            handles.pop(h.id, None)
+            pending.pop(h.id, None)
+            dropped.pop(h.id, None)
+            self._release_ckpt_dir(h.id)
+        return {"ok": True, "checkpoint": saved}
+
+    # Resolved failures retained for the next "sync", per session. The
+    # bound matters: without it a pipeline-forever driver whose session
+    # persistently fails (e.g. a poisoned facade failing every move)
+    # would grow the waitlist O(ops). Beyond the cap the OLDEST
+    # resolved failures are dropped and counted; sync reports the
+    # count. Unresolved futures are never dropped (their verdict isn't
+    # known yet) and are bounded by the session queue depth anyway.
+    _MAX_RETAINED_FAILURES = 32
+
+    def _ack(self, fut: Future, waitlist: List[Future],
+             dropped: Dict[str, int], sid: str, req: dict) -> dict:
+        if req.get("wait", True):
+            fut.result()  # raises → error reply path
+            return {"ok": True}
+        # Prune resolved SUCCESSFUL futures so a driver that pipelines
+        # forever without ever sending "sync" stays bounded; failures
+        # are retained (up to the cap above) for the next sync.
+        waitlist[:] = [
+            x for x in waitlist
+            if not (x.done() and x.exception() is None)
+        ]
+        resolved = [x for x in waitlist if x.done()]
+        overflow = len(resolved) - self._MAX_RETAINED_FAILURES + 1
+        if overflow > 0:
+            drop = set(id(x) for x in resolved[:overflow])
+            waitlist[:] = [x for x in waitlist if id(x) not in drop]
+            dropped[sid] = dropped.get(sid, 0) + len(drop)
+        waitlist.append(fut)
+        return {"ok": True, "queued": True}
+
+    def _sync(self, waitlist: List[Future], dropped: Dict[str, int],
+              sid: str) -> dict:
+        # Await EVERY future before clearing: raising out of the loop
+        # at the first failure would clear (and so silently discard)
+        # any later failures still on the list — the one thing _ack's
+        # retention promise forbids. One reply surfaces them all,
+        # including the count of failures dropped past the cap.
+        failures: List[BaseException] = []
+        for fut in waitlist:
+            e = fut.exception()
+            if e is not None:
+                failures.append(e)
+        waitlist.clear()
+        ndropped = dropped.pop(sid, 0)
+        if failures or ndropped:
+            if len(failures) == 1 and not ndropped:
+                raise failures[0]
+            parts = [f"{type(e).__name__}: {e}" for e in failures]
+            if ndropped:
+                parts.append(
+                    f"(+{ndropped} earlier failures dropped past the "
+                    f"{self._MAX_RETAINED_FAILURES}-entry retention cap)"
+                )
+            raise RuntimeError(
+                f"{len(failures) + ndropped} pipelined ops failed: "
+                + "; ".join(parts)
+            )
+        return {"ok": True}
+
+    # -- session construction --------------------------------------------
+    def _build_tally(self, req: dict):
+        from pumiumtally_tpu import (
+            CheckpointPolicy,
+            PartitionedPumiTally,
+            PumiTally,
+            SentinelPolicy,
+            StreamingTally,
+            TallyConfig,
+        )
+
+        mesh = self._resolve_mesh(req.get("mesh"))
+        n = int(req.get("num_particles", self.default_particles))
+        kw: Dict[str, Any] = {
+            # Serving default: no per-move convergence D2H sync (the
+            # health op reports through the sentinel instead).
+            "check_found_all": bool(req.get("check_found_all", False)),
+        }
+        if req.get("batch_stats"):
+            kw["batch_stats"] = True
+        if req.get("sentinel"):
+            kw["sentinel"] = SentinelPolicy()
+        if req.get("checkpoint_dir"):
+            kw["checkpoint"] = CheckpointPolicy(
+                dir=str(req["checkpoint_dir"]),
+                every_n_batches=int(req.get("every_n_batches", 1)),
+                keep=int(req.get("keep", 3)),
+                handle_signals=False,  # the service owns the handler
+            )
+        facade = req.get("facade", "mono")
+        if facade == "mono":
+            return PumiTally(mesh, n, TallyConfig(**kw))
+        if facade == "stream":
+            return StreamingTally(
+                mesh, n, chunk_size=int(req.get("chunk_size", 1 << 20)),
+                config=TallyConfig(**kw),
+            )
+        if facade == "part":
+            return PartitionedPumiTally(
+                mesh, n,
+                TallyConfig(capacity_factor=float(
+                    req.get("capacity_factor", 4.0)
+                ), **kw),
+            )
+        raise ValueError(
+            f"unknown facade {facade!r} (mono/stream/part)"
+        )
+
+    def _resolve_mesh(self, spec):
+        if spec is None:
+            if self.default_mesh is None:
+                raise ValueError(
+                    "no mesh in the open request and the server has no "
+                    "default mesh"
+                )
+            return self.default_mesh
+        if "box" in spec:
+            from pumiumtally_tpu import build_box
+
+            lx, ly, lz, nx, ny, nz = spec["box"]
+            return build_box(float(lx), float(ly), float(lz),
+                             int(nx), int(ny), int(nz))
+        if "path" in spec:
+            if not self.allow_mesh_paths:
+                raise ValueError(
+                    "mesh-path loading is disabled on this server "
+                    "(start with allow_mesh_paths / --allow-mesh-paths)"
+                )
+            return str(spec["path"])  # facades load .msh/.osh paths
+        raise ValueError(f"unknown mesh spec {spec!r} (box/path)")

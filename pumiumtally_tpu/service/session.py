@@ -1,0 +1,159 @@
+"""Per-client session state: one facade, one queue, one lifecycle.
+
+A ``TallySession`` wraps ONE engine facade (any of the five kinds) on
+behalf of one client. Everything campaign-scoped already lives on the
+facade — flux, scoring lane banks, batch-statistics accumulators, the
+sentinel's health record and quarantine stream, the autosave runner
+and its generation store — so wrapping a facade per session is exactly
+what keeps those PER-SESSION: two clients sharing a service share the
+device and the jit cache (compiled code is value-free) and nothing
+else. That is also the root of the service's determinism contract: a
+session's campaign output is bitwise the solo run of the same
+campaign, however its ops interleave with other sessions'.
+
+Lifecycle: OPEN → DRAINING → CLOSED.
+
+- OPEN accepts submissions into the bounded FIFO queue (admission
+  control: ``ServiceBusyError`` when full — the client retries after
+  its oldest future resolves; the refused op was never queued and the
+  session's state is untouched);
+- DRAINING (client close, or service-wide SIGTERM drain) rejects new
+  work with ``SessionClosedError`` while queued ops finish;
+- CLOSED: queue empty, drain checkpoint written (when autosave is
+  armed), facade released from the scheduler ring.
+
+The queue bound defaults to 2 — the double buffer: one op staged
+ahead while one executes (staging.py). Deeper queues buy more
+pipeline slack at the price of staler backpressure.
+"""
+
+from __future__ import annotations
+
+import enum
+import warnings
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from pumiumtally_tpu.service.staging import StagedOp
+
+DEFAULT_QUEUE_DEPTH = 2
+
+
+class SessionState(enum.Enum):
+    OPEN = "open"
+    DRAINING = "draining"
+    CLOSED = "closed"
+
+
+class ServiceBusyError(RuntimeError):
+    """The session's move queue is full (admission control): the op was
+    NOT enqueued. Retry after one of the session's outstanding futures
+    resolves — per-session state is untouched by the refusal."""
+
+
+class SessionClosedError(RuntimeError):
+    """The session is draining or closed and accepts no new work."""
+
+
+class TallySession:
+    """One client's campaign inside the service (built by
+    ``server.TallyService.open_session``; all methods are called under
+    the service's lock — the session itself is not a thread-safe
+    object)."""
+
+    def __init__(self, session_id: str, tally,
+                 max_queue: int = DEFAULT_QUEUE_DEPTH):
+        if int(max_queue) < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue!r}")
+        self.id = str(session_id)
+        self.tally = tally
+        self.max_queue = int(max_queue)
+        self.state = SessionState.OPEN
+        self._queue: deque = deque()
+        self.ops_submitted = 0
+        self.ops_completed = 0
+        self.moves_completed = 0
+        # The close sentinel's future, once a close is issued: a
+        # second close() returns it instead of queueing a sentinel the
+        # scheduler could never pick after the first one unregisters
+        # the session (a hung future, not an error).
+        self.close_future = None
+        runner = getattr(tally, "_resilience", None)
+        if runner is not None and runner.policy.handle_signals:
+            # The SERVICE owns the process's drain handler (one
+            # dispatcher, resilience/policy.py); a per-session runner
+            # that also installed one would shadow it with a handler
+            # whose drain flag nothing in the service consumes.
+            warnings.warn(
+                f"session {self.id!r}: its CheckpointPolicy has "
+                "handle_signals=True — inside a service, pass "
+                "handle_signals=False and let the service drain every "
+                "session on SIGTERM"
+            )
+
+    # -- queue (service-lock context) ------------------------------------
+    def submit(self, op: StagedOp) -> StagedOp:
+        if self.state is not SessionState.OPEN:
+            raise SessionClosedError(
+                f"session {self.id!r} is {self.state.value}: it accepts "
+                "no new work"
+            )
+        if len(self._queue) >= self.max_queue:
+            raise ServiceBusyError(
+                f"session {self.id!r} queue is full "
+                f"({self.max_queue} staged ops): retry after an "
+                "outstanding future resolves"
+            )
+        self._queue.append(op)
+        self.ops_submitted += 1
+        return op
+
+    def submit_final(self, op: StagedOp) -> StagedOp:
+        """Enqueue past the DRAINING gate (the session-close sentinel
+        op itself; the depth bound is deliberately not applied — a
+        close must never be refused for backpressure)."""
+        if self.state is SessionState.CLOSED:
+            raise SessionClosedError(f"session {self.id!r} is closed")
+        self._queue.append(op)
+        self.ops_submitted += 1
+        return op
+
+    def head_cost(self) -> Optional[int]:
+        return self._queue[0].cost if self._queue else None
+
+    def pop(self) -> StagedOp:
+        return self._queue.popleft()
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def note_completed(self, op: StagedOp) -> None:
+        self.ops_completed += 1
+        if op.kind == "move":
+            self.moves_completed += 1
+
+    # -- lifecycle -------------------------------------------------------
+    def begin_drain(self) -> None:
+        if self.state is SessionState.OPEN:
+            self.state = SessionState.DRAINING
+
+    def mark_closed(self) -> None:
+        self.state = SessionState.CLOSED
+
+    # -- drain checkpoint -------------------------------------------------
+    def drain_checkpoint(self, reason: str = "service_drain"
+                         ) -> Optional[Tuple[int, str]]:
+        """Write one generation through the session's own autosave
+        runner (None when the facade has no ``TallyConfig.checkpoint``
+        armed — drain then simply discards the session's device state,
+        exactly like a bare facade's process exit). The generation's
+        metadata carries the session id and, with a sentinel armed,
+        the session's health summary — a drained fleet leaves one
+        self-describing generation per session."""
+        runner = getattr(self.tally, "_resilience", None)
+        if runner is None:
+            return None
+        meta: Dict[str, Any] = {"session": self.id}
+        if getattr(self.tally, "_sentinel", None) is not None:
+            meta["health"] = self.tally.health_report().as_dict()
+        return runner.save(self.tally, reason=reason, meta=meta)

@@ -1,0 +1,167 @@
+"""Subprocess service campaign driver for the SIGTERM-drain tests.
+
+Runs one deterministic two-session campaign through the multi-session
+service (``TallyService`` with the process-wide drain handler), so
+tests/test_service.py can drain it mid-campaign and relaunch it with
+``--resume``:
+
+    python tests/_service_driver.py --ckpt-dir /tmp/ck --out-dir /tmp/o \
+        [--sigterm-after-batch K] [--resume]
+
+Two sessions of different facade kinds (mono + streaming), each with
+its OWN autosave store under ``<ckpt-dir>/<session>``. The campaign is
+B source batches x M moves per session, all inputs derived from
+per-session seeded rngs — every process (fresh, drained, resumed)
+computes identical trajectories and indexes into them by each
+session's restored ``iter_count``, so a resumed run re-drives exactly
+the batches the drained one had not finished (the
+tests/_resilience_driver.py recipe, per session).
+
+``--sigterm-after-batch K`` raises SIGTERM against this process right
+after batch K completes in both sessions — the deterministic stand-in
+for an external preemption notice. The service's drain dispatch sets
+the flag; the loop observes it at the next batch boundary, so
+``shutdown(drain=True)`` writes one BATCH-ALIGNED generation per
+session (iter_count a multiple of M) and the process exits 0 without
+writing campaign outputs. Not collected by pytest; runnable
+standalone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCHES = 4
+MOVES = 2
+N = 64
+MESH_ARGS = (1, 1, 1, 3, 3, 3)
+SESSIONS = ("mono", "stream")  # session ids double as facade kinds
+SEEDS = {"mono": 101, "stream": 202}
+QUEUE_DEPTH = MOVES + 1  # one batch fits the queue: source + M moves
+
+
+def build_tally(kind, ckpt_dir):
+    from pumiumtally_tpu import (
+        CheckpointPolicy,
+        PumiTally,
+        StreamingTally,
+        TallyConfig,
+        build_box,
+    )
+
+    policy = CheckpointPolicy(
+        dir=os.path.join(ckpt_dir, kind), every_n_batches=1, keep=5,
+        handle_signals=False,  # the SERVICE owns the drain handler
+    )
+    mesh = build_box(*MESH_ARGS)
+    cfg = TallyConfig(checkpoint=policy, check_found_all=False)
+    if kind == "mono":
+        return PumiTally(mesh, N, cfg)
+    return StreamingTally(mesh, N, chunk_size=40, config=cfg)
+
+
+def trajectory(kind):
+    import numpy as np
+
+    rng = np.random.default_rng(SEEDS[kind])
+    src = rng.uniform(0.1, 0.9, (BATCHES, N, 3))
+    dst = rng.uniform(0.1, 0.9, (BATCHES, MOVES, N, 3))
+    return src, dst
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--sigterm-after-batch", type=int, default=None)
+    p.add_argument("--resume", action="store_true")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+    import numpy as np
+
+    from pumiumtally_tpu import TallyService, resume_latest
+    from pumiumtally_tpu.service import ServiceDrainingError
+
+    svc = TallyService(handle_signals=True)
+    handles = {}
+    start_batch = {}
+    done_moves = {}
+    for kind in SESSIONS:
+        t = build_tally(kind, args.ckpt_dir)
+        sb = dm = 0
+        if args.resume:
+            info = resume_latest(t)
+            if info is not None:
+                sb, dm = divmod(t.iter_count, MOVES)
+                print(
+                    f"resumed session {kind} generation "
+                    f"{info.generation} at batch {sb} "
+                    f"(iter_count {t.iter_count})"
+                )
+        handles[kind] = svc.open_session(t, session_id=kind,
+                                         max_queue=QUEUE_DEPTH)
+        start_batch[kind], done_moves[kind] = sb, dm
+
+    first = min(start_batch.values())
+    for b in range(first, BATCHES):
+        if svc.drain_requested:
+            break
+        futs = []
+        try:
+            for kind in SESSIONS:
+                if b < start_batch[kind]:
+                    continue  # this session resumed further along
+                src, dst = trajectory(kind)
+                skip = done_moves[kind] if b == start_batch[kind] else 0
+                h = handles[kind]
+                if skip == 0:
+                    # A mid-batch restore already localized this
+                    # batch's sources (same rule as the resilience
+                    # driver).
+                    futs.append(h.copy_initial_position(
+                        src[b].reshape(-1).copy()
+                    ))
+                for m in range(skip, MOVES):
+                    futs.append(h.move(
+                        None, dst[b, m].reshape(-1).copy()
+                    ))
+        except ServiceDrainingError:
+            pass  # an external SIGTERM landed mid-batch: drain below
+        for f in futs:
+            f.result(timeout=300)
+        print(f"batch {b} done", flush=True)
+        if args.sigterm_after_batch is not None and (
+            b == args.sigterm_after_batch
+        ):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    if svc.drain_requested:
+        saved = svc.shutdown(drain=True)
+        print(json.dumps({
+            "drained": {
+                sid: (None if gen is None else gen[0])
+                for sid, gen in saved.items()
+            }
+        }), flush=True)
+        raise SystemExit(0)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    for kind in SESSIONS:
+        flux = handles[kind].flux().result(timeout=300)
+        np.save(os.path.join(args.out_dir, f"{kind}.npy"),
+                np.asarray(flux, np.float64))
+    svc.shutdown(drain=False)
+
+
+if __name__ == "__main__":
+    main()

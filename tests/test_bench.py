@@ -281,6 +281,28 @@ def test_sentinel_row(bench):
     assert res["compiles"].get("straggler_retry", 0) == 0
 
 
+def test_service_row(bench):
+    """The multi-session-service component row: schema keys present,
+    bitwise flux parity between the 1-session service and the direct
+    facade asserted (the tool raises otherwise), positive rates in
+    all three arms, and the host-side-only contract —
+    ``compiles.timed == 0``: the service adds no jitted entry points,
+    so a served session compiles exactly what a bare facade does (in
+    warmup)."""
+    res = bench.run_service_ab()
+    for key in ("direct_moves_per_sec", "service_moves_per_sec",
+                "service_fenced_moves_per_sec", "service_overhead_pct",
+                "pipeline_speedup", "flux_parity_bitwise",
+                "queue_depth", "compiles", "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["direct_moves_per_sec"] > 0
+    assert res["service_moves_per_sec"] > 0
+    assert res["service_fenced_moves_per_sec"] > 0
+    assert res["queue_depth"] >= 2  # double-buffered at minimum
+    assert res["compiles"]["timed"] == 0
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

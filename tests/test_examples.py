@@ -58,6 +58,17 @@ def test_openmc_style_driver_runs(tmp_path, mode, protocol, extra):
 
 
 @pytest.mark.slow
+def test_multi_client_service(tmp_path):
+    """Two concurrent drivers on one service: the example asserts each
+    session's flux bitwise against its serial single-client run (the
+    service determinism contract) and must keep executing."""
+    proc = _run_example("multi_client_service.py", tmp_path)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.count("bitwise vs serial run: True") == 2
+    assert "zero cross-talk" in proc.stdout
+
+
+@pytest.mark.slow
 def test_multichip_checkpointed_run(tmp_path):
     proc = _run_example("multichip_checkpointed_run.py", tmp_path)
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -615,3 +615,33 @@ def test_scoring_subsystem_registered_and_pragma_free():
     # would silently drop its CI coverage).
     with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
         assert "tools/exp_scoring_ab.py" in fh.read()
+
+
+def test_service_subsystem_registered_and_pragma_free():
+    """The multi-session-service modules (r11) must be IN the
+    self-check's file set and hold the strongest form of the clean
+    contract: zero violations with zero pragmas — the service layer is
+    host-side threading and prepacked numpy buffers with NO trace
+    roots at all, so there is no excuse for even a justified
+    suppression. The bench-consumed A/B tool is covered the same way
+    (it is in tools/lint_all.py's jaxlint targets)."""
+    import glob
+
+    svc_dir = os.path.join(REPO, "pumiumtally_tpu", "service")
+    files = sorted(glob.glob(os.path.join(svc_dir, "*.py")))
+    names = {os.path.basename(f) for f in files}
+    assert {"__init__.py", "session.py", "scheduler.py", "staging.py",
+            "server.py"} <= names
+    from pumiumtally_tpu.analysis import lint_paths
+
+    ab = os.path.join(REPO, "tools", "exp_service_ab.py")
+    assert lint_paths(files + [ab]) == []
+    for f in files + [ab]:
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the service modules ship pragma-free"
+            )
+    # tools/lint_all.py actually targets the A/B tool (a slip here
+    # would silently drop its CI coverage).
+    with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
+        assert "tools/exp_service_ab.py" in fh.read()

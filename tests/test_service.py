@@ -1,0 +1,874 @@
+"""The multi-session service layer (round 11).
+
+Contracts pinned here (docs/DESIGN.md "Multi-session service"):
+
+- determinism under concurrency: N >= 3 interleaved sessions of mixed
+  facade kinds (scoring on one, sentinel on another), driven by
+  concurrent client threads, each produce flux (and score banks, and
+  health reports) BITWISE identical to running that campaign alone on
+  a bare facade;
+- a single-session service is bitwise- AND allocation-identical to
+  the bare facade (the service layer allocates nothing on device);
+- backpressure: a full session queue refuses with ServiceBusyError at
+  submit, without corrupting any session's state — the refused op was
+  never queued, and the campaign continues bitwise;
+- submit-time validation: malformed moves raise argument-naming
+  errors at submit (staging), never occupying a queue slot;
+- scheduler: deficit round robin is fair, work-proportional, and
+  work-conserving; an emptied queue forfeits banked credit;
+- reads ride the session FIFO (a flux read observes exactly the moves
+  submitted before it);
+- the NDJSON socket front end round-trips a campaign bitwise;
+- SIGTERM drains a server with >= 2 open sessions through the
+  resilience dispatcher: exit 0, one batch-aligned generation per
+  session, bitwise resume per session (subprocess,
+  tests/_service_driver.py).
+"""
+
+import gc
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pumiumtally_tpu import (
+    EnergyFilter,
+    PartitionedPumiTally,
+    PumiTally,
+    ScoringSpec,
+    SentinelPolicy,
+    ServiceBusyError,
+    SessionClosedError,
+    SessionState,
+    StreamingTally,
+    TallyConfig,
+    TallyService,
+    build_box,
+)
+from pumiumtally_tpu.service import (
+    DeficitRoundRobinScheduler,
+    ServiceDrainingError,
+    SocketFrontend,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_service_driver.py")
+
+N = 192
+BATCHES = 2
+MOVES = 2
+
+
+def _mesh():
+    return build_box(1.0, 1.0, 1.0, 3, 3, 3)
+
+
+def _campaign(seed, batches=BATCHES, moves=MOVES, n=N):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.uniform(0.1, 0.9, (n, 3)),
+         [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)],
+         [rng.uniform(0.1, 1.9, n) for _ in range(moves)])
+        for _ in range(batches)
+    ]
+
+
+def _drive_direct(t, work, with_energy=False):
+    for src, dests, energies in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d, e in zip(dests, energies):
+            kw = {"energy": e.copy()} if with_energy else {}
+            t.MoveToNextLocation(None, d.reshape(-1).copy(), **kw)
+
+
+def _submit_retry(fn, *args, **kw):
+    """Client-side busy-retry: the documented reaction to
+    ServiceBusyError (the op was never queued; state is clean)."""
+    while True:
+        try:
+            return fn(*args, **kw)
+        except ServiceBusyError:
+            time.sleep(0.002)
+
+
+def _drive_handle(h, work, with_energy=False, timeout=300):
+    for src, dests, energies in work:
+        futs = [_submit_retry(h.copy_initial_position,
+                              src.reshape(-1).copy())]
+        for d, e in zip(dests, energies):
+            kw = {"energy": e.copy()} if with_energy else {}
+            futs.append(_submit_retry(
+                h.move, None, d.reshape(-1).copy(), **kw
+            ))
+        for f in futs:
+            f.result(timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (pure data structure)
+# ---------------------------------------------------------------------------
+
+class _Q:
+    def __init__(self, costs):
+        self.items = list(costs)
+
+    def head(self):
+        return self.items[0] if self.items else None
+
+    def pop(self):
+        return self.items.pop(0)
+
+
+def _run_sched(sched, queues, picks):
+    served = []
+    for _ in range(picks):
+        k = sched.pick(lambda key: queues[key].head())
+        if k is None:
+            break
+        served.append((k, queues[k].pop()))
+    return served
+
+
+def test_drr_strict_alternation_equal_costs():
+    """Equal-cost backlogged sessions serve in strict round robin —
+    a hot session (100 queued) cannot starve a cold one (3 queued)."""
+    sched = DeficitRoundRobinScheduler()
+    sched.register("hot")
+    sched.register("cold")
+    queues = {"hot": _Q([1] * 100), "cold": _Q([1] * 3)}
+    served = _run_sched(sched, queues, 8)
+    kinds = [k for k, _ in served]
+    assert kinds[:6] == ["hot", "cold", "hot", "cold", "hot", "cold"]
+    # Work conservation: once cold empties, hot serves every pick.
+    assert kinds[6:] == ["hot", "hot"]
+
+
+def test_drr_work_proportional_unequal_costs():
+    """With 10x cost difference, the cheap session serves ~10 ops per
+    visit of the expensive one: served COST stays balanced within one
+    quantum + one max op cost (the O(1) unfairness bound)."""
+    sched = DeficitRoundRobinScheduler()
+    sched.register("big")
+    sched.register("small")
+    queues = {"big": _Q([10] * 50), "small": _Q([1] * 500)}
+    served = _run_sched(sched, queues, 110)
+    cost = {"big": 0, "small": 0}
+    for k, c in served:
+        cost[k] += c
+    assert abs(cost["big"] - cost["small"]) <= 10 + 10
+
+
+def test_drr_deficit_resets_when_queue_empties():
+    """Idle time banks no credit: a session that drained and refilled
+    competes from zero, not from saved-up quantum."""
+    sched = DeficitRoundRobinScheduler(quantum=1)
+    sched.register("a")
+    sched.register("b")
+    queues = {"a": _Q([3]), "b": _Q([])}
+    # b is visited while empty many times; its deficit must stay 0.
+    _run_sched(sched, queues, 1)
+    assert sched.deficit("b") == 0
+    queues["b"].items = [3]
+    queues["a"].items = []
+    served = _run_sched(sched, queues, 1)
+    # b needed 3 fresh visits of quantum 1 — but pick() loops rounds
+    # internally, so one pick serves it; the point is the deficit
+    # counter was not pre-loaded.
+    assert served == [("b", 3)]
+    assert sched.deficit("b") == 0
+
+
+def test_drr_small_manual_quantum_jumps_not_spins():
+    """quantum=1 with 100k-cost ops must serve in O(ring) work per
+    pick (the deficit clock jumps arithmetically), not O(cost) spin
+    passes under the service lock — and the accounting must match the
+    one-pass-at-a-time semantics exactly."""
+    sched = DeficitRoundRobinScheduler(quantum=1)
+    sched.register("a")
+    sched.register("b")
+    queues = {"a": _Q([100_000, 100_000]), "b": _Q([50_000])}
+    t0 = time.perf_counter()
+    served = _run_sched(sched, queues, 3)
+    assert time.perf_counter() - t0 < 1.0  # spin would take ~minutes
+    # b needs 50k quanta, a needs 100k: b first, then a, then a.
+    assert served == [("b", 50_000), ("a", 100_000), ("a", 100_000)]
+    assert sched.deficit("b") == 0  # emptied: credit forfeited
+
+
+def test_drr_register_unregister_and_validation():
+    sched = DeficitRoundRobinScheduler()
+    sched.register("a")
+    with pytest.raises(ValueError):
+        sched.register("a")
+    sched.register("b")
+    sched.unregister("a")
+    assert sched.keys == ("b",)
+    assert sched.pick(lambda k: None) is None
+    with pytest.raises(ValueError):
+        DeficitRoundRobinScheduler(quantum=0)
+
+
+# ---------------------------------------------------------------------------
+# Session lifecycle + backpressure
+# ---------------------------------------------------------------------------
+
+def test_backpressure_busy_without_corrupting_state():
+    """Fill the bounded queue against a stopped worker: the (k+1)-th
+    submit refuses with ServiceBusyError, nothing partial enters the
+    pipeline, and after the worker starts the campaign completes —
+    flux bitwise equal to the solo run of exactly the ACCEPTED ops
+    plus the retried one."""
+    mesh = _mesh()
+    work = _campaign(7, batches=1)
+    src, dests, _ = work[0]
+    svc = TallyService(autostart=False)
+    h = svc.open_session(PumiTally(mesh, N), max_queue=2)
+    f1 = h.copy_initial_position(src.reshape(-1).copy())
+    f2 = h.move(None, dests[0].reshape(-1).copy())
+    flying = np.ones(N, np.int8)
+    with pytest.raises(ServiceBusyError):
+        h.move(None, dests[1].reshape(-1).copy(), flying=flying)
+    # The refusal left the caller's buffers UNTOUCHED — in particular
+    # the flying array was not zeroed (the protocol side effect fires
+    # only on accept), so the retry below stages identical bytes.
+    assert flying.sum() == N
+    assert h.tally.iter_count == 0  # nothing executed, nothing corrupted
+    svc.start()
+    f1.result(timeout=300)
+    f2.result(timeout=300)
+    # The refused move retries cleanly once a slot frees — and the
+    # accepted submit applies the protocol's zeroing side effect.
+    _submit_retry(
+        h.move, None, dests[1].reshape(-1).copy(), flying=flying
+    ).result(timeout=300)
+    assert flying.sum() == 0
+    flux_s = h.flux().result(timeout=300)
+    svc.shutdown(drain=False)
+
+    t = PumiTally(mesh, N)
+    _drive_direct(t, work)
+    np.testing.assert_array_equal(flux_s, np.asarray(t.flux))
+
+
+def test_submit_validation_raises_before_queueing():
+    """Malformed moves refuse AT SUBMIT with the facades' own
+    argument-naming errors — no queue slot consumed, no future
+    created, session state untouched, and the campaign continues
+    bitwise without them."""
+    mesh = _mesh()
+    work = _campaign(9, batches=1)
+    src, dests, _ = work[0]
+    with TallyService() as svc:
+        h = svc.open_session(PumiTally(mesh, N), max_queue=4)
+        h.copy_initial_position(src.reshape(-1).copy()).result(
+            timeout=300
+        )
+        bad = dests[0].reshape(-1).copy()
+        bad[5] = np.nan
+        with pytest.raises(ValueError, match="destinations"):
+            h.move(None, bad)
+        with pytest.raises(ValueError, match="flying"):
+            h.move(None, dests[0].reshape(-1).copy(),
+                   flying=np.ones(3, np.int8))
+        with pytest.raises(ValueError, match="energy"):
+            # No scoring armed on this session: energy= must refuse.
+            h.move(None, dests[0].reshape(-1).copy(),
+                   energy=np.ones(N))
+        assert h.pending == 0  # refused ops never occupied a slot
+        for d in dests:
+            h.move(None, d.reshape(-1).copy())
+        flux_s = h.flux().result(timeout=300)
+    t = PumiTally(mesh, N)
+    _drive_direct(t, work)
+    np.testing.assert_array_equal(flux_s, np.asarray(t.flux))
+
+
+def test_execution_error_propagates_and_session_survives():
+    """An op that fails at EXECUTION (not submit) carries its
+    exception to exactly that client's future; the worker and every
+    other queued op survive."""
+    mesh = _mesh()
+    work = _campaign(11, batches=1)
+    src, dests, _ = work[0]
+    with TallyService() as svc:
+        h = svc.open_session(PumiTally(mesh, N), max_queue=4)
+        h.copy_initial_position(src.reshape(-1).copy())
+        bad = h.close_batch()  # no batch_stats on this facade
+        good = h.move(None, dests[0].reshape(-1).copy())
+        with pytest.raises(RuntimeError, match="batch statistics"):
+            bad.result(timeout=300)
+        good.result(timeout=300)
+        flux_s = h.flux().result(timeout=300)
+    t = PumiTally(mesh, N)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dests[0].reshape(-1).copy())
+    np.testing.assert_array_equal(flux_s, np.asarray(t.flux))
+
+
+def test_session_lifecycle_rejections():
+    mesh = _mesh()
+    with TallyService() as svc:
+        h = svc.open_session(PumiTally(mesh, 16), max_queue=4)
+        assert h.state is SessionState.OPEN
+        first = h.close()
+        # A repeated close is idempotent: it returns the SAME future
+        # (a second sentinel could never execute once the first
+        # unregisters the session — it would hang, not close).
+        assert h.close() is first
+        first.result(timeout=300)
+        assert h.state is SessionState.CLOSED
+        assert h.close() is first
+        with pytest.raises(SessionClosedError):
+            h.flux()
+        assert svc.session_ids() == ()
+    # A drained service refuses new sessions and new work.
+    svc2 = TallyService()
+    h2 = svc2.open_session(PumiTally(mesh, 16))
+    svc2.request_drain()
+    with pytest.raises(ServiceDrainingError):
+        svc2.open_session(PumiTally(mesh, 16))
+    with pytest.raises(ServiceDrainingError):
+        h2.flux()
+    svc2.shutdown(drain=False)
+    with pytest.raises(ValueError):
+        TallyService().open_session(PumiTally(mesh, 16), max_queue=0)
+
+
+def test_auto_session_ids_skip_caller_claimed():
+    """open_session(session_id="s1") then open_session() with no id:
+    the generator must skip the caller-claimed id instead of refusing
+    the caller who passed nothing."""
+    mesh = _mesh()
+    with TallyService() as svc:
+        h1 = svc.open_session(PumiTally(mesh, 16), session_id="s1")
+        h2 = svc.open_session(PumiTally(mesh, 16))
+        assert h2.id != h1.id
+        assert set(svc.session_ids()) == {h1.id, h2.id}
+        h1.close().result(timeout=300)
+        h2.close().result(timeout=300)
+
+
+def test_reads_ride_the_session_fifo():
+    """A flux read submitted between moves observes exactly the moves
+    before it — FIFO consistency, not eventual consistency."""
+    mesh = _mesh()
+    work = _campaign(13, batches=1)
+    src, dests, _ = work[0]
+    with TallyService() as svc:
+        h = svc.open_session(PumiTally(mesh, N), max_queue=8)
+        h.copy_initial_position(src.reshape(-1).copy())
+        h.move(None, dests[0].reshape(-1).copy())
+        mid = h.flux()
+        h.move(None, dests[1].reshape(-1).copy())
+        end = h.flux()
+        mid_flux = mid.result(timeout=300)
+        end_flux = end.result(timeout=300)
+    t = PumiTally(mesh, N)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, dests[0].reshape(-1).copy())
+    np.testing.assert_array_equal(mid_flux, np.asarray(t.flux))
+    t.MoveToNextLocation(None, dests[1].reshape(-1).copy())
+    np.testing.assert_array_equal(end_flux, np.asarray(t.flux))
+
+
+# ---------------------------------------------------------------------------
+# Determinism under concurrency (the round-11 acceptance contract)
+# ---------------------------------------------------------------------------
+
+def _session_zoo(mesh):
+    """Three sessions of mixed facade kinds: sentinel on the
+    monolithic one, scoring on the streaming one, a partitioned
+    third."""
+    spec = ScoringSpec(filters=[EnergyFilter(np.array([0.0, 1.0, 2.0]))],
+                       scores=["flux", "events"])
+    return {
+        "mono_sentinel": PumiTally(
+            mesh, N,
+            TallyConfig(check_found_all=False, sentinel=SentinelPolicy()),
+        ),
+        "stream_scoring": StreamingTally(
+            mesh, N, chunk_size=128,
+            config=TallyConfig(check_found_all=False, scoring=spec),
+        ),
+        "part": PartitionedPumiTally(
+            mesh, N,
+            TallyConfig(check_found_all=False, capacity_factor=4.0),
+        ),
+    }
+
+
+_SEEDS = {"mono_sentinel": 21, "stream_scoring": 22, "part": 23}
+
+
+def test_interleaved_sessions_bitwise_vs_solo():
+    """THE determinism-under-concurrency pin: three concurrent client
+    threads drive three sessions of mixed facade kinds through one
+    service; every session's flux — and the scoring session's lane
+    bank, and the sentinel session's health record — is BITWISE the
+    solo run of the same campaign on a bare facade."""
+    mesh = _mesh()
+    results = {}
+    with TallyService() as svc:
+        handles = {
+            kind: svc.open_session(t, session_id=kind, max_queue=2)
+            for kind, t in _session_zoo(mesh).items()
+        }
+
+        errors = []
+
+        def client(kind):
+            try:
+                h = handles[kind]
+                _drive_handle(h, _campaign(_SEEDS[kind]),
+                              with_energy=(kind == "stream_scoring"))
+                out = {"flux": h.flux().result(timeout=300)}
+                if kind == "stream_scoring":
+                    out["bank"] = h.score_bank().result(timeout=300)
+                if kind == "mono_sentinel":
+                    out["health"] = (
+                        h.health_report().result(timeout=300).as_dict()
+                    )
+                results[kind] = out
+            except Exception as e:  # noqa: BLE001 — surface in-main
+                errors.append((kind, e))
+
+        threads = [
+            threading.Thread(target=client, args=(kind,))
+            for kind in handles
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errors, errors
+
+    for kind, solo in _session_zoo(mesh).items():
+        _drive_direct(solo, _campaign(_SEEDS[kind]),
+                      with_energy=(kind == "stream_scoring"))
+        np.testing.assert_array_equal(
+            results[kind]["flux"], np.asarray(solo.flux), err_msg=kind,
+        )
+        if kind == "stream_scoring":
+            np.testing.assert_array_equal(
+                results[kind]["bank"], np.asarray(solo.score_bank),
+            )
+        if kind == "mono_sentinel":
+            assert results[kind]["health"] == (
+                solo.health_report().as_dict()
+            )
+
+
+def test_single_session_bitwise_and_allocation_identical():
+    """A 1-session service is indistinguishable from the bare facade:
+    same flux/positions/elements BITWISE, and the SAME number of live
+    device arrays afterwards — the service layer stages host-side
+    numpy only and allocates nothing on device."""
+    mesh = _mesh()
+    work = _campaign(31)
+
+    # Warm every jit cache + global constant once, so neither measured
+    # run pays one-time allocations the other would not.
+    warm = PumiTally(mesh, N)
+    _drive_direct(warm, work)
+    del warm
+    gc.collect()
+    base = len(jax.live_arrays())
+
+    t_direct = PumiTally(mesh, N)
+    _drive_direct(t_direct, work)
+    flux_d = np.asarray(t_direct.flux)
+    gc.collect()
+    direct_delta = len(jax.live_arrays()) - base
+
+    svc = TallyService()
+    t_served = PumiTally(mesh, N)
+    h = svc.open_session(t_served)
+    _drive_handle(h, work)
+    flux_s = h.flux().result(timeout=300)
+    pos_s = h.tally.positions
+    elem_s = h.tally.elem_ids
+    svc.shutdown(drain=False)
+    del svc, h
+    gc.collect()
+    service_delta = len(jax.live_arrays()) - base - direct_delta
+
+    np.testing.assert_array_equal(flux_s, flux_d)
+    np.testing.assert_array_equal(pos_s, t_direct.positions)
+    np.testing.assert_array_equal(elem_s, t_direct.elem_ids)
+    assert service_delta == direct_delta
+
+
+# ---------------------------------------------------------------------------
+# Socket front end
+# ---------------------------------------------------------------------------
+
+def _rpc(f, obj):
+    f.write(json.dumps(obj).encode() + b"\n")
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_socket_frontend_roundtrip():
+    """A remote driver over the NDJSON socket gets the same bitwise
+    flux as a direct facade — including the pipelined (wait=false +
+    sync) path — and malformed requests answer structured errors
+    instead of dropping the connection."""
+    import base64
+    import socket as socketlib
+
+    mesh = _mesh()
+    work = _campaign(41, batches=1)
+    src, dests, _ = work[0]
+
+    def b64(a):
+        return base64.b64encode(
+            np.asarray(a, "<f8").tobytes()
+        ).decode()
+
+    svc = TallyService()
+    fe = SocketFrontend(svc, default_mesh=mesh, default_particles=N)
+    fe.start()
+    try:
+        with socketlib.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+            assert _rpc(f, {"op": "ping"}) == {
+                "ok": True, "draining": False,
+            }
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": N, "max_queue": 8})
+            assert r["ok"], r
+            sid = r["session"]
+            assert _rpc(f, {"op": "source", "session": sid,
+                            "positions": b64(src.reshape(-1))})["ok"]
+            r = _rpc(f, {"op": "move", "session": sid,
+                         "dests": b64(dests[0].reshape(-1)),
+                         "wait": False})
+            assert r == {"ok": True, "queued": True}
+            assert _rpc(f, {"op": "move", "session": sid,
+                            "dests": b64(dests[1].reshape(-1))})["ok"]
+            assert _rpc(f, {"op": "sync", "session": sid})["ok"]
+            # Structured errors, connection survives:
+            r = _rpc(f, {"op": "nonsense"})
+            assert r["ok"] is False and "unknown op" in r["message"]
+            r = _rpc(f, {"op": "move", "session": sid, "dests": None})
+            assert r["ok"] is False  # bad payload type: still answered
+            r = _rpc(f, {"op": "flux", "session": "nope"})
+            assert r["ok"] is False and r["error"] == "KeyError"
+            r = _rpc(f, {"op": "write", "session": sid,
+                         "filename": "x.vtk"})
+            assert r["ok"] is False  # allow_write off by default
+            r = _rpc(f, {"op": "flux", "session": sid})
+            flux_s = np.frombuffer(
+                base64.b64decode(r["flux"]), "<f8"
+            )
+            assert _rpc(f, {"op": "close", "session": sid})["ok"]
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+    t = PumiTally(mesh, N)
+    _drive_direct(t, work)
+    np.testing.assert_array_equal(flux_s,
+                                  np.asarray(t.flux, np.float64))
+
+
+def test_socket_sync_reports_every_pipelined_failure():
+    """sync must consume the whole waitlist and surface EVERY failure
+    in its one error reply — raising at the first would clear (and so
+    silently discard) any later pipelined failure, and a driver that
+    fixed only the named op would then get a clean second sync while
+    flux is missing a move."""
+    from concurrent.futures import Future
+
+    svc = TallyService(autostart=False)
+    fe = SocketFrontend(svc)
+    try:
+        fa, ok, fb = Future(), Future(), Future()
+        fa.set_exception(ValueError("bad move A"))
+        ok.set_result(None)
+        fb.set_exception(RuntimeError("bad move B"))
+        waitlist, dropped = [fa, ok, fb], {}
+        with pytest.raises(RuntimeError) as ei:
+            fe._sync(waitlist, dropped, "s")
+        assert "bad move A" in str(ei.value)
+        assert "bad move B" in str(ei.value)
+        assert waitlist == []  # consumed, not leaked into the next sync
+        # A single failure propagates as itself (typed error reply).
+        only = Future()
+        only.set_exception(ValueError("only failure"))
+        waitlist = [only]
+        with pytest.raises(ValueError, match="only failure"):
+            fe._sync(waitlist, dropped, "s")
+        assert waitlist == []
+        # Retention cap: a pipeline-forever driver whose ops fail
+        # persistently must not grow the waitlist O(ops) — the oldest
+        # resolved failures are dropped, counted, and reported.
+        waitlist, dropped = [], {}
+        for i in range(fe._MAX_RETAINED_FAILURES + 10):
+            fut = Future()
+            fut.set_exception(ValueError(f"fail {i}"))
+            fe._ack(fut, waitlist, dropped, "s", {"wait": False})
+        assert len(waitlist) == fe._MAX_RETAINED_FAILURES
+        assert dropped["s"] == 10
+        with pytest.raises(RuntimeError) as ei:
+            fe._sync(waitlist, dropped, "s")
+        assert "+10 earlier failures dropped" in str(ei.value)
+        assert dropped == {} and waitlist == []
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+def test_queued_future_refuses_cancel_and_worker_survives():
+    """A client's fut.cancel() on a still-queued op must not land: a
+    CANCELLED future would make the worker's set_result raise
+    InvalidStateError, killing the one thread that drains every
+    session. Cancellation is refused (as the Future contract allows),
+    the op still runs (a campaign is exactly its submission sequence),
+    and the service keeps serving."""
+    svc = TallyService(autostart=False)  # queue while no worker runs
+    try:
+        h = svc.open_session(PumiTally(_mesh(), N), max_queue=8)
+        src, dests, _ = _campaign(17, batches=1)[0]
+        f_src = h.copy_initial_position(src.reshape(-1).copy())
+        assert f_src.cancel() is False  # refused while queued
+        f_move = h.move(None, dests[0].reshape(-1).copy())
+        assert f_move.cancel() is False
+        svc.start()
+        f_move.result(timeout=300)  # resolves normally, not cancelled
+        # The worker survived the "cancelled" ops: further work runs.
+        flux = h.flux().result(timeout=300)
+        assert np.isfinite(flux).all() and flux.sum() > 0
+        h.close().result(timeout=300)
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_socket_checkpoint_dir_collision_refused(tmp_path):
+    """Two socket sessions sharing one checkpoint_dir would share one
+    GenerationStore — keep-pruning then deletes the OTHER session's
+    generations and the drain promise (one generation per session)
+    silently collapses. The second open must refuse with a structured
+    error; closing a session releases its directory for reuse."""
+    import socket as socketlib
+
+    svc = TallyService()
+    fe = SocketFrontend(svc, default_mesh=_mesh(), default_particles=16)
+    fe.start()
+    try:
+        with socketlib.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+
+            def open_ck(d):
+                return _rpc(f, {"op": "open", "facade": "mono",
+                                "num_particles": 16,
+                                "checkpoint_dir": str(d)})
+
+            r1 = open_ck(tmp_path / "ck")
+            assert r1["ok"], r1
+            r2 = open_ck(tmp_path / "ck")
+            assert r2["ok"] is False and "already in use" in r2["message"]
+            assert open_ck(tmp_path / "ck2")["ok"]  # distinct dir fine
+            assert _rpc(f, {"op": "close", "session": r1["session"]})["ok"]
+            assert open_ck(tmp_path / "ck")["ok"]  # released on close
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+def test_socket_failed_close_still_cleans_up(tmp_path):
+    """A close whose drain checkpoint fails (dir swapped for a file)
+    must still drop the wire bookkeeping and the checkpoint-dir
+    reservation: the error reply carries the real failure, a retry
+    gets an honest unknown-session error instead of the cached
+    failure forever, and the directory is reusable."""
+    import shutil
+    import socket as socketlib
+
+    svc = TallyService()
+    fe = SocketFrontend(svc, default_mesh=_mesh(), default_particles=16)
+    fe.start()
+    try:
+        with socketlib.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+            ck = tmp_path / "ck"
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": 16,
+                         "checkpoint_dir": str(ck)})
+            assert r["ok"], r
+            sid = r["session"]
+            if ck.exists():
+                shutil.rmtree(ck)
+            ck.write_text("not a directory")
+            r_close = _rpc(f, {"op": "close", "session": sid})
+            assert r_close["ok"] is False
+            # Retry: the session is genuinely gone, not a cached error.
+            r_retry = _rpc(f, {"op": "close", "session": sid})
+            assert r_retry["ok"] is False and r_retry["error"] == "KeyError"
+            # Reservation released: the (repaired) dir is reusable.
+            ck.unlink()
+            r2 = _rpc(f, {"op": "open", "facade": "mono",
+                          "num_particles": 16,
+                          "checkpoint_dir": str(ck)})
+            assert r2["ok"], r2
+            assert _rpc(f, {"op": "close", "session": r2["session"]})["ok"]
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+def test_socket_disconnect_closes_orphaned_sessions():
+    """A remote client that vanishes without sending close must not
+    leak its sessions (facade device arrays) into the server forever —
+    the connection teardown drain-closes them."""
+    import socket as socketlib
+
+    svc = TallyService()
+    fe = SocketFrontend(svc, default_mesh=_mesh(), default_particles=16)
+    fe.start()
+    try:
+        with socketlib.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": 16})
+            assert r["ok"] and svc.session_ids() == (r["session"],)
+            # makefile() holds its own reference to the fd — close it
+            # too, or the "vanished" client never actually sends FIN.
+            f.close()
+        deadline = time.monotonic() + 60
+        while svc.session_ids() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.session_ids() == ()
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain + bitwise resume (subprocess, the satellite gate)
+# ---------------------------------------------------------------------------
+
+def _run_service_driver(ckpt_dir, out_dir, *extra, timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PUMIUMTALLY_FAULT", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    return subprocess.run(
+        [sys.executable, DRIVER, "--ckpt-dir", str(ckpt_dir),
+         "--out-dir", str(out_dir), *extra],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=env,
+    )
+
+
+def test_service_drain_sigterm_and_bitwise_resume(tmp_path):
+    """SIGTERM against a server with two open sessions (mono +
+    streaming, each autosave-armed): the process exits 0, every
+    session leaves one BATCH-ALIGNED generation (iter_count a multiple
+    of the per-batch move count), and a resumed server finishes each
+    session's campaign to flux BITWISE equal to the uninterrupted
+    run."""
+    from tests._service_driver import MOVES as DRV_MOVES
+    from tests._service_driver import SESSIONS
+
+    # Uninterrupted reference.
+    r = _run_service_driver(tmp_path / "ck_base", tmp_path / "out_base")
+    assert r.returncode == 0, r.stderr
+    base = {
+        s: np.load(tmp_path / "out_base" / f"{s}.npy") for s in SESSIONS
+    }
+
+    # Drain after batch 1: exit 0, no outputs, one extra generation
+    # per session (the drain save) beyond the per-batch autosaves.
+    r = _run_service_driver(tmp_path / "ck_drain", tmp_path / "out_drain",
+                            "--sigterm-after-batch", "1")
+    assert r.returncode == 0, r.stderr
+    assert not (tmp_path / "out_drain").exists()
+    drained = json.loads(
+        [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
+    )["drained"]
+    assert set(drained) == set(SESSIONS)
+    assert all(gen is not None for gen in drained.values())
+
+    # Resume: each session reports a batch-aligned restore point and
+    # lands bitwise on the reference flux.
+    r = _run_service_driver(tmp_path / "ck_drain", tmp_path / "out_drain",
+                            "--resume")
+    assert r.returncode == 0, r.stderr
+    for s in SESSIONS:
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith(f"resumed session {s} ")][0]
+        iter_count = int(line.rsplit("iter_count ", 1)[1].rstrip(")"))
+        assert iter_count % DRV_MOVES == 0  # batch-aligned
+        assert iter_count == 2 * DRV_MOVES  # drained after batch 1
+        np.testing.assert_array_equal(
+            np.load(tmp_path / "out_drain" / f"{s}.npy"), base[s],
+            err_msg=f"{s}: resume arm",
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI serve verb
+# ---------------------------------------------------------------------------
+
+def test_cli_serve_roundtrip_and_sigterm_exit(tmp_path):
+    """``pumiumtally serve`` binds, serves one socket session (box
+    mesh from the open request), and exits 0 on SIGTERM."""
+    import base64
+    import socket as socketlib
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pumiumtally_tpu.cli", "serve",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(tmp_path), env=env,
+    )
+    try:
+        line = proc.stdout.readline()
+        addr = json.loads(line)["serving"]
+        n = 32
+        rng = np.random.default_rng(3)
+        src = rng.uniform(0.1, 0.9, (n, 3))
+        dst = rng.uniform(0.1, 0.9, (n, 3))
+
+        def b64(a):
+            return base64.b64encode(
+                np.asarray(a, "<f8").tobytes()
+            ).decode()
+
+        with socketlib.create_connection(
+            (addr["host"], addr["port"]), timeout=300
+        ) as conn:
+            f = conn.makefile("rwb")
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": n, "max_queue": 8,
+                         "mesh": {"box": [1, 1, 1, 2, 2, 2]}})
+            assert r["ok"], r
+            sid = r["session"]
+            assert _rpc(f, {"op": "source", "session": sid,
+                            "positions": b64(src.reshape(-1))})["ok"]
+            assert _rpc(f, {"op": "move", "session": sid,
+                            "dests": b64(dst.reshape(-1))})["ok"]
+            r = _rpc(f, {"op": "flux", "session": sid})
+            assert r["ok"]
+            flux = np.frombuffer(base64.b64decode(r["flux"]), "<f8")
+            assert flux.shape == (6 * 2 * 2 * 2,) and flux.sum() > 0
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

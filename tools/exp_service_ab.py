@@ -1,0 +1,191 @@
+"""Service-layer overhead A/B: 1-session service vs direct facade (r11).
+
+Three arms over the IDENTICAL box workload (same mesh, same seeds,
+same per-batch protocol: one CopyInitialPosition + ``moves``
+continue-mode moves per source batch):
+
+- ``direct``: the bare monolithic facade, unfenced
+  (``fenced_timing=False`` — the established bench posture: calls
+  return at dispatch);
+- ``service``: the same facade behind a 1-session ``TallyService``,
+  unfenced — the PIPELINED serving path: submit-time prepack +
+  validation on the client thread, futures, the worker's facade call
+  returning at dispatch, so move k+1's staging overlaps move k's
+  device compute;
+- ``service_fenced``: the same served session over a
+  ``fenced_timing=True`` facade — every move synchronizes before the
+  next op runs, so the fenced-vs-pipelined spread is the measured
+  value of cross-move overlap under the service.
+
+Reported, non-interactively (one JSON line — bench.py's "service" row
+consumes it): all three rates, the service-vs-direct overhead (the
+serving tax: queue hops + one extra owned host copy per buffer), the
+pipelined/fenced speedup, and the compiles-healthy contract
+(``compiles.timed == 0`` — the service adds NO jitted entry points;
+every compile happens in the warmup batches, exactly the facade's
+own).
+
+Flux parity between the direct and served arms is asserted BITWISE
+before any number is reported — the determinism-under-concurrency
+contract's single-session corner, enforced where the measurement
+happens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _make_batches(rng, n: int, batches: int, moves: int):
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    segs = [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)]
+    return [(src, segs) for _ in range(batches)]
+
+
+def _drive_direct(t, work):
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+
+def _drive_handle(h, work, timeout=600):
+    """Submit the whole campaign through the bounded queue, retrying
+    on backpressure (the documented client reaction: the refused op
+    was never queued). The futures resolve in order; waiting on the
+    last is waiting on all."""
+    import time
+
+    from pumiumtally_tpu import ServiceBusyError
+
+    def submit(fn, *args):
+        while True:
+            try:
+                return fn(*args)
+            except ServiceBusyError:
+                time.sleep(0.0005)
+
+    futs = []
+    for src, dests in work:
+        futs.append(submit(h.copy_initial_position,
+                           src.reshape(-1).copy()))
+        for d in dests:
+            futs.append(submit(h.move, None, d.reshape(-1).copy()))
+    for f in futs:
+        f.result(timeout=timeout)
+
+
+def run_ab(
+    n: int = 100_000,
+    div: int = 20,
+    moves: int = 2,
+    batches: int = 8,
+) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import (
+        PumiTally,
+        TallyConfig,
+        TallyService,
+        build_box,
+    )
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(17)
+    work = _make_batches(rng, n, batches, moves)
+    cfg = dict(check_found_all=False, fenced_timing=False)
+    # One batch in flight end to end (source + every move of the next
+    # batch stages while the previous walks).
+    queue_depth = moves + 1
+
+    # Arm 1: direct facade.
+    t_direct = PumiTally(mesh, n, TallyConfig(**cfg))
+    _drive_direct(t_direct, work[:2])  # warmup: compiles happen here
+    jax.block_until_ready(t_direct.flux)
+    t0 = time.perf_counter()
+    _drive_direct(t_direct, work[2:])
+    jax.block_until_ready(t_direct.flux)
+    direct_s = time.perf_counter() - t0
+
+    # Arm 2: 1-session service, pipelined (unfenced facade).
+    with retrace_guard(raise_on_exceed=False) as guard:
+        with TallyService() as svc:
+            h = svc.open_session(PumiTally(mesh, n, TallyConfig(**cfg)),
+                                 max_queue=queue_depth)
+            _drive_handle(h, work[:2])
+            h.flux().result(timeout=600)  # fence the warmup
+            with retrace_guard(raise_on_exceed=False) as timed_guard:
+                t0 = time.perf_counter()
+                _drive_handle(h, work[2:])
+                flux_served = h.flux().result(timeout=600)
+                service_s = time.perf_counter() - t0
+
+    # Parity gate: a 1-session service is the bare facade plus queues
+    # — BITWISE, or the serving layer corrupted a campaign.
+    if not bool(jnp.all(t_direct.flux == jnp.asarray(flux_served))):
+        raise RuntimeError(
+            "1-session service flux diverged bitwise from the direct "
+            "facade"
+        )
+
+    # Arm 3: served but FENCED facade (no cross-move pipelining).
+    with TallyService() as svc:
+        h = svc.open_session(
+            PumiTally(mesh, n, TallyConfig(check_found_all=False,
+                                           fenced_timing=True)),
+            max_queue=queue_depth,
+        )
+        _drive_handle(h, work[:2])
+        h.flux().result(timeout=600)
+        t0 = time.perf_counter()
+        _drive_handle(h, work[2:])
+        h.flux().result(timeout=600)
+        fenced_s = time.perf_counter() - t0
+
+    moves_total = n * moves * (batches - 2)
+    return {
+        "row": "service",
+        "direct_moves_per_sec": moves_total / direct_s,
+        "service_moves_per_sec": moves_total / service_s,
+        "service_fenced_moves_per_sec": moves_total / fenced_s,
+        "service_overhead_pct": (service_s - direct_s) / direct_s * 100.0,
+        "pipeline_speedup": fenced_s / service_s,
+        "flux_parity_bitwise": True,
+        "queue_depth": queue_depth,
+        # The service adds no entry points: every compile is the
+        # facade's own, in warmup — never in the timed window.
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 20))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 8))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves, batches=batches),
+                     default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,15 +32,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RUFF_TARGETS = ["pumiumtally_tpu/", "tests/", "bench.py"]
 # pumiumtally_tpu/ covers the stats/ (r7), resilience/ (r8),
-# sentinel/ (r9) and scoring/ (r10) subsystems like every other
-# package module;
+# sentinel/ (r9), scoring/ (r10) and service/ (r11) subsystems like
+# every other package module;
 # examples/ and the bench-consumed A/B tools are jax-driving code
 # outside the package tree, added explicitly so their trace-safety
 # regressions fail the pre-PR check too.
 JAXLINT_TARGETS = [
     "pumiumtally_tpu/", "bench.py", "examples/", "tools/exp_stats_ab.py",
     "tools/exp_resilience_ab.py", "tools/exp_sentinel_ab.py",
-    "tools/exp_scoring_ab.py",
+    "tools/exp_scoring_ab.py", "tools/exp_service_ab.py",
 ]
 
 
