@@ -543,6 +543,32 @@ def run_service_ab() -> dict | None:
     )
 
 
+def run_service_fusion_ab() -> dict | None:
+    """Component row: cross-session batch fusion (r12,
+    tools/exp_fusion_ab.py run_ab) — fused vs unfused serving
+    throughput at 1/4/8 concurrent sessions on identical per-session
+    campaigns, with the per-session BITWISE flux parity gate (both
+    arms vs bare-facade solo runs) enforced inside the tool, the
+    telemetry-derived device dispatches per move (a K-way fused group
+    is ONE dispatch where the unfused arm pays K), and the
+    compiles-healthy contract — ``compiles.timed == 0``: the fused
+    program compiles once per group composition in the warmup pass,
+    never in a measured pass. Reduced per-session shape (pow2 so
+    equal sessions pack with zero padding rows); best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_fusion_ab
+
+    # Pow2 FLOOR of the (bounded) per-session batch: equal-sized
+    # sessions then pack with zero dead rows (fusion.padded_total).
+    n = min(N, 8192)
+    return exp_fusion_ab.run_ab(
+        n=1 << (n.bit_length() - 1),
+        div=min(MESH_DIV, 12), moves=2, batches=8,
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -972,6 +998,12 @@ def _measure_and_report() -> None:
             service = run_service_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# service A/B failed: {e}", file=sys.stderr)
+    service_fusion = None
+    if os.environ.get("PUMIUMTALLY_BENCH_SERVICE_FUSION", "1") != "0":
+        try:
+            service_fusion = run_service_fusion_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# service fusion A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -1126,6 +1158,13 @@ def _measure_and_report() -> None:
         # compiles-healthy contract (compiles.timed == 0: the service
         # adds no jitted entry points).
         "service": service,
+        # Cross-session batch fusion (r12): fused vs unfused serving
+        # throughput at 1/4/8 sessions (per-session flux parity
+        # bitwise inside the tool, both arms), device dispatches per
+        # move (~1/K under fusion), and the compiles-healthy contract
+        # (compiles.timed == 0: walk_fused compiles once per group
+        # composition, in warmup only).
+        "service_fusion": service_fusion,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

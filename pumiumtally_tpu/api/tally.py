@@ -232,7 +232,7 @@ def _localize_step(mesh, x, elem, dest, *, tol, max_iters, walk_kw=()):
 
 def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
                        max_iters, walk_kw=(), score_kinds=(),
-                       score_ops=None):
+                       score_ops=None, tally_seg=None):
     """Phase-B-only move: transport from the COMMITTED state straight to
     the destinations, tallying. Semantically identical to ``move_step``
     when the caller's origins equal the committed positions — the common
@@ -254,7 +254,15 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
     ``(bank, bin_off, fac)`` bundle from scoring.ScoringRuntime —
     arm the walk's segment-commit scoring hook (round 10); the return
     then gains the accumulated bank as a SIXTH element. None
-    (default) leaves the trace byte-identical to pre-scoring builds."""
+    (default) leaves the trace byte-identical to pre-scoring builds.
+
+    ``tally_seg`` (round 12, cross-session fusion) arms the walk's
+    SEGMENTED flux commit: per-particle int32 offsets added to every
+    flux scatter index, so a slab packing several sessions' particles
+    tallies each session into its own ``[E]`` segment of a
+    concatenated flux bank (ops/walk.py ``walk(tally_seg=)``). None
+    (default, every non-fused path) leaves the trace byte-identical
+    to pre-hook builds."""
     is_flying = flying[:, None] == 1
     dest_b = jnp.where(is_flying, dests, x)  # stopped → hold (cpp:100-103)
     sc = None
@@ -265,7 +273,7 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
     rb = walk(
         mesh, x, elem, dest_b, flying, weights, flux,
         tally=True, tol=tol, max_iters=max_iters, scoring=sc,
-        **dict(walk_kw),
+        tally_seg=tally_seg, **dict(walk_kw),
     )
     if score_ops is None:
         return rb.x, rb.elem, rb.flux, rb.done, rb.s
@@ -273,7 +281,8 @@ def move_step_continue(mesh, x, elem, dests, flying, weights, flux, *, tol,
 
 
 def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
-              max_iters, walk_kw=(), score_kinds=(), score_ops=None):
+              max_iters, walk_kw=(), score_kinds=(), score_ops=None,
+              tally_seg=None):
     """One full MoveToNextLocation: phase A (relocate, no tally) then
     phase B (transport, tally). Reference PumiTallyImpl.cpp:66-149.
 
@@ -320,6 +329,7 @@ def move_step(mesh, x, elem, origins, dests, flying, weights, flux, *, tol,
         mesh, xa, ea, dests, flying, weights, flux,
         tol=tol, max_iters=max_iters, walk_kw=walk_kw,
         score_kinds=score_kinds, score_ops=score_ops,
+        tally_seg=tally_seg,
     )
     x2, elem2, flux2, done_b, s_b = res[:5]
     # Per-particle mask + phase-B ray coordinate (round 9, see
@@ -347,6 +357,27 @@ _move_step_continue = register_entry_point(
 # wrapper, and only calls through the wrapper are counted.
 _locate_step = register_entry_point("locate", _locate_step)
 _localize_step = register_entry_point("localize", _localize_step)
+
+
+@dataclass
+class FusedMoveStage:
+    """One session's share of a fused cross-session launch (round 12):
+    the host half of a move, produced by ``PumiTally._fused_move_stage``
+    and consumed by ``service/fusion.py``'s pack step. Position/weight
+    buffers are HOST arrays in the working dtype (``None`` weights /
+    flying = the unit defaults, packed as ones rows); the scoring
+    operands are the per-session device arrays a solo move would stage
+    (``None`` with scoring off). ``x_prev`` is the committed position
+    array BEFORE the move — the phase-B start the sentinel audit needs
+    in continue mode."""
+
+    dests: np.ndarray  # [n,3] working dtype, host
+    origins: Optional[np.ndarray]  # [n,3] host, None = continue mode
+    fly: Optional[np.ndarray]  # [n] int8 host, None = all in flight
+    w: Optional[np.ndarray]  # [n] working dtype host, None = unit
+    sbin: Optional[jnp.ndarray]  # [n] int32 device (scoring only)
+    sfac: Optional[jnp.ndarray]  # [n,S] device (scoring only)
+    x_prev: Optional[jnp.ndarray] = None
 
 
 class PumiTally:
@@ -1332,6 +1363,119 @@ class PumiTally:
             x_prev if origins is None else origins, dests, fly, w, done,
             s_b,
         )
+
+    # -- cross-session fusion surface (round 12, service/fusion.py) ------
+    def _fusion_key(self):
+        """The co-fusability identity of this facade's moves, or None
+        when its moves must never share a fused launch.
+
+        Two sessions may pack one padded slab and run ONE walk iff
+        their moves already lower through the same program family:
+        same mesh (the fused walk gathers from ONE table set — object
+        identity, since value comparison would cost an [E]-sized scan
+        per pick), same working dtype, and the same static walk
+        configuration (tolerance, iteration budget, walk_kw, table
+        tier). A scoring spec joins through its STATIC key only — edge
+        values are per-session operands, exactly as in a solo move.
+        Host-side subsystems (sentinel, stats, resilience, timing,
+        validation) run per-session after the shared launch and do not
+        key. Conservative by construction: subclasses (streaming,
+        partitioned — their moves are chunked/multi-launch, and the
+        chunk-major scatter order that defines their bitwise contract
+        cannot survive coalescing), sharded facades, and xpoint
+        recorders never fuse."""
+        if type(self) is not PumiTally:
+            return None
+        if self.device_mesh is not None or self.config.record_xpoints:
+            return None
+        spec = self.config.scoring
+        return (
+            "mono",
+            id(self.mesh),
+            str(np.dtype(self.dtype)),
+            self._tol,
+            self._max_iters,
+            self._walk_kw,
+            self._table_dtype,
+            None if spec is None else spec.static_key(),
+        )
+
+    def _fused_move_stage(self, op) -> "FusedMoveStage":
+        """The host half of one move, for a fused group: cast the
+        PREVALIDATED staged op's buffers to the working dtype and
+        resolve the scoring operands, mutating NO facade state — a
+        later pack/launch failure can fall back to the solo path (or
+        land on exactly this session's future) with the campaign
+        untouched. ``op`` is a service ``StagedOp`` whose buffers
+        already passed submit-time validation (service/staging.py), so
+        no finite/shape checks re-run here; the protocol-order checks
+        that gate a solo move (poisoned latch, initialization) DO
+        re-run, with the same errors."""
+        self._check_poisoned()
+        if not self.is_initialized:
+            raise RuntimeError(
+                "CopyInitialPosition must be called before "
+                "MoveToNextLocation (reference invariant, "
+                "PumiTallyImpl.cpp:437-438)"
+            )
+        n = self.num_particles
+        wd = np.dtype(self.dtype)
+        sbin, sfac = self._resolve_move_scoring(op.energy, op.time)
+        return FusedMoveStage(
+            dests=np.asarray(op.dests.reshape(n, 3), dtype=wd),
+            origins=(
+                None if op.origins is None
+                else np.asarray(op.origins.reshape(n, 3), dtype=wd)
+            ),
+            fly=op.flying,
+            w=(
+                None if op.weights is None
+                else np.asarray(op.weights, dtype=wd)
+            ),
+            sbin=sbin,
+            sfac=sfac,
+            x_prev=self.x,
+        )
+
+    def _fused_move_commit(self, res, stage: "FusedMoveStage", t0: float,
+                           sentinel_ops=None) -> None:
+        """The state half of one fused move: adopt this session's
+        slice of the shared launch and run the solo move's post-walk
+        sequence in the solo order (scoring bank + ladder operands,
+        sentinel audit/ladder, iter/stats counters, found-all check,
+        fence, timing, resilience move hook). ``res`` is
+        ``(x, elem, flux, done, s, bank-or-None)``; ``sentinel_ops``
+        — ``(x_start, dests, fly, w)`` device views — is required iff
+        a sentinel is armed. ``t0`` is the GROUP's staging start, so
+        every co-fused session's TallyTimes carries the wall time its
+        move actually took (the shared launch is each move's launch).
+        The auto-continue echo snapshots are left as they were: the
+        fused pack stages from host slabs, so there is no upload to
+        skip, and a stale snapshot is value-correct by construction
+        (the echo substitutes bytes equal to whatever the caller
+        passed)."""
+        x2, elem2, flux2, done, s_b, bank2 = res
+        self.x, self.elem, self.flux = x2, elem2, flux2
+        if self._scoring is not None:
+            self._score_bank = bank2
+            self._last_score_ops = (stage.sbin, stage.sfac)
+        found_all = done
+        if self._sentinel is not None:
+            x_start, dests_dev, fly_dev, w_dev = sentinel_ops
+            found_all = self._sentinel_post_move(
+                x_start, dests_dev, fly_dev, w_dev, done, s_b
+            )
+        self.iter_count += 1
+        self._stats_note_move()
+        if self.config.check_found_all and not bool(jnp.all(found_all)):
+            print(
+                "ERROR: Not all particles are found. May need more loops "
+                "in search"
+            )
+        if self.config.fenced_timing:
+            jax.block_until_ready(self.flux)
+        self.tally_times.total_time_to_tally += _perf_counter() - t0
+        self._resilience_note_move()  # drain/timer-cadence safe point
 
     def _stats_vtk_cell_data(self) -> dict:
         """Optional flux_mean/rel_err cell arrays for the VTK payload

@@ -190,7 +190,8 @@ def cmd_serve(args) -> None:
     if args.mesh is not None:
         coords, tets = _load(args.mesh)
         default_mesh = TetMesh.from_arrays(coords, tets)
-    service = TallyService(handle_signals=True)
+    service = TallyService(handle_signals=True,
+                           fuse_sessions=not args.no_fuse)
     frontend = SocketFrontend(
         service, host=args.host, port=args.port,
         default_mesh=default_mesh, default_particles=args.particles,
@@ -380,6 +381,10 @@ def main(argv=None) -> None:
                         "path")
     c.add_argument("--allow-write", action="store_true",
                    help="let sessions write VTK output files")
+    c.add_argument("--no-fuse", action="store_true",
+                   help="disable cross-session batch fusion (serve "
+                        "every session's ops one launch at a time — "
+                        "the pre-round-12 dispatch path)")
     c.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

@@ -141,6 +141,20 @@ RETRACE_BUDGETS: dict = {
     # PUMIUMTALLY_RETRACE_RECORD — every per-test maximum stayed
     # inside the r10 budgets — and pinned by the service bench row's
     # compiles.timed == 0 (tools/exp_service_ab.py).
+    #
+    # Cross-session fusion (r12, service/fusion.py): the service's ONE
+    # jitted program — K compatible sessions' head moves in one padded
+    # slab launch. One cache key per group COMPOSITION (the spans
+    # tuple, padding, continue-vs-origins pattern, and the walk/
+    # scoring statics the fusion key already pinned equal), so a
+    # steady serving mix compiles once and then every fused dispatch
+    # hits the cache (the fusion A/B's timed window pins
+    # compiles.timed == 0). Measured tier-1 max 2
+    # (PUMIUMTALLY_RETRACE_RECORD over the full r12 tier-1: the
+    # fusion A/B schema row and the bitwise suites drive two group
+    # compositions in one test — e.g. continue-mode AND
+    # origin-passing 3-session slabs) + 1 headroom.
+    "walk_fused": 3,
 }
 
 

@@ -445,6 +445,7 @@ def walk(
     table_dtype: str = "auto",
     s_init: jnp.ndarray = None,
     scoring=None,
+    tally_seg: jnp.ndarray = None,
 ) -> WalkResult:
     """Walk every particle from ``x`` (inside ``elem``) toward ``dest``.
 
@@ -506,11 +507,31 @@ def walk(
     each stage gathers its window's rows ONCE through the carried
     original-slot index. The flux scatter is the byte-identical
     scoring-off path, so scoring-on flux stays bitwise.
+
+    ``tally_seg`` (tally walks only) is the SEGMENTED-commit hook
+    (round 12, the cross-session fusion scatter-back): a per-particle
+    walk-constant int32 offset added to every flux scatter index, so a
+    slab packing K independent particle populations tallies into a
+    concatenated ``[K·E]`` flux bank — segment k's particles commit at
+    ``k·E + elem`` and never touch another segment's lanes (dead
+    padding rows carry an offset at/past the bank end and die in the
+    scatter's ``mode="drop"``, exactly like the scoring DROP
+    sentinel). The rows ride the walk like the scoring rows: never
+    permuted by the cascade, gathered per stage through the carried
+    original-slot index. ``None`` (every non-fused path) leaves the
+    trace byte-identical to pre-hook builds. Per-segment determinism:
+    a segment's particles keep their relative row order through every
+    stable stage partition, so each bank segment accumulates the
+    bit-identical addition sequence a solo walk of that segment
+    commits (docs/DESIGN.md "Cross-session fusion").
     """
     lo_select = _resolve_lo_select(mesh, table_dtype)
     score_on = scoring is not None
     if score_on and not tally:
         raise ValueError("scoring requires a tallying walk (tally=True)")
+    seg_on = tally_seg is not None
+    if seg_on and not tally:
+        raise ValueError("tally_seg requires a tallying walk (tally=True)")
     if score_on:
         s_kinds = scoring.kinds
         # Lanes per element — static (shape-derived) like every other
@@ -541,14 +562,19 @@ def walk(
     # change, ~1 ulp).
     eff_w = jnp.where(in_flight.astype(bool), weight * seg_len, 0.0)
 
-    def advance(s, elem, dest, d0, eff_w, done, sb=None, sf=None):
+    def advance(s, elem, dest, d0, eff_w, done, sb=None, sf=None,
+                tseg=None):
         """One lock-step iteration over a (possibly windowed) batch.
         Returns the advanced (s, elem, done) plus this crossing's tally
         pair (element indexed, contribution) — the caller decides how
         to scatter (per iteration, or fused across an unrolled group).
         ``sb``/``sf`` (scoring only) are the window's walk-constant bin
         offsets / factor rows; the pair then carries the lane update
-        too (``score_pair``)."""
+        too (``score_pair``). ``tseg`` (segmented commit only) is the
+        window's walk-constant flux-index offset rows: the pair's
+        element index becomes ``elem + tseg`` — the scoring ``sidx``
+        stays un-offset because the fused bank offset rides in the
+        caller's pre-shifted ``bin_off`` rows."""
         active = ~done
         s_new, reached, next_elem, hit_boundary = _advance_geometry(
             mesh, s, elem, dest, d0, tol, one, lo_select
@@ -556,14 +582,15 @@ def walk(
 
         if tally:
             contrib = jnp.where(active, (s_new - s) * eff_w, 0.0)
+            eidx = elem if tseg is None else elem + tseg
             if score_on:
                 crossed = (active & ~reached).astype(contrib.dtype)
                 sidx, sval = score_pair(
                     s_kinds, s_stride, elem, sb, sf, contrib, crossed
                 )
-                pair = (elem, contrib, sidx, sval)
+                pair = (eidx, contrib, sidx, sval)
             else:
-                pair = (elem, contrib)
+                pair = (eidx, contrib)
         else:
             pair = None
 
@@ -577,13 +604,14 @@ def walk(
         (s, elem, done), pair = advance(
             s, elem, dest, d0, eff_w, done,
             sb0 if score_on else None, sf0 if score_on else None,
+            tally_seg if seg_on else None,
         )
         return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
     it0 = jnp.asarray(0, jnp.int32)
-    # NOTE: valid for FULL-batch loops only when scoring is armed (the
-    # step closes over the full-size sb0/sf0); the cascade builds
-    # per-stage bodies with windowed scoring rows instead.
+    # NOTE: valid for FULL-batch loops only when scoring/segmentation
+    # is armed (the step closes over the full-size sb0/sf0/tally_seg);
+    # the cascade builds per-stage bodies with windowed rows instead.
     body = fused_tally_body(step, cond_every, tally, scoring=score_on)
 
     def final_x(s, done, exited, dest, d0):
@@ -679,14 +707,18 @@ def walk(
             sb_w, sf_w = sb0[head(idx)], sf0[head(idx)]
         else:
             sb_w = sf_w = None
+        # Segment-offset rows ride exactly like the scoring rows: one
+        # [w] gather per stage through the original-slot index.
+        seg_w = tally_seg[head(idx)] if seg_on else None
         if mode == "indirect":
             idx_w = head(idx)
 
             def step_ind(it, s, elem, done, _idx=idx_w, _sb=sb_w,
-                         _sf=sf_w):
+                         _sf=sf_w, _tg=seg_w):
                 r = ray[_idx]
                 (s, elem, done), pair = advance(
-                    s, elem, r[:, 0:3], r[:, 3:6], r[:, 6], done, _sb, _sf
+                    s, elem, r[:, 0:3], r[:, 3:6], r[:, 6], done, _sb,
+                    _sf, _tg,
                 )
                 return (it + 1, s, elem, done), pair
 
@@ -702,16 +734,16 @@ def walk(
                     cond, body_i, carry_i
                 )
         else:
-            if score_on:
+            if score_on or seg_on:
                 def step_w(it, s, elem, dest, d0, eff_w, done, _sb=sb_w,
-                           _sf=sf_w):
+                           _sf=sf_w, _tg=seg_w):
                     (s, elem, done), pair = advance(
-                        s, elem, dest, d0, eff_w, done, _sb, _sf
+                        s, elem, dest, d0, eff_w, done, _sb, _sf, _tg
                     )
                     return (it + 1, s, elem, dest, d0, eff_w, done), pair
 
                 body_w = fused_tally_body(step_w, cond_every, tally,
-                                          scoring=True)
+                                          scoring=score_on)
             else:
                 body_w = body
             carry_w = (

@@ -20,13 +20,21 @@ many concurrent client sessions:
   front end (``pumiumtally serve``), and the process-wide SIGTERM
   drain that checkpoints every open session through the resilience
   dispatcher.
+- cross-session batch fusion (fusion.py, round 12) — backlogged
+  sessions grouped by fusion key pack their head moves into one
+  padded slab and share ONE device launch (entry point
+  ``"walk_fused"``, the service's single jitted program), scattering
+  per-session flux/score-bank results back bitwise-equal to solo
+  runs; ``TallyService(fuse_sessions=False)`` reproduces the
+  one-op-at-a-time round-11 path bit for bit.
 
 Core contract — determinism under concurrency: each session's output
 is BITWISE the solo run of the same campaign, regardless of how the
-scheduler interleaves sessions (pinned by tests/test_service.py).
-Everything here is host-side Python (threads, queues, numpy buffers)
-— no jitted code, no new trace entry points
-(config.RETRACE_BUDGETS unchanged, same contract as resilience/).
+scheduler interleaves sessions OR which sessions shared a fused
+launch (pinned by tests/test_service.py and tests/test_fusion.py).
+Outside fusion.py everything here is host-side Python (threads,
+queues, numpy buffers) — the fused entry point is the service's one
+addition to config.RETRACE_BUDGETS.
 """
 
 from pumiumtally_tpu.service.scheduler import DeficitRoundRobinScheduler

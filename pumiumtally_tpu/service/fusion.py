@@ -1,0 +1,325 @@
+"""Cross-session batch fusion: coalesced device launches (round 12).
+
+The paper's premise is amortization — ship particle batches to the
+device and walk them in one bulk pass instead of paying per-particle
+host overhead. The service layer (round 11) reintroduced that overhead
+one level up: ONE facade call per session per dispatch, each paying
+its own staging pass and its own device launch, with a CPU-measured
+~30% serving tax. Under heavy traffic most sessions run the SAME
+jitted programs — so their queued moves should share one launch.
+
+This module is that dispatch-amortization layer:
+
+- the worker hands ``run_group`` the head MOVES of several sessions
+  the scheduler grouped by **fusion key** (``PumiTally._fusion_key``:
+  mesh identity + facade kind + the static walk/scoring configuration
+  — i.e. sessions whose moves already lower to byte-identical HLO);
+- each facade stages its move WITHOUT mutating state
+  (``_fused_move_stage``), the host buffers pack into one padded
+  particle slab (total rows rounded up to a power of two; dead
+  padding rows carry ``in_flight=0`` / ``dest=x`` and retire on the
+  walk's first iteration with zero contribution — the walk's existing
+  done-mask semantics), and ONE jitted program (entry point
+  ``"walk_fused"``) concatenates the sessions' committed state, runs
+  ONE ``move_step`` over the slab, and scatters every session's
+  flux / scoring-bank contribution back to its own banks through the
+  walk's segmented-commit hook (``walk(tally_seg=)`` — the scoring
+  bank's fused deterministic scatter contract from round 10 is the
+  template: per-session index offsets ride the walk as never-permuted
+  walk-constant rows);
+- each facade then commits its slice (``_fused_move_commit``) — the
+  solo move's post-walk sequence (sentinel audit, counters, fence,
+  timing, resilience hook) runs per-session, after the shared launch.
+
+Determinism (the service's core contract, extended): a session's
+fused campaign output is BITWISE the solo run. Per-particle outputs
+are independent arithmetic; for the accumulated banks, a session's
+particles keep their relative row order through every stable stage
+partition of the cascade, other sessions' updates land in other bank
+segments, padding rows drop at the scatter, and a done particle's
+extra-iteration updates add exact (sign-safe) zeros — so each bank
+segment sees the bit-identical addition sequence a solo walk commits
+(docs/DESIGN.md "Cross-session fusion"; pinned by
+tests/test_fusion.py).
+
+Failure containment: a session whose stage step refuses (poisoned
+engine, move before source) gets the error on ITS future and leaves
+the group; a failing shared launch falls back to solo execution per
+session (warned — the futures then resolve exactly as unfused ops
+would); a failing per-session commit lands on that session's future
+while the other sessions' results commit.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pumiumtally_tpu.api.tally import move_step, move_step_continue
+from pumiumtally_tpu.service import staging
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def padded_total(n: int) -> int:
+    """Slab row count for ``n`` staged particles: the next power of
+    two (equal-sized pow2 sessions pack with ZERO dead rows — the
+    serving sweet spot the A/B measures). Dead rows cost one walk
+    iteration each and vanish at the first compaction boundary."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def _fused_move_impl(mesh, xs, elems, fluxes, banks, sbins, sfacs,
+                     dests, fly, w, origins, *, spans, pad,
+                     use_committed, tol, max_iters, walk_kw,
+                     score_kinds, stride):
+    """ONE launch for K sessions' head moves.
+
+    Per-session arrays arrive as tuples (``xs``/``elems``/``fluxes``
+    and, with scoring armed, ``banks``/``sbins``/``sfacs``); the
+    staged inputs arrive as already-packed ``[P]``-row slabs. All
+    structure (``spans``, ``pad``, ``use_committed``, the walk
+    statics) is static, so one group composition is one cache key.
+
+    The committed state concatenates INSIDE the program, the walk runs
+    with the segmented flux commit (segment k at ``k·E + elem``;
+    padding at ``K·E`` → dropped) and, when scoring is armed, with
+    per-session bin offsets pre-shifted by ``k·E·stride`` (the
+    session-local DROP sentinel ``>= stride`` remaps to the fused
+    bank's end so it still drops instead of landing in a neighbour's
+    segment). Returns one ``(x, elem, flux, done, s, bank)`` slice per
+    session.
+    """
+    E = fluxes[0].shape[0]
+    K = len(spans)
+    fdtype = xs[0].dtype
+
+    def cat(parts, pad_part):
+        return jnp.concatenate(
+            list(parts) + ([pad_part] if pad else []), axis=0
+        )
+
+    x = cat(xs, jnp.zeros((pad, 3), fdtype))
+    elem = cat(elems, jnp.zeros((pad,), jnp.int32))
+    flux = jnp.concatenate(list(fluxes))
+    seg = cat(
+        [jnp.full((spans[k],), k * E, jnp.int32) for k in range(K)],
+        jnp.full((pad,), K * E, jnp.int32),
+    )
+    score_kw = {}
+    if score_kinds:
+        bank = jnp.concatenate(list(banks))
+        drop = jnp.asarray(K * E * stride, jnp.int32)
+        sbin = cat(
+            [
+                jnp.where(
+                    sbins[k] >= stride, drop,
+                    sbins[k] + jnp.asarray(k * E * stride, jnp.int32),
+                )
+                for k in range(K)
+            ],
+            jnp.full((pad,), drop),
+        )
+        sfac = cat(sfacs, jnp.zeros((pad, len(score_kinds)), fdtype))
+        score_kw = {"score_kinds": score_kinds,
+                    "score_ops": (bank, sbin, sfac)}
+    kw = dict(tol=tol, max_iters=max_iters, walk_kw=walk_kw,
+              tally_seg=seg, **score_kw)
+    if all(use_committed):
+        # Every session continues from its committed state: the fused
+        # program is phase B only, exactly like each solo move.
+        res = move_step_continue(mesh, x, elem, dests, fly, w, flux,
+                                 **kw)
+    else:
+        # Sessions without staged origins synthesize origins == their
+        # committed positions: phase A walks zero distance for those
+        # rows and leaves their (x, elem) bitwise unchanged, so the
+        # follow-up phase B matches their solo continue-mode move.
+        parts = []
+        a = 0
+        for k in range(K):
+            parts.append(
+                xs[k] if use_committed[k] else origins[a:a + spans[k]]
+            )
+            a += spans[k]
+        org = cat(parts, jnp.zeros((pad, 3), fdtype))
+        res = move_step(mesh, x, elem, org, dests, fly, w, flux, **kw)
+    if score_kinds:
+        x2, elem2, flux2, done, s_b, bank2 = res
+    else:
+        x2, elem2, flux2, done, s_b = res
+        bank2 = None
+    out = []
+    a = 0
+    for k in range(K):
+        n_k = spans[k]
+        out.append((
+            x2[a:a + n_k], elem2[a:a + n_k],
+            flux2[k * E:(k + 1) * E], done[a:a + n_k], s_b[a:a + n_k],
+            None if bank2 is None
+            else bank2[k * E * stride:(k + 1) * E * stride],
+        ))
+        a += n_k
+    return tuple(out)
+
+
+_fused_move = register_entry_point(
+    "walk_fused",
+    partial(
+        jax.jit,
+        static_argnames=("spans", "pad", "use_committed", "tol",
+                         "max_iters", "walk_kw", "score_kinds",
+                         "stride"),
+    )(_fused_move_impl),
+)
+
+
+def _run_solo(live) -> bool:
+    """Execute staged-but-not-launched moves one at a time through the
+    normal facade path (the stage step mutated nothing, so the full
+    ``MoveToNextLocation`` replays cleanly — with the worker's own
+    containment, shared via ``staging.run_op_contained``). The
+    fallback for a failed pack/launch and for groups that shrank to
+    one live session — errors then land on exactly the failing
+    session's future, as unfused ops' do."""
+    drain = False
+    for sess, op, _st in live:
+        drain = staging.run_op_contained(sess.tally, op) or drain
+    return drain
+
+
+def run_group(items: List[Tuple]) -> Tuple[bool, int, int]:
+    """Execute one fused group: ``items`` is a list of
+    ``(session, StagedOp)`` move heads sharing one fusion key (the
+    worker popped them under the lock in one round trip). Resolves
+    every op's future (result None, like a solo move, or its own
+    exception). Returns ``(drain, coalesced, solo_ran)``:
+
+    - ``drain``: a facade's resilience hook raised SystemExit — the
+      worker folds it into a service-wide drain, exactly as for solo
+      ops;
+    - ``coalesced``: moves that actually went through the ONE shared
+      launch; ``solo_ran``: moves executed one launch at a time (the
+      fallback paths). The worker's ``fusion_stats`` — what the A/B's
+      dispatches-per-move is computed from — count these honestly: a
+      fallback is K dispatches, not one, and a staged op that refused
+      before any launch dispatched nothing."""
+    t0 = time.perf_counter()
+    live = []
+    for sess, op in items:
+        try:
+            st = sess.tally._fused_move_stage(op)
+        except BaseException as e:  # noqa: BLE001 — a stage refusal is
+            # that session's own error (poisoned engine, move before
+            # source); it leaves the group, the rest still fuse.
+            op.future.set_exception(e)
+        else:
+            live.append((sess, op, st))
+    if not live:
+        return False, 0, 0
+    if len(live) == 1:
+        return _run_solo(live), 0, 1
+    try:
+        outs, devs = _pack_and_launch(live)
+    except BaseException as e:  # noqa: BLE001 — availability first: a
+        # failing shared launch must not take K sessions down when
+        # each op can still run solo (and a per-session cause then
+        # surfaces on its own future). Warn so a fusion-layer bug is
+        # not silently absorbed as a perf loss.
+        warnings.warn(
+            f"fused launch failed ({type(e).__name__}: {e}); "
+            "re-executing the group unfused"
+        )
+        return _run_solo(live), 0, len(live)
+    dests_dev, fly_dev, w_dev, org_dev = devs
+    drain = False
+    a = 0
+    for k, (sess, op, st) in enumerate(live):
+        n_k = sess.tally.num_particles
+        try:
+            s_ops = None
+            if sess.tally._sentinel is not None:
+                x_start = (
+                    st.x_prev if st.origins is None
+                    else org_dev[a:a + n_k]
+                )
+                s_ops = (x_start, dests_dev[a:a + n_k],
+                         fly_dev[a:a + n_k], w_dev[a:a + n_k])
+            sess.tally._fused_move_commit(outs[k], st, t0, s_ops)
+        except SystemExit as e:
+            op.future.set_exception(e)
+            drain = True
+        except BaseException as e:  # noqa: BLE001 — one session's
+            # failing commit (quarantine IO, ladder refusal) must not
+            # cost the other sessions their already-launched results.
+            op.future.set_exception(e)
+        else:
+            op.future.set_result(None)
+        a += n_k
+    return drain, len(live), 0
+
+
+def _pack_and_launch(live):
+    """Pack the staged host buffers into padded slabs (ONE host
+    concatenation + ONE upload per operand, however many sessions),
+    then run the fused program. Returns the per-session output slices
+    and the uploaded slab device arrays (the sentinel commits slice
+    them for their audit operands)."""
+    rep = live[0][0].tally  # representative: the key pinned the statics
+    wd = np.dtype(rep.dtype)
+    spans = tuple(sess.tally.num_particles for sess, _op, _st in live)
+    P0 = sum(spans)
+    pad = padded_total(P0) - P0
+    zeros3 = np.zeros((pad, 3), wd)
+    stages = [st for _sess, _op, st in live]
+    dests = np.concatenate([st.dests for st in stages] + [zeros3])
+    fly = np.concatenate(
+        [
+            st.fly if st.fly is not None else np.ones(n, np.int8)
+            for st, n in zip(stages, spans)
+        ]
+        + [np.zeros(pad, np.int8)]
+    )
+    w = np.concatenate(
+        [
+            st.w if st.w is not None else np.ones(n, wd)
+            for st, n in zip(stages, spans)
+        ]
+        + [np.zeros(pad, wd)]
+    )
+    use_committed = tuple(st.origins is None for st in stages)
+    org_dev = None
+    if not all(use_committed):
+        org_dev = jnp.asarray(np.concatenate(
+            [
+                st.origins if st.origins is not None
+                else np.zeros((n, 3), wd)
+                for st, n in zip(stages, spans)
+            ]
+            + [zeros3]
+        ))
+    scoring = rep._scoring is not None
+    tallies = [sess.tally for sess, _op, _st in live]
+    dests_dev = jnp.asarray(dests)
+    fly_dev = jnp.asarray(fly)
+    w_dev = jnp.asarray(w)
+    outs = _fused_move(
+        rep.mesh,
+        tuple(t.x for t in tallies),
+        tuple(t.elem for t in tallies),
+        tuple(t.flux for t in tallies),
+        tuple(t._score_bank for t in tallies) if scoring else None,
+        tuple(st.sbin for st in stages) if scoring else None,
+        tuple(st.sfac for st in stages) if scoring else None,
+        dests_dev, fly_dev, w_dev, org_dev,
+        spans=spans, pad=pad, use_committed=use_committed,
+        tol=rep._tol, max_iters=rep._max_iters, walk_kw=rep._walk_kw,
+        score_kinds=rep._scoring.spec.kinds if scoring else (),
+        stride=rep._scoring.stride if scoring else 0,
+    )
+    return outs, (dests_dev, fly_dev, w_dev, org_dev)

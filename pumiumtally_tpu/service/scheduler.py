@@ -14,9 +14,12 @@ session:
   staging.StagedOp.cost);
 - a served op's cost is debited; the visit continues on the same
   session while further heads fit, then moves on;
-- a session whose queue empties forfeits its deficit (the classic DRR
-  reset — idle time banks no credit, so a bursty client cannot starve
-  the ring with saved-up quantum).
+- a session whose queue empties forfeits its banked CREDIT (the
+  classic DRR reset — idle time banks no credit, so a bursty client
+  cannot starve the ring with saved-up quantum). Owed DEBT — a
+  negative deficit from ``pick_group``'s co-fused pre-payment — is
+  kept: a session that rides fused launches and then empties still
+  pays before its next lead service.
 
 Fairness contract (docs/DESIGN.md "Multi-session service"): over any
 window in which a set of sessions stays backlogged, the cost served to
@@ -34,7 +37,7 @@ memory, or touches jax.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Any, Callable, List, Optional
 
 
 class DeficitRoundRobinScheduler:
@@ -114,7 +117,12 @@ class DeficitRoundRobinScheduler:
                 self._deficit[k] -= c
                 return k
             if c is None and k in self._deficit:
-                self._deficit[k] = 0  # emptied: forfeit banked credit
+                # Emptied: forfeit banked CREDIT only. A negative
+                # deficit is debt from pick_group's co-fused
+                # pre-payment — zeroing it would let a session that
+                # empties between submissions ride fused launches
+                # without ever being charged.
+                self._deficit[k] = min(0, self._deficit[k])
             self._visiting = None
         # Ring scan. With auto quantum the first backlogged session
         # serves immediately; with a small manual quantum the deficit
@@ -131,7 +139,9 @@ class DeficitRoundRobinScheduler:
                 self._cursor = (self._cursor + 1) % n
                 c = costs[k]
                 if c is None:
-                    self._deficit[k] = 0
+                    # Credit forfeits on empty; co-fusion debt stays
+                    # (see the visit-continuation branch above).
+                    self._deficit[k] = min(0, self._deficit[k])
                     continue
                 self._deficit[k] += quantum
                 if c <= self._deficit[k]:
@@ -152,3 +162,47 @@ class DeficitRoundRobinScheduler:
                 for k in self._keys:
                     if costs[k] is not None:
                         self._deficit[k] += (passes_needed - 1) * quantum
+
+    def pick_group(
+        self,
+        head_cost: Callable[[str], Optional[int]],
+        group_key: Callable[[str], Optional[Any]],
+        max_group: int,
+    ) -> Optional[List[str]]:
+        """The fusion window (round 12): one DRR pick, then up to
+        ``max_group - 1`` more sessions whose queued heads are
+        COMPATIBLE with it — ``group_key(sid)`` returns the head's
+        fusion key, or None for a head that must run alone (non-move
+        ops, non-fusable facades, empty queues).
+
+        Fairness accounting is unchanged in its bounds: the lead pick
+        goes through ``pick`` (quantum credits, deficit debit, visit
+        continuation), and every co-fused session is charged ITS OWN
+        head cost against its deficit — early service is pre-paid
+        service, so over any backlogged window the cost served per
+        session still tracks the deficit clock within one quantum plus
+        one maximal op cost. Co-fused members are scanned in
+        registration (ring) order, so group composition is
+        deterministic given the queue states. Returns None iff no
+        session has work; the caller must pop and run every returned
+        head (their costs are already debited)."""
+        lead = self.pick(head_cost)
+        if lead is None:
+            return None
+        group = [lead]
+        if int(max_group) <= 1:
+            return group
+        key = group_key(lead)
+        if key is None:
+            return group
+        for k in self._keys:
+            if len(group) >= int(max_group):
+                break
+            if k == lead:
+                continue
+            c = head_cost(k)
+            if c is None or group_key(k) != key:
+                continue
+            self._deficit[k] -= int(c)
+            group.append(k)
+        return group

@@ -80,10 +80,24 @@ class TallyService:
       autostart: start the worker thread lazily on the first submit
         (False = the caller starts it explicitly — the backpressure
         tests stage against a stopped worker deterministically).
+      fuse_sessions: coalesce compatible sessions' queued moves into
+        ONE padded device launch (round 12, service/fusion.py) —
+        sessions grouped by fusion key (same mesh + facade kind +
+        static walk/scoring configuration) pack one slab, run one
+        walk, and scatter per-session results back bitwise-equal to
+        solo runs. Default on; False reproduces the one-op-at-a-time
+        round-11 path bit for bit (and a 1-session service never
+        fuses either way — a group of one runs the unfused path).
+      max_fuse: the fusion window — at most this many compatible
+        session heads share one launch (bounds slab size and trace
+        keys).
     """
 
     def __init__(self, *, handle_signals: bool = False,
-                 quantum: Optional[int] = None, autostart: bool = True):
+                 quantum: Optional[int] = None, autostart: bool = True,
+                 fuse_sessions: bool = True, max_fuse: int = 8):
+        if int(max_fuse) < 1:
+            raise ValueError(f"max_fuse must be >= 1, got {max_fuse!r}")
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._sessions: Dict[str, TallySession] = {}
@@ -94,6 +108,16 @@ class TallyService:
         self._inflight = 0
         self._autostart = bool(autostart)
         self._handle_signals = bool(handle_signals)
+        self._fuse = bool(fuse_sessions)
+        self._max_fuse = int(max_fuse)
+        # Serving telemetry (read by the fusion A/B): how many device
+        # dispatch opportunities coalesced. "fused_groups" counts
+        # shared launches, "fused_moves" the moves they carried,
+        # "solo_moves"/"solo_other" the ops that ran one at a time.
+        self.fusion_stats: Dict[str, int] = {
+            "fused_groups": 0, "fused_moves": 0,
+            "solo_moves": 0, "solo_other": 0,
+        }
         self._worker: Optional[threading.Thread] = None
         if self._handle_signals:
             from pumiumtally_tpu.resilience import install_drain_owner
@@ -303,11 +327,37 @@ class TallyService:
         sess = self._sessions.get(sid)
         return None if sess is None else sess.head_cost()
 
+    def _group_key(self, sid: str):
+        """The fusion key of a session's queued head, or None when
+        that head must run alone: only MOVE ops of facades that
+        declare a fusion key (PumiTally._fusion_key) ever co-fuse —
+        sources, reads, batch closes and the close sentinel keep the
+        one-at-a-time path."""
+        sess = self._sessions.get(sid)
+        if sess is None:
+            return None
+        op = sess.head()
+        if op is None or op.kind != "move":
+            return None
+        fkey = getattr(sess.tally, "_fusion_key", None)
+        return None if fkey is None else fkey()
+
     def _worker_loop(self) -> None:
         while True:
             with self._cv:
-                sid = self._sched.pick(self._head_cost)
-                if sid is None:
+                # ONE scheduler-lock round trip per dispatched GROUP
+                # (round-12 micro-fix): the lead pick and every
+                # co-fused head pop under a single acquisition, so a
+                # K-way fused dispatch costs one lock round trip, not
+                # K.
+                if self._fuse and self._max_fuse > 1:
+                    sids = self._sched.pick_group(
+                        self._head_cost, self._group_key, self._max_fuse
+                    )
+                else:
+                    one = self._sched.pick(self._head_cost)
+                    sids = None if one is None else [one]
+                if sids is None:
                     if self._stop:
                         return
                     # Every producer notifies this condition (_submit,
@@ -318,29 +368,47 @@ class TallyService:
                     # could never hang a drain.
                     self._cv.wait(1.0)
                     continue
-                sess = self._sessions[sid]
-                op = sess.pop()
-                self._inflight += 1
+                items = []
+                for sid in sids:
+                    sess = self._sessions[sid]
+                    items.append((sess, sess.pop()))
+                self._inflight += len(items)
             # Execute OUTSIDE the lock: device work must never block
-            # staging/submission on the client threads.
-            try:
-                result = staging.execute_op(sess.tally, op)
-            except SystemExit as e:
-                # A facade-level drain exit (e.g. checkpoint_now with a
-                # pending runner drain) must not kill the worker; fold
-                # it into a service-wide drain instead.
-                op.future.set_exception(e)
-                self.request_drain()
-            except BaseException as e:  # noqa: BLE001 — server boundary:
-                # one client's failing op must not take the worker (and
-                # every other session) down; the exception travels to
-                # exactly that client through its future.
-                op.future.set_exception(e)
+            # staging/submission on the client threads. A facade-level
+            # drain exit (SystemExit, absorbed by run_op_contained /
+            # run_group) folds into a service-wide drain instead of
+            # killing the worker.
+            coalesced = solo_ran = 0
+            if len(items) == 1:
+                sess, op = items[0]
+                drain = staging.run_op_contained(sess.tally, op)
+                solo_ran = 1
             else:
-                op.future.set_result(result)
+                # Deferred import: the fuse-off (and never-fusing)
+                # service keeps the round-11 import graph.
+                from pumiumtally_tpu.service import fusion
+
+                drain, coalesced, solo_ran = fusion.run_group(items)
+            if drain:
+                self.request_drain()
             with self._cv:
-                self._inflight -= 1
-                sess.note_completed(op)
+                # Telemetry counts what actually DISPATCHED: a group
+                # whose launch fell back to solo execution reports its
+                # moves as solo (the A/B's dispatches-per-move is
+                # computed from exactly these counters), and a staged
+                # op that refused before any launch counts nowhere.
+                if coalesced:
+                    self.fusion_stats["fused_groups"] += 1
+                    self.fusion_stats["fused_moves"] += coalesced
+                if solo_ran:
+                    key = (
+                        "solo_moves"
+                        if items[0][1].kind == "move" else "solo_other"
+                    )
+                    self.fusion_stats[key] += solo_ran
+                for sess, op in items:
+                    self._inflight -= 1
+                    sess.note_completed(op)
                 self._cv.notify_all()
 
 

@@ -121,6 +121,12 @@ class TallySession:
     def head_cost(self) -> Optional[int]:
         return self._queue[0].cost if self._queue else None
 
+    def head(self) -> Optional[StagedOp]:
+        """The queued head op WITHOUT popping it (the fusion window
+        inspects kinds/keys under the service lock before committing
+        to a group)."""
+        return self._queue[0] if self._queue else None
+
     def pop(self) -> StagedOp:
         return self._queue.popleft()
 

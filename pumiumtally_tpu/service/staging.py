@@ -230,3 +230,26 @@ def execute_op(tally, op: StagedOp):
             op.origins, op.dests, op.flying, op.weights, **kw
         )
     return op.fn(tally)
+
+
+def run_op_contained(tally, op: StagedOp) -> bool:
+    """``execute_op`` with the server's containment contract, in ONE
+    place (the worker's solo path and the fusion fallback both route
+    here — a policy change cannot silently diverge them): the result
+    or exception lands on exactly this op's future, and the return
+    says whether a facade-level drain exit (SystemExit — e.g.
+    checkpoint_now with a pending runner drain) was absorbed, so the
+    caller folds it into a service-wide drain instead of letting it
+    kill the one worker thread that serves every session."""
+    try:
+        result = execute_op(tally, op)
+    except SystemExit as e:
+        op.future.set_exception(e)
+        return True
+    except BaseException as e:  # noqa: BLE001 — server boundary: one
+        # client's failing op must not take the worker (and every
+        # other session) down.
+        op.future.set_exception(e)
+        return False
+    op.future.set_result(result)
+    return False

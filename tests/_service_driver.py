@@ -8,8 +8,11 @@ tests/test_service.py can drain it mid-campaign and relaunch it with
     python tests/_service_driver.py --ckpt-dir /tmp/ck --out-dir /tmp/o \
         [--sigterm-after-batch K] [--resume]
 
-Two sessions of different facade kinds (mono + streaming), each with
-its OWN autosave store under ``<ckpt-dir>/<session>``. The campaign is
+Two sessions of different facade kinds (mono + streaming) — or, with
+``--mono-pair``, two CO-FUSABLE monolithic sessions sharing one mesh,
+so the campaign's moves coalesce into shared launches (round 12)
+before any drain lands — each with its OWN autosave store under
+``<ckpt-dir>/<session>``. The campaign is
 B source batches x M moves per session, all inputs derived from
 per-session seeded rngs — every process (fresh, drained, resumed)
 computes identical trajectories and indexes into them by each
@@ -43,8 +46,24 @@ MOVES = 2
 N = 64
 MESH_ARGS = (1, 1, 1, 3, 3, 3)
 SESSIONS = ("mono", "stream")  # session ids double as facade kinds
-SEEDS = {"mono": 101, "stream": 202}
+# --mono-pair: two monolithic sessions SHARING one mesh — the
+# co-fusable pair the round-12 drain test runs, so the campaign's
+# moves actually coalesce into shared launches before the SIGTERM
+# lands (ids still prefix-encode the facade kind).
+MONO_PAIR_SESSIONS = ("monoA", "monoB")
+SEEDS = {"mono": 101, "stream": 202, "monoA": 303, "monoB": 404}
 QUEUE_DEPTH = MOVES + 1  # one batch fits the queue: source + M moves
+
+_MESH = None  # one mesh per process: co-fusion keys on mesh identity
+
+
+def _mesh():
+    global _MESH
+    if _MESH is None:
+        from pumiumtally_tpu import build_box
+
+        _MESH = build_box(*MESH_ARGS)
+    return _MESH
 
 
 def build_tally(kind, ckpt_dir):
@@ -53,18 +72,16 @@ def build_tally(kind, ckpt_dir):
         PumiTally,
         StreamingTally,
         TallyConfig,
-        build_box,
     )
 
     policy = CheckpointPolicy(
         dir=os.path.join(ckpt_dir, kind), every_n_batches=1, keep=5,
         handle_signals=False,  # the SERVICE owns the drain handler
     )
-    mesh = build_box(*MESH_ARGS)
     cfg = TallyConfig(checkpoint=policy, check_found_all=False)
-    if kind == "mono":
-        return PumiTally(mesh, N, cfg)
-    return StreamingTally(mesh, N, chunk_size=40, config=cfg)
+    if kind.startswith("mono"):
+        return PumiTally(_mesh(), N, cfg)
+    return StreamingTally(_mesh(), N, chunk_size=40, config=cfg)
 
 
 def trajectory(kind):
@@ -82,7 +99,12 @@ def main() -> None:
     p.add_argument("--out-dir", required=True)
     p.add_argument("--sigterm-after-batch", type=int, default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--mono-pair", action="store_true",
+                   help="two co-fusable monolithic sessions instead of "
+                        "the mono+stream mix (the round-12 fusion drain "
+                        "arm)")
     args = p.parse_args()
+    sessions = MONO_PAIR_SESSIONS if args.mono_pair else SESSIONS
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JAX_ENABLE_X64", "true")
@@ -96,7 +118,7 @@ def main() -> None:
     handles = {}
     start_batch = {}
     done_moves = {}
-    for kind in SESSIONS:
+    for kind in sessions:
         t = build_tally(kind, args.ckpt_dir)
         sb = dm = 0
         if args.resume:
@@ -118,7 +140,7 @@ def main() -> None:
             break
         futs = []
         try:
-            for kind in SESSIONS:
+            for kind in sessions:
                 if b < start_batch[kind]:
                     continue  # this session resumed further along
                 src, dst = trajectory(kind)
@@ -151,15 +173,17 @@ def main() -> None:
             "drained": {
                 sid: (None if gen is None else gen[0])
                 for sid, gen in saved.items()
-            }
+            },
+            "fusion": svc.fusion_stats,
         }), flush=True)
         raise SystemExit(0)
 
     os.makedirs(args.out_dir, exist_ok=True)
-    for kind in SESSIONS:
+    for kind in sessions:
         flux = handles[kind].flux().result(timeout=300)
         np.save(os.path.join(args.out_dir, f"{kind}.npy"),
                 np.asarray(flux, np.float64))
+    print(json.dumps({"fusion": svc.fusion_stats}), flush=True)
     svc.shutdown(drain=False)
 
 

@@ -303,6 +303,40 @@ def test_service_row(bench):
     assert res["compiles"]["timed"] == 0
 
 
+def test_service_fusion_row(bench):
+    """The cross-session-fusion component row (r12): schema keys
+    present per session count, bitwise per-session flux parity in
+    BOTH arms asserted (the tool raises otherwise), the dispatch
+    amortization visible in the telemetry (1 dispatch per move
+    unfused, ~1/K fused), and the compiles-healthy contract —
+    ``compiles.timed == 0``: walk_fused compiles once per group
+    composition in the warmup pass, and every measured pass runs
+    against a hot cache. Tiny shape: the schema test pins machinery,
+    not throughput (the >= 1.15x serving gate is the full-shape A/B's
+    job)."""
+    res = bench.run_service_fusion_ab()
+    assert res["flux_parity_bitwise"] is True
+    assert res["compiles"]["timed"] == 0
+    for s_count, row in res["per_sessions"].items():
+        for key in ("unfused_moves_per_sec", "fused_moves_per_sec",
+                    "fused_speedup", "unfused_dispatches_per_move",
+                    "fused_dispatches_per_move", "fused_move_fraction"):
+            assert key in row, (s_count, key)
+        assert row["unfused_moves_per_sec"] > 0
+        assert row["fused_moves_per_sec"] > 0
+        assert row["unfused_dispatches_per_move"] == 1.0
+        if int(s_count) > 1:
+            # Every move wave coalesced: K moves -> 1 dispatch.
+            assert row["fused_dispatches_per_move"] == pytest.approx(
+                1.0 / int(s_count)
+            )
+            assert row["fused_move_fraction"] == 1.0
+        else:
+            assert row["fused_dispatches_per_move"] == 1.0
+            assert row["fused_move_fraction"] == 0.0
+    assert "walk_fused" in res["compiles"]
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

@@ -1,0 +1,552 @@
+"""Cross-session batch fusion (round 12, service/fusion.py).
+
+Contracts pinned here (docs/DESIGN.md "Cross-session fusion"):
+
+- a fused group's per-session flux / positions / elements / scoring
+  bank / sentinel health are BITWISE the solo run of each campaign —
+  the round-11 determinism contract survives sharing ONE device
+  launch (mono, scoring-armed, and origin-passing variants; the
+  fusion_stats telemetry proves the launches actually coalesced);
+- ``fuse_sessions=False`` reproduces the one-op-at-a-time path bit
+  for bit, and a 1-session service stays bitwise- AND
+  allocation-identical to the bare facade whether fusion is on or
+  off (a group of one always runs the unfused path);
+- sessions with DIFFERENT fusion keys (other facade kinds, other
+  meshes, other scoring statics) never co-fuse — and still land
+  bitwise;
+- a mid-group failure (move before source) lands on exactly the
+  failing session's future while the other sessions' results commit;
+- ``pick_group`` charges co-fused heads by their own cost (fairness
+  bounds unchanged) and groups deterministically in ring order;
+- SIGTERM drain under fusion writes one BATCH-ALIGNED generation per
+  session with bitwise per-session resume (subprocess,
+  tests/_service_driver.py --mono-pair).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from pumiumtally_tpu import (
+    EnergyFilter,
+    PumiTally,
+    ScoringSpec,
+    SentinelPolicy,
+    StreamingTally,
+    TallyConfig,
+    TallyService,
+    build_box,
+)
+from pumiumtally_tpu.service import DeficitRoundRobinScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_service_driver.py")
+
+N = 192
+BATCHES = 2
+MOVES = 2
+
+
+def _mesh():
+    return build_box(1.0, 1.0, 1.0, 3, 3, 3)
+
+
+def _campaign(seed, batches=BATCHES, moves=MOVES, n=N):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.uniform(0.1, 0.9, (n, 3)),
+         [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)],
+         [rng.uniform(0.1, 1.9, n) for _ in range(moves)])
+        for _ in range(batches)
+    ]
+
+
+def _drive_direct(t, work, with_energy=False, with_origins=False):
+    for src, dests, energies in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        prev = src
+        for d, e in zip(dests, energies):
+            kw = {"energy": e.copy()} if with_energy else {}
+            org = prev.reshape(-1).copy() if with_origins else None
+            t.MoveToNextLocation(org, d.reshape(-1).copy(), **kw)
+            prev = d
+
+
+def _submit_campaigns(svc, handles, works, with_energy=False,
+                      with_origins=False):
+    """Queue every session's whole campaign against a STOPPED worker
+    (autostart=False + generous queues), so when the worker starts,
+    all compatible heads are backlogged together — fusion grouping is
+    then deterministic, not a race against client threads."""
+    futs = []
+    for b in range(BATCHES):
+        for sid, h in handles.items():
+            src, dests, energies = works[sid][b]
+            futs.append(h.copy_initial_position(src.reshape(-1).copy()))
+            prev = src
+            for d, e in zip(dests, energies):
+                kw = {"energy": e.copy()} if with_energy else {}
+                org = prev.reshape(-1).copy() if with_origins else None
+                futs.append(h.move(org, d.reshape(-1).copy(), **kw))
+                prev = d
+    svc.start()
+    for f in futs:
+        f.result(timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# pick_group (pure scheduler)
+# ---------------------------------------------------------------------------
+
+def test_pick_group_charges_cofused_heads_by_own_cost():
+    """The fusion window serves compatible heads early but charges
+    each by ITS OWN cost: the co-fused session's deficit goes negative
+    (pre-paid service), so over a backlogged window the DRR fairness
+    bound is unchanged."""
+    sched = DeficitRoundRobinScheduler()
+    for k in ("a", "b", "c"):
+        sched.register(k)
+    costs = {"a": 5, "b": 3, "c": 7}
+    keys = {"a": "K", "b": "K", "c": "K"}
+    group = sched.pick_group(lambda k: costs.get(k),
+                             lambda k: keys.get(k), max_group=8)
+    assert group == ["a", "b", "c"]
+    # The lead paid through pick() (quantum 7 credited, 5 debited);
+    # the co-fused members were debited their own costs with no
+    # credit.
+    assert sched.deficit("a") == 2
+    assert sched.deficit("b") == -3
+    assert sched.deficit("c") == -7
+
+
+def test_cofusion_debt_survives_queue_empty():
+    """The empty-queue forfeit drops banked CREDIT only: a session
+    that rides fused launches in one-at-a-time bursts (queue empties
+    between submissions) keeps its negative deficit across the empty
+    — otherwise its entire consumption would be forgiven and the
+    fairness bound would not hold for intermittent co-fused
+    sessions."""
+    sched = DeficitRoundRobinScheduler()  # auto quantum
+    for k in ("a", "b"):
+        sched.register(k)
+    costs = {"a": 4, "b": 4}
+    group = sched.pick_group(lambda k: costs.get(k), lambda k: "K", 8)
+    assert group == ["a", "b"]
+    assert sched.deficit("b") == -4  # pre-paid co-fused service
+    # b's queue empties; a stays backlogged. The visit/ring forfeits
+    # must NOT zero b's debt.
+    assert sched.pick(lambda k: 4 if k == "a" else None) == "a"
+    assert sched.deficit("b") == -4
+    # Positive CREDIT still forfeits on empty (the classic DRR reset):
+    # with quantum=3, x (cost 5) needs two passes, so y banks +3...
+    sched2 = DeficitRoundRobinScheduler(quantum=3)
+    sched2.register("x")
+    sched2.register("y")
+    assert sched2.pick(lambda k: 5) == "x"
+    assert sched2.deficit("y") == 3
+    # ...then y empties: its banked credit drops to zero, not below.
+    assert sched2.pick(lambda k: 5 if k == "x" else None) == "x"
+    assert sched2.deficit("y") == 0
+
+
+def test_pick_group_respects_keys_window_and_nonfusable_heads():
+    sched = DeficitRoundRobinScheduler()
+    for k in ("a", "b", "c", "d"):
+        sched.register(k)
+    costs = {"a": 1, "b": 1, "c": 1, "d": 1}
+    keys = {"a": "K", "b": "OTHER", "c": None, "d": "K"}
+    # b (different key) and c (non-fusable head) stay out; d joins.
+    group = sched.pick_group(lambda k: costs.get(k),
+                             lambda k: keys.get(k), max_group=8)
+    assert group == ["a", "d"]
+    # A window of one degenerates to plain pick (no key calls needed).
+    sched2 = DeficitRoundRobinScheduler()
+    sched2.register("x")
+    sched2.register("y")
+    group = sched2.pick_group(lambda k: 1, lambda k: "K", max_group=1)
+    assert group == ["x"]
+    # Nothing queued -> None, like pick().
+    assert sched2.pick_group(lambda k: None, lambda k: None, 8) is None
+
+
+# ---------------------------------------------------------------------------
+# The walk's segmented-commit hook (ops/walk.py walk(tally_seg=))
+# ---------------------------------------------------------------------------
+
+def test_walk_tally_seg_bitwise_across_perm_modes():
+    """The segmented flux commit at the kernel level: a slab packing
+    two independent populations, walked ONCE with per-particle segment
+    offsets into a [2E] bank, reproduces each population's solo walk
+    BITWISE — flux segments AND per-particle outputs — in every
+    cascade permutation mode (the stable stage partitions preserve
+    each segment's relative row order; "sorted" holds too because a
+    stable sort induces the stable sort of every subsequence). Small
+    min_window so the cascade actually runs at test size."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu.ops.walk import walk
+
+    mesh = _mesh()
+    E = int(mesh.nelems)
+    fdtype = mesh.coords.dtype
+    c0 = np.asarray(jnp.mean(mesh.coords[mesh.tet2vert[0]], axis=0))
+
+    def pop(n, seed):
+        r = np.random.default_rng(seed)
+        return (np.broadcast_to(c0, (n, 3)).astype(fdtype),
+                r.uniform(0.1, 0.9, (n, 3)).astype(fdtype),
+                r.uniform(0.5, 1.5, n).astype(fdtype))
+
+    pops = [pop(512, 1), pop(384, 2)]
+    for mode in ("packed", "arrays", "indirect", "sorted"):
+        kw = dict(tally=True, tol=1e-8, max_iters=600, min_window=256,
+                  perm_mode=mode)
+        solos = []
+        for x, dest, w in pops:
+            n = x.shape[0]
+            solos.append(walk(
+                mesh, jnp.asarray(x), jnp.zeros((n,), jnp.int32),
+                jnp.asarray(dest), jnp.ones((n,), jnp.int8),
+                jnp.asarray(w), jnp.zeros((E,), fdtype), **kw,
+            ))
+        seg = np.concatenate([
+            np.full(pops[0][0].shape[0], 0, np.int32),
+            np.full(pops[1][0].shape[0], E, np.int32),
+        ])
+        fused = walk(
+            mesh,
+            jnp.asarray(np.concatenate([p[0] for p in pops])),
+            jnp.zeros((seg.shape[0],), jnp.int32),
+            jnp.asarray(np.concatenate([p[1] for p in pops])),
+            jnp.ones((seg.shape[0],), jnp.int8),
+            jnp.asarray(np.concatenate([p[2] for p in pops])),
+            jnp.zeros((2 * E,), fdtype),
+            tally_seg=jnp.asarray(seg), **kw,
+        )
+        a = 0
+        for k, solo in enumerate(solos):
+            n = pops[k][0].shape[0]
+            np.testing.assert_array_equal(
+                np.asarray(fused.flux)[k * E:(k + 1) * E],
+                np.asarray(solo.flux), err_msg=f"{mode} seg {k}",
+            )
+            for field in ("x", "elem", "done", "s"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(fused, field))[a:a + n],
+                    np.asarray(getattr(solo, field)),
+                    err_msg=f"{mode} {field} seg {k}",
+                )
+            a += n
+    with pytest.raises(ValueError, match="tally_seg"):
+        x, dest, _w = pops[0]
+        walk(mesh, jnp.asarray(x), jnp.zeros((512,), jnp.int32),
+             jnp.asarray(dest), jnp.ones((512,), jnp.int8),
+             jnp.zeros((512,), fdtype), jnp.zeros((0,), fdtype),
+             tally=False, tol=1e-8, max_iters=10,
+             tally_seg=jnp.asarray(seg[:512]))
+
+
+# ---------------------------------------------------------------------------
+# Fused bitwise parity (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+def _fused_vs_solo(mesh, build, *, with_energy=False, with_origins=False,
+                   expect_fused, fuse=True, seeds=(71, 72, 73)):
+    """Run len(seeds) sessions through one service and compare each,
+    bitwise, against the solo run of the same campaign."""
+    svc = TallyService(autostart=False, fuse_sessions=fuse)
+    handles = {}
+    works = {}
+    for i, seed in enumerate(seeds):
+        sid = f"s{i}"
+        # Generous queues: the whole campaign stages against the
+        # stopped worker (see _submit_campaigns).
+        handles[sid] = svc.open_session(build(i), session_id=sid,
+                                        max_queue=BATCHES * (MOVES + 2))
+        works[sid] = _campaign(seed)
+    _submit_campaigns(svc, handles, works, with_energy, with_origins)
+    out = {
+        sid: {
+            "flux": h.flux().result(timeout=300),
+            "pos": h.tally.positions,
+            "elem": h.tally.elem_ids,
+        }
+        for sid, h in handles.items()
+    }
+    for sid, h in handles.items():
+        if h.tally._scoring is not None:
+            out[sid]["bank"] = h.score_bank().result(timeout=300)
+        if h.tally._sentinel is not None:
+            out[sid]["health"] = (
+                h.health_report().result(timeout=300).as_dict()
+            )
+    stats = dict(svc.fusion_stats)
+    svc.shutdown(drain=False)
+    total_moves = len(seeds) * BATCHES * MOVES
+    if expect_fused:
+        assert stats["fused_moves"] == total_moves, stats
+        assert stats["solo_moves"] == 0, stats
+    else:
+        assert stats["fused_groups"] == 0, stats
+        assert stats["solo_moves"] == total_moves, stats
+    for i, seed in enumerate(seeds):
+        sid = f"s{i}"
+        solo = build(i)
+        _drive_direct(solo, _campaign(seed), with_energy, with_origins)
+        np.testing.assert_array_equal(
+            out[sid]["flux"], np.asarray(solo.flux), err_msg=sid,
+        )
+        np.testing.assert_array_equal(out[sid]["pos"], solo.positions,
+                                      err_msg=sid)
+        np.testing.assert_array_equal(out[sid]["elem"], solo.elem_ids,
+                                      err_msg=sid)
+        if "bank" in out[sid]:
+            np.testing.assert_array_equal(
+                out[sid]["bank"], np.asarray(solo.score_bank),
+                err_msg=sid,
+            )
+        if "health" in out[sid]:
+            assert out[sid]["health"] == solo.health_report().as_dict()
+    return stats
+
+
+def test_fused_three_mono_sessions_bitwise_vs_solo():
+    """THE fusion pin: three co-fusable monolithic sessions run their
+    whole campaigns through shared launches (every move fused —
+    telemetry-checked) and each lands flux/positions/elements BITWISE
+    on its solo run. Continue-mode and origin-passing (phase A through
+    the fused program) both covered."""
+    mesh = _mesh()
+
+    def build(_i):
+        return PumiTally(mesh, N, TallyConfig(check_found_all=False))
+
+    _fused_vs_solo(mesh, build, expect_fused=True)
+    _fused_vs_solo(mesh, build, with_origins=True, expect_fused=True)
+
+
+def test_fused_scoring_and_sentinel_sessions_bitwise_vs_solo():
+    """Scoring lanes ride the fused launch (per-session bank segments
+    through the pre-shifted bin offsets) and a sentinel-armed session
+    co-fuses with unarmed ones (the audit runs per-session after the
+    shared launch): banks and health records bitwise vs solo."""
+    mesh = _mesh()
+
+    def build(i):
+        spec = ScoringSpec(
+            filters=[EnergyFilter(np.array([0.0, 1.0, 2.0]))],
+            scores=["flux", "events"],
+        )
+        kw = {"check_found_all": False, "scoring": spec}
+        if i == 1:
+            kw["sentinel"] = SentinelPolicy()
+        return PumiTally(mesh, N, TallyConfig(**kw))
+
+    _fused_vs_solo(mesh, build, with_energy=True, expect_fused=True)
+
+
+def test_streaming_sessions_do_not_fuse_and_stay_bitwise():
+    """Chunked facades declare no fusion key (their chunk-major
+    scatter order cannot survive coalescing): with fusion ON their
+    moves run one at a time — and still bitwise."""
+    mesh = _mesh()
+
+    def build(_i):
+        return StreamingTally(mesh, N, chunk_size=64,
+                              config=TallyConfig(check_found_all=False))
+
+    _fused_vs_solo(mesh, build, expect_fused=False, seeds=(81, 82))
+
+
+def test_mixed_key_sessions_never_cofuse():
+    """Different meshes, different facade kinds, and different scoring
+    statics are different fusion keys: a mixed zoo runs entirely
+    unfused (zero groups) and bitwise."""
+    mesh_a = _mesh()
+    mesh_b = _mesh()  # equal values, DIFFERENT identity: no co-fusion
+    spec = ScoringSpec(scores=["flux"])
+
+    def build(i):
+        if i == 0:
+            return PumiTally(mesh_a, N, TallyConfig(check_found_all=False))
+        if i == 1:
+            return PumiTally(mesh_b, N, TallyConfig(check_found_all=False))
+        return PumiTally(mesh_a, N, TallyConfig(check_found_all=False,
+                                                scoring=spec))
+
+    stats = _fused_vs_solo(mesh_a, build, expect_fused=False)
+    assert stats["fused_groups"] == 0
+
+
+def test_fuse_off_is_bitwise_and_allocation_identical():
+    """fuse_sessions=False: the round-11 one-op-at-a-time path, bit
+    for bit — multi-session campaigns land bitwise, and the 1-session
+    service allocates not one device array more than the bare facade
+    (fusion code never runs, so the live-array census matches exactly
+    as it did in round 11)."""
+    mesh = _mesh()
+
+    def build(_i):
+        return PumiTally(mesh, N, TallyConfig(check_found_all=False))
+
+    _fused_vs_solo(mesh, build, expect_fused=False, fuse=False,
+                   seeds=(91, 92))
+
+    # Allocation census (the round-11 single-session pin, re-run with
+    # the knob in both positions: a group of one never fuses).
+    work = _campaign(93)
+    warm = PumiTally(mesh, N)
+    _drive_direct(warm, work)
+    del warm
+    gc.collect()
+    base = len(jax.live_arrays())
+
+    t_direct = PumiTally(mesh, N)
+    _drive_direct(t_direct, work)
+    flux_d = np.asarray(t_direct.flux)
+    gc.collect()
+    direct_delta = len(jax.live_arrays()) - base
+
+    for fuse in (False, True):
+        gc.collect()
+        prev = len(jax.live_arrays())
+        t_served = PumiTally(mesh, N)
+        svc = TallyService(fuse_sessions=fuse)
+        h = svc.open_session(t_served, max_queue=BATCHES * (MOVES + 2))
+        futs = []
+        for src, dests, _ in work:
+            futs.append(h.copy_initial_position(src.reshape(-1).copy()))
+            for d in dests:
+                futs.append(h.move(None, d.reshape(-1).copy()))
+        for f in futs:
+            f.result(timeout=300)
+        # Owned copy: the raw read is a view whose .base pins the
+        # facade's device array across the next loop's census.
+        flux_s = np.array(h.flux().result(timeout=300))
+        assert svc.fusion_stats["fused_groups"] == 0
+        svc.shutdown(drain=False)
+        del svc, h, futs
+        gc.collect()
+        # The (still-live) served facade accounts for every device
+        # array the run left behind — the service itself added none.
+        served_delta = len(jax.live_arrays()) - prev
+        np.testing.assert_array_equal(flux_s, flux_d)
+        assert served_delta == direct_delta, f"fuse_sessions={fuse}"
+        del t_served
+
+
+def test_mid_group_error_lands_on_failing_session_only():
+    """A session whose staged move refuses at the fused stage step
+    (move before source) gets the error on ITS future; the other
+    sessions in the group still fuse, commit, and land bitwise — and
+    the failed session recovers with a late source."""
+    mesh = _mesh()
+    svc = TallyService(autostart=False)
+    hs = [
+        svc.open_session(
+            PumiTally(mesh, N, TallyConfig(check_found_all=False)),
+            session_id=f"s{i}", max_queue=8,
+        )
+        for i in range(3)
+    ]
+    works = [_campaign(61 + i, batches=1) for i in range(3)]
+    futs = []
+    for i, h in enumerate(hs):
+        src, dests, _ = works[i][0]
+        if i != 2:  # s2 never sources: its move must fail at stage
+            futs.append(h.copy_initial_position(src.reshape(-1).copy()))
+        futs.append(h.move(None, dests[0].reshape(-1).copy()))
+    svc.start()
+    with pytest.raises(RuntimeError, match="CopyInitialPosition"):
+        futs[-1].result(timeout=300)
+    for f in futs[:-1]:
+        f.result(timeout=300)
+    # The refusal SHRANK the launch to the healthy pair instead of
+    # breaking it — and the telemetry counts what actually dispatched:
+    # two moves through one shared launch, the refused op nowhere (it
+    # dispatched nothing).
+    assert svc.fusion_stats["fused_groups"] == 1, svc.fusion_stats
+    assert svc.fusion_stats["fused_moves"] == 2, svc.fusion_stats
+    # The failed session is not poisoned: a late source + move works.
+    src2, dests2, _ = works[2][0]
+    hs[2].copy_initial_position(src2.reshape(-1).copy())
+    hs[2].move(None, dests2[0].reshape(-1).copy())
+    fluxes = [h.flux().result(timeout=300) for h in hs]
+    svc.shutdown(drain=False)
+    for i in range(3):
+        solo = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+        src, dests, _ = works[i][0]
+        solo.CopyInitialPosition(src.reshape(-1).copy())
+        solo.MoveToNextLocation(None, dests[0].reshape(-1).copy())
+        np.testing.assert_array_equal(fluxes[i], np.asarray(solo.flux),
+                                      err_msg=f"s{i}")
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain under fusion (subprocess)
+# ---------------------------------------------------------------------------
+
+def _run_driver(ckpt_dir, out_dir, *extra, timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PUMIUMTALLY_FAULT", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    return subprocess.run(
+        [sys.executable, DRIVER, "--ckpt-dir", str(ckpt_dir),
+         "--out-dir", str(out_dir), "--mono-pair", *extra],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=env,
+    )
+
+
+def _last_json(stdout: str) -> dict:
+    return json.loads(
+        [ln for ln in stdout.splitlines() if ln.startswith("{")][-1]
+    )
+
+
+def test_drain_under_fusion_batch_aligned_and_bitwise_resume(tmp_path):
+    """SIGTERM against a server whose two sessions were actually
+    SHARING launches: exit 0, one BATCH-ALIGNED generation per session
+    (iter_count a multiple of the per-batch move count), and the
+    resumed campaigns land bitwise on the uninterrupted (also fused)
+    reference — fusion changes dispatch, never state."""
+    from tests._service_driver import MONO_PAIR_SESSIONS
+    from tests._service_driver import MOVES as DRV_MOVES
+
+    r = _run_driver(tmp_path / "ck_base", tmp_path / "out_base")
+    assert r.returncode == 0, r.stderr
+    assert _last_json(r.stdout)["fusion"]["fused_moves"] > 0
+    base = {
+        s: np.load(tmp_path / "out_base" / f"{s}.npy")
+        for s in MONO_PAIR_SESSIONS
+    }
+
+    r = _run_driver(tmp_path / "ck", tmp_path / "out",
+                    "--sigterm-after-batch", "1")
+    assert r.returncode == 0, r.stderr
+    assert not (tmp_path / "out").exists()
+    drained = _last_json(r.stdout)
+    assert set(drained["drained"]) == set(MONO_PAIR_SESSIONS)
+    assert all(g is not None for g in drained["drained"].values())
+    assert drained["fusion"]["fused_moves"] > 0  # drained WHILE fusing
+
+    r = _run_driver(tmp_path / "ck", tmp_path / "out", "--resume")
+    assert r.returncode == 0, r.stderr
+    for s in MONO_PAIR_SESSIONS:
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith(f"resumed session {s} ")][0]
+        iter_count = int(line.rsplit("iter_count ", 1)[1].rstrip(")"))
+        assert iter_count % DRV_MOVES == 0  # batch-aligned
+        assert iter_count == 2 * DRV_MOVES  # drained after batch 1
+        np.testing.assert_array_equal(
+            np.load(tmp_path / "out" / f"{s}.npy"), base[s],
+            err_msg=f"{s}: resume arm",
+        )

@@ -618,30 +618,35 @@ def test_scoring_subsystem_registered_and_pragma_free():
 
 
 def test_service_subsystem_registered_and_pragma_free():
-    """The multi-session-service modules (r11) must be IN the
-    self-check's file set and hold the strongest form of the clean
-    contract: zero violations with zero pragmas — the service layer is
-    host-side threading and prepacked numpy buffers with NO trace
-    roots at all, so there is no excuse for even a justified
-    suppression. The bench-consumed A/B tool is covered the same way
-    (it is in tools/lint_all.py's jaxlint targets)."""
+    """The multi-session-service modules (r11, plus the r12 fusion
+    module) must be IN the self-check's file set and hold the
+    strongest form of the clean contract: zero violations with zero
+    pragmas — the service layer is host-side threading and prepacked
+    numpy buffers, and its ONE trace root (fusion.py's walk_fused) is
+    a plain jitted pack/walk/split program with no host syncs
+    reachable from the trace, so there is no excuse for even a
+    justified suppression. The bench-consumed A/B tools are covered
+    the same way (they are in tools/lint_all.py's jaxlint targets)."""
     import glob
 
     svc_dir = os.path.join(REPO, "pumiumtally_tpu", "service")
     files = sorted(glob.glob(os.path.join(svc_dir, "*.py")))
     names = {os.path.basename(f) for f in files}
     assert {"__init__.py", "session.py", "scheduler.py", "staging.py",
-            "server.py"} <= names
+            "server.py", "fusion.py"} <= names
     from pumiumtally_tpu.analysis import lint_paths
 
-    ab = os.path.join(REPO, "tools", "exp_service_ab.py")
-    assert lint_paths(files + [ab]) == []
-    for f in files + [ab]:
+    abs_ = [os.path.join(REPO, "tools", "exp_service_ab.py"),
+            os.path.join(REPO, "tools", "exp_fusion_ab.py")]
+    assert lint_paths(files + abs_) == []
+    for f in files + abs_:
         with open(f) as fh:
             assert "jaxlint: disable" not in fh.read(), (
                 f"{f}: the service modules ship pragma-free"
             )
-    # tools/lint_all.py actually targets the A/B tool (a slip here
-    # would silently drop its CI coverage).
+    # tools/lint_all.py actually targets the A/B tools (a slip here
+    # would silently drop their CI coverage).
     with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
-        assert "tools/exp_service_ab.py" in fh.read()
+        targets = fh.read()
+    assert "tools/exp_service_ab.py" in targets
+    assert "tools/exp_fusion_ab.py" in targets

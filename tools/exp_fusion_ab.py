@@ -1,0 +1,223 @@
+"""Cross-session fusion A/B: fused vs unfused serving throughput (r12).
+
+The round-11 service executes ONE facade call per session per
+dispatch; round 12 coalesces compatible sessions' queued moves into
+one padded launch (service/fusion.py). This tool measures what that
+buys, non-interactively (one JSON line — bench.py's "service_fusion"
+row consumes it):
+
+For N_sessions in {1, 4, 8}: the IDENTICAL per-session campaigns run
+through two services —
+
+- ``unfused``: ``TallyService(fuse_sessions=False)`` — the round-11
+  one-op-at-a-time serving path;
+- ``fused``: ``TallyService()`` (fusion on, the default) — compatible
+  heads share one ``walk_fused`` launch.
+
+Protocol: every session's WHOLE campaign pre-queues against a stopped
+worker (``autostart=False``, deep queues), then the worker starts and
+drains it — the steady heavy-traffic backlog, made DETERMINISTIC (one
+worker thread, no client-thread races: each batch wave serves the S
+sources one at a time, then every move wave as one full-width fused
+group; the unfused arm serves the same ops one at a time). Each arm
+runs twice: the first pass holds every compile, the measured second
+pass must be cache-hits only.
+
+Reported per N: both throughputs, the fused/unfused speedup, and the
+device dispatches per move from the service's own fused-vs-solo
+telemetry (``fusion_stats``: a K-way fused group is ONE dispatch
+where the unfused arm pays K) — the ~N-fold dispatch amortization the
+tentpole exists for.
+
+Gates enforced HERE, before any number is reported:
+
+- **bitwise per-session parity**: every served session's flux (both
+  arms) equals the solo run of its campaign on a bare facade, bit for
+  bit;
+- **compiles.timed == 0**: no compile lands inside any measured pass.
+
+The default per-session batch is a power of two, so equal-sized
+sessions pack with ZERO padding rows (fusion.padded_total) — the
+serving sweet spot. Override via PUMIUMTALLY_AB_N etc. to probe other
+regimes (a non-pow2 n measures the dead-row tax too).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SESSION_COUNTS = (1, 4, 8)
+
+
+def _campaign(seed: int, n: int, batches: int, moves: int):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.uniform(0.1, 0.9, (n, 3)),
+         [rng.uniform(0.1, 0.9, (n, 3)) for _ in range(moves)])
+        for _ in range(batches)
+    ]
+
+
+def _drive_direct(t, work):
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+
+def _run_arm(mesh, n, works, fuse, batches, moves):
+    """One serving arm: pre-queue every campaign, start the worker,
+    time the drain. Returns (seconds, per-session flux, dispatch
+    telemetry)."""
+    import time
+
+    from pumiumtally_tpu import PumiTally, TallyConfig, TallyService
+
+    cfg = TallyConfig(check_found_all=False, fenced_timing=False)
+    depth = batches * (moves + 1) + 2
+    with TallyService(fuse_sessions=fuse, autostart=False) as svc:
+        handles = {
+            sid: svc.open_session(PumiTally(mesh, n, cfg),
+                                  session_id=sid, max_queue=depth)
+            for sid in works
+        }
+        futs = []
+        for b in range(batches):
+            for sid, h in handles.items():
+                src, _dests = works[sid][b]
+                futs.append(h.copy_initial_position(
+                    src.reshape(-1).copy()
+                ))
+            for m in range(moves):
+                for sid, h in handles.items():
+                    _src, dests = works[sid][b]
+                    futs.append(h.move(None,
+                                       dests[m].reshape(-1).copy()))
+        t0 = time.perf_counter()
+        svc.start()
+        for f in futs:
+            f.result(timeout=600)
+        fluxes = {
+            sid: np.array(h.flux().result(timeout=600))
+            for sid, h in handles.items()
+        }
+        seconds = time.perf_counter() - t0
+        stats = dict(svc.fusion_stats)
+    return seconds, fluxes, stats
+
+
+def run_ab(
+    n: int = 8_192,
+    div: int = 12,
+    moves: int = 2,
+    batches: int = 8,
+    session_counts=SESSION_COUNTS,
+    trials: int = 2,
+) -> dict:
+    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    per = {}
+    timed_compiles = 0
+    with retrace_guard(raise_on_exceed=False) as guard:
+        for s_count in session_counts:
+            works = {
+                f"s{i}": _campaign(1000 + 17 * i, n, batches, moves)
+                for i in range(s_count)
+            }
+
+            def measure(fuse):
+                """Warmup pass (holds every compile), then ``trials``
+                measured passes against the hot jit cache — min wall
+                time (least interference) wins; every measured pass
+                must be compile-free."""
+                nonlocal timed_compiles
+                _run_arm(mesh, n, works, fuse, batches, moves)
+                best = None
+                for _ in range(max(1, trials)):
+                    with retrace_guard(raise_on_exceed=False) as tg:
+                        got = _run_arm(mesh, n, works, fuse, batches,
+                                       moves)
+                    timed_compiles += tg.total_compiles
+                    if best is None or got[0] < best[0]:
+                        best = got
+                return best
+
+            unf_s, unf_flux, unf_stats = measure(False)
+            fus_s, fus_flux, fus_stats = measure(True)
+            # Bitwise per-session parity gate, BOTH arms, before any
+            # number is reported.
+            for i in range(s_count):
+                sid = f"s{i}"
+                solo = PumiTally(mesh, n, TallyConfig(
+                    check_found_all=False, fenced_timing=False))
+                _drive_direct(solo, works[sid])
+                solo_flux = np.asarray(solo.flux)
+                if not np.array_equal(unf_flux[sid], solo_flux):
+                    raise RuntimeError(
+                        f"{s_count} sessions: unfused {sid} flux "
+                        "diverged bitwise from the solo run"
+                    )
+                if not np.array_equal(fus_flux[sid], solo_flux):
+                    raise RuntimeError(
+                        f"{s_count} sessions: FUSED {sid} flux "
+                        "diverged bitwise from the solo run"
+                    )
+            total_moves = s_count * batches * moves
+            unf_disp = unf_stats["solo_moves"] + unf_stats["fused_groups"]
+            fus_disp = fus_stats["solo_moves"] + fus_stats["fused_groups"]
+            per[str(s_count)] = {
+                "unfused_moves_per_sec": total_moves * n / unf_s,
+                "fused_moves_per_sec": total_moves * n / fus_s,
+                "fused_speedup": unf_s / fus_s,
+                "unfused_dispatches_per_move": unf_disp / total_moves,
+                "fused_dispatches_per_move": fus_disp / total_moves,
+                "fused_move_fraction": (
+                    fus_stats["fused_moves"] / total_moves
+                ),
+            }
+    return {
+        "row": "service_fusion",
+        "per_sessions": per,
+        "flux_parity_bitwise": True,
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles_per_session": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 8_192))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 12))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 8))
+    trials = int(os.environ.get("PUMIUMTALLY_AB_TRIALS", 2))
+    counts = tuple(
+        int(x) for x in os.environ.get(
+            "PUMIUMTALLY_AB_SESSIONS", "1,4,8"
+        ).split(",")
+    )
+    print(json.dumps(
+        run_ab(n=n, div=div, moves=moves, batches=batches,
+               session_counts=counts, trials=trials),
+        default=float,
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/bin/bash
+# r11 on-chip suite (PR 11 — the round-12 cross-session batch fusion
+# layer; suites are numbered by PR like r8-r10 before it, one less
+# than the docs/DESIGN.md round they measure).
+# Fired by a probe loop (tools/r5_probe_loop.sh pattern) the moment
+# the TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK headline
+# bench first (a short window must still yield a fresh cached
+# measurement), then the full bench (whose row set now includes the
+# SERVICE_FUSION component row in-process), then THIS round's two
+# measurements —
+#   fusion_ab: fused vs unfused serving throughput at 1/4/8 sessions
+#     at serving shape (pow2 per-session batches so slabs pack
+#     pad-free; per-session bitwise flux-parity gate in BOTH arms and
+#     the zero-compile measured-pass contract enforced inside the
+#     tool). On-chip this decides the armed round-12 bet
+#     (docs/PERF_NOTES.md "Cross-session batch fusion"): SHIP fusion
+#     default-on if fused >= 1.15x unfused at 4+ sessions with
+#     dispatches/move ~1/K; KILL (flip the default off) if < 1.05x —
+#     on a real accelerator the dispatch amortization should GROW
+#     relative to CPU (launch overhead is a bigger fraction when the
+#     walk itself is fast), so a flat result means the pack/split
+#     cost ate the win;
+#   service_ab: the round-11 serving-tax re-measure (the ~30% CPU
+#     figure fusion exists to shrink), unchanged shape so rounds
+#     compare like-for-like —
+# then the inherited subsystem A/Bs and engine experiments; chipless
+# AOT compiles go last (the remote compile helper remains the prime
+# wedge suspect).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r11_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r11 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_SCORING=0 PUMIUMTALLY_BENCH_RESILIENCE=0 PUMIUMTALLY_BENCH_SENTINEL=0 PUMIUMTALLY_BENCH_SERVICE=0 PUMIUMTALLY_BENCH_SERVICE_FUSION=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-12 measurement: cross-session fusion at serving shape —
+# larger per-session batches than the in-bench row (still pow2 so
+# equal sessions pack pad-free) plus a 16-session point, because on
+# chip the dispatch amortization is the whole question. Decides the
+# ship/kill rule in the header.
+run fusion_ab 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=1,4,8,16 PUMIUMTALLY_AB_TRIALS=3 python tools/exp_fusion_ab.py
+# The round-11 serving-tax re-measure (the number fusion shrinks),
+# full shape, unchanged so rounds compare like-for-like.
+run service_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_service_ab.py
+# Inherited subsystem A/Bs (r7-r10 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run scoring_ab  1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=6 python tools/exp_scoring_ab.py
+run sentinel_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_sentinel_ab.py
+run resilience_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_resilience_ab.py
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects).
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
