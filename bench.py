@@ -569,6 +569,32 @@ def run_service_fusion_ab() -> dict | None:
     )
 
 
+def run_distributed_ab() -> dict | None:
+    """Component row: pod-scale distributed campaigns (r13,
+    tools/exp_distributed_ab.py run_ab) — the collective particle
+    migration (all_gather'd counting-rank keys + ppermute ring) vs the
+    global-scatter migrate on the identical partitioned workload, with
+    the BITWISE flux-parity gate enforced inside the tool (the
+    determinism contract pod campaigns rest on), fenced per-move ms
+    for both arms, the modeled per-round migration-collective bytes
+    from the engine's actual packed-state layout, and the
+    compiles-healthy contract — ``compiles.timed == 0``: the
+    collective path is one phase-program variant, compiled in warmup.
+    The cross-process subarm (1-proc-x-8 vs 2-proc-x-4 CPU
+    subprocesses, global results bitwise) reports
+    ``available: false`` honestly on jaxlib builds without
+    cross-process CPU collectives. Reduced shape like the other
+    component rows; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_distributed_ab
+
+    return exp_distributed_ab.run_ab(
+        n=min(N, 50_000), div=MESH_DIV, moves=2, batches=6
+    )
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -1004,6 +1030,12 @@ def _measure_and_report() -> None:
             service_fusion = run_service_fusion_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# service fusion A/B failed: {e}", file=sys.stderr)
+    distributed = None
+    if os.environ.get("PUMIUMTALLY_BENCH_DISTRIBUTED", "1") != "0":
+        try:
+            distributed = run_distributed_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# distributed A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -1165,6 +1197,13 @@ def _measure_and_report() -> None:
         # (compiles.timed == 0: walk_fused compiles once per group
         # composition, in warmup only).
         "service_fusion": service_fusion,
+        # Pod-scale distributed campaigns (r13): collective vs
+        # global-scatter migration (flux parity bitwise inside the
+        # tool), fenced per-move ms, modeled migration-collective
+        # bytes, the 2-process cross-host parity subarm (honest
+        # "available": false without gloo), and the compiles-healthy
+        # contract (compiles.timed == 0).
+        "distributed": distributed,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

@@ -92,6 +92,7 @@ class PartitionedPumiTally(PumiTally):
             table_dtype=self._table_dtype,
             cap_frontier=self.config.cap_frontier,
             scoring=self.config.scoring,
+            migrate_collective=self.config.migrate_collective,
         )
         self._wire_engine_hooks(self.engine)
         # Scoring runtime AFTER the engine: the DROP sentinel needs the

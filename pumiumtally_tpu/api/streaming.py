@@ -760,6 +760,7 @@ class StreamingPartitionedTally(StreamingTally):
                 partition_method=self.config.resolved_partition_method(),
                 cap_frontier=self.config.cap_frontier,
                 scoring=self.config.scoring,
+                migrate_collective=self.config.migrate_collective,
             ))
         # Scoring runtime AFTER the engines: the DROP sentinel needs
         # the shared partition's PADDED lane-bank size (every chunk

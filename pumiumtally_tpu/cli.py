@@ -214,6 +214,53 @@ def cmd_serve(args) -> None:
     raise SystemExit(0)
 
 
+def cmd_route(args) -> None:
+    """Run the session router over per-host service workers
+    (service/server.py SessionRouter, round 13): each worker is a
+    ``pumiumtally serve`` process on its own host/devices; the router
+    pins every session to a home worker at open (least-loaded, or the
+    request's "home" hint) and forwards its NDJSON ops there — the
+    horizontal scaling front of the multi-session service. SIGTERM
+    (or SIGINT) stops intake, closes the worker links, and exits 0 —
+    same preemption-safe contract as ``serve``; the workers' own
+    vanished-client handling drain-closes any sessions the router
+    still had open."""
+    import json as _json
+    import signal as _signal
+    import time as _time
+
+    from pumiumtally_tpu.service import SessionRouter
+
+    backends = []
+    for spec in args.backend:
+        host, sep, port = spec.rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(
+                f"--backend {spec!r} is not host:port"
+            )
+        backends.append((host, int(port)))
+    router = SessionRouter(backends, host=args.host, port=args.port)
+    router.start()
+    # Same one-parseable-line discovery contract as `serve`.
+    print(_json.dumps({"routing": {"host": router.host,
+                                   "port": router.port,
+                                   "backends": len(backends)}}),
+          flush=True)
+    stop = {"requested": False}
+    prev = _signal.signal(_signal.SIGTERM,
+                          lambda _sig, _frm: stop.update(requested=True))
+    try:
+        while not stop["requested"]:
+            _time.sleep(0.1)
+        print("route: drain requested; closing worker links", flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)
+        router.stop()
+    raise SystemExit(0)
+
+
 def _subproc_timeout() -> float:
     """Helper-subprocess timeout in seconds (default 1800). Deployments
     with slow toolchains raise it via PUMIUMTALLY_SUBPROC_TIMEOUT; the
@@ -386,6 +433,19 @@ def main(argv=None) -> None:
                         "every session's ops one launch at a time — "
                         "the pre-round-12 dispatch path)")
     c.set_defaults(fn=cmd_serve)
+
+    c = sub.add_parser(
+        "route",
+        help="route NDJSON sessions over per-host service workers",
+    )
+    c.add_argument("--backend", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="a worker's serve address (repeat per host)")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (the bound port is printed as "
+                        "one JSON line)")
+    c.set_defaults(fn=cmd_route)
 
     c = sub.add_parser(
         "aot-check",

@@ -69,6 +69,10 @@ RETRACE_BUDGETS: dict = {
     # phase cache key), and the recovery tests drive reference +
     # sentinel engines back to back — measured max 11 + 1 headroom
     # (PUMIUMTALLY_RETRACE_RECORD over the full r9 tier-1).
+    # Re-measured over the full r13 tier-1 after migrate_collective
+    # joined the phase cache key: still max 11 (the collective-vs-
+    # scatter parity tests peak at 6 — one extra phase variant per
+    # engine pair, compiled once), so the budget holds unchanged.
     "cascade_phase": 12,
     # Profiled-phase programs (parallel/partition.py component-budget
     # instrumentation): one jitted single-round program per
@@ -284,6 +288,23 @@ class TallyConfig:
     # hook). Size it from PartitionedEngine.last_frontier_max — a slab
     # at or above the workload's largest front never falls back.
     cap_frontier: Optional[int] = None
+    # Partitioned engines only (round 13): lower in-loop particle
+    # migration to explicit named collectives — an all_gather of the
+    # counting-rank keys plus a ppermute ring of the packed state
+    # slabs inside a shard_map over the engine mesh
+    # (parallel/distributed.py make_collective_migrate) — instead of
+    # the GSPMD-partitioned full-capacity global scatter. Same
+    # redistribution, BITWISE-equal result (destinations are globally
+    # unique stable ranks, so arrival order cannot matter; pinned by
+    # tests/test_distributed.py): on a multi-process global mesh a
+    # particle leaving a host-owned block lands on the owning host in
+    # one launch with the traffic explicit per hop, where the GSPMD
+    # scatter lowering is whatever this jaxlib chose. Only the
+    # full-capacity migrate exists collectively, so combining with
+    # cap_frontier refuses at construction. False (default) keeps the
+    # historical scatter — bitwise and allocation-identical to
+    # pre-round-13 builds.
+    migrate_collective: bool = False
     # Walk-kernel tuning knobs (ops/walk.py) — exposed so a deployment
     # can adopt the best measured configuration for its chip without
     # code changes. Defaults = the kernel's own defaults (None = leave
@@ -571,6 +592,12 @@ class TallyConfig:
             raise ValueError(
                 f"cap_frontier must be >= 0 (0 = forced full-capacity "
                 f"fallback) or None, got {self.cap_frontier!r}"
+            )
+        if self.migrate_collective and self.cap_frontier is not None:
+            raise ValueError(
+                "migrate_collective=True lowers only the full-capacity "
+                "migrate to collectives; it cannot combine with the "
+                "cap_frontier slab — unset one of them"
             )
 
     def resolved_min_window(self) -> int:

@@ -25,6 +25,14 @@ from pumiumtally_tpu.parallel.partition import (
     build_partition,
     rcb_partition,
 )
+from pumiumtally_tpu.parallel.distributed import (
+    DistributedUnavailableError,
+    assert_collectives_available,
+    fetch_global,
+    global_device_mesh,
+    init_distributed,
+    make_collective_migrate,
+)
 
 __all__ = [
     "initialize_distributed",
@@ -36,4 +44,10 @@ __all__ = [
     "PartitionedEngine",
     "build_partition",
     "rcb_partition",
+    "DistributedUnavailableError",
+    "assert_collectives_available",
+    "fetch_global",
+    "global_device_mesh",
+    "init_distributed",
+    "make_collective_migrate",
 ]

@@ -908,7 +908,7 @@ def _frontier_migrate_impl(part_L: int, nparts: int, cap_per_chip: int,
 
 def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
                    cap_frontier, pmethod: str, state: dict,
-                   n_pending: jnp.ndarray):
+                   n_pending: jnp.ndarray, collective_fn=None):
     """One in-loop migration round: the frontier slab when the crossing
     front fits ``cap_frontier``, else the full-capacity
     ``_migrate_impl`` (today's semantics, bitwise — it also re-compacts
@@ -919,11 +919,23 @@ def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
     fallback every round (the parity-testing hook). Returns
     ``(state, overflow, departures, arrivals, fellback)`` with zero
     counts on fallback rounds (occupancy recomputes from scratch then —
-    ``_update_occupancy``)."""
+    ``_update_occupancy``).
+
+    ``collective_fn`` (round 13, ``migrate_collective``): a
+    ``distributed.make_collective_migrate`` closure replacing the
+    full-capacity global scatter with the explicit
+    all_gather + ppermute-ring collective — same
+    ``(state) -> (state, overflow)`` contract, bitwise-equal result.
+    Only the full-capacity form exists collectively (config forbids
+    combining the knob with the frontier slab), so the default-``None``
+    trace is byte-identical to pre-round-13 builds."""
     z = jnp.zeros((nparts,), jnp.int32)
     if cap_frontier is None or cap_frontier == 0:
-        st, ovf = _migrate_impl(part_L, nparts, cap_per_chip, state,
-                                pmethod)
+        if collective_fn is not None:
+            st, ovf = collective_fn(state)
+        else:
+            st, ovf = _migrate_impl(part_L, nparts, cap_per_chip, state,
+                                    pmethod)
         return st, ovf, z, z, jnp.asarray(True)
 
     def full(st):
@@ -959,14 +971,15 @@ def _update_occupancy(nparts: int, cap_frontier, state: dict,
 
 def _inloop_migrate_step(part_L: int, nparts: int, cap_per_chip: int,
                          cap_frontier, pmethod: str, state: dict,
-                         n_act: jnp.ndarray, n_pending: jnp.ndarray):
+                         n_act: jnp.ndarray, n_pending: jnp.ndarray,
+                         collective_fn=None):
     """Migration + occupancy bookkeeping for one phase-loop round —
     the composition the fused phase program inlines; the profiled
     driver dispatches the same two pieces separately so each section
     can be fenced and timed."""
     st, ovf, dep, arr, fellback = _migrate_round(
         part_L, nparts, cap_per_chip, cap_frontier, pmethod, state,
-        n_pending,
+        n_pending, collective_fn,
     )
     n_act2 = _update_occupancy(nparts, cap_frontier, st, n_act, dep,
                                arr, fellback)
@@ -1135,6 +1148,7 @@ class PartitionedEngine:
         table_dtype: str = "float32",
         cap_frontier: Optional[int] = None,
         scoring=None,
+        migrate_collective: bool = False,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -1264,6 +1278,35 @@ class PartitionedEngine:
             None if cap_frontier is None
             else max(0, min(int(cap_frontier), self.cap))
         )
+        # Round 13: lower in-loop migration to explicit named
+        # collectives (all_gather + ppermute ring inside a shard_map
+        # over the engine mesh) instead of the GSPMD-partitioned global
+        # scatter — bitwise-equal by construction (unique stable
+        # destination ranks), built once here so every phase-family
+        # program shares one closure. Only the full-capacity migrate
+        # exists collectively, so the frontier slab is incompatible
+        # (TallyConfig validates the same pair earlier with the
+        # config-level message).
+        self.migrate_collective = bool(migrate_collective)
+        if self.migrate_collective and self.cap_frontier is not None:
+            raise ValueError(
+                "migrate_collective=True replaces the full-capacity "
+                "migrate only; it cannot combine with cap_frontier"
+            )
+        if self.migrate_collective:
+            from pumiumtally_tpu.parallel.distributed import (
+                make_collective_migrate,
+            )
+
+            self._collective_migrate = make_collective_migrate(
+                device_mesh,
+                part_L=self.part.L,
+                nparts=nparts,
+                cap_per_block=cap_b,
+                partition_method=partition_method,
+            )
+        else:
+            self._collective_migrate = None
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
@@ -1891,7 +1934,8 @@ class PartitionedEngine:
         return (kind, tally, self.cap_per_chip, self.max_rounds,
                 self.max_iters, self.tol, self.cond_every,
                 self.min_window, self.use_vmem_walk, self.blocks_per_chip,
-                self.partition_method, self.cap_frontier, id(self.part),
+                self.partition_method, self.cap_frontier,
+                self.migrate_collective, id(self.part),
                 None if self.scoring is None else self.scoring.static_key(),
                 variant)
 
@@ -1932,6 +1976,7 @@ class PartitionedEngine:
         cap_frontier = (
             None if force_full_migrate else self.cap_frontier
         )
+        collective_fn = self._collective_migrate
         round_sm = self._make_round_sm(
             tally, max_iters=self.max_iters * int(iters_mult)
         )
@@ -1994,7 +2039,7 @@ class PartitionedEngine:
                  nfb) = c
                 st2, ovf2, n_act2, fellback = _inloop_migrate_step(
                     part_L, nparts, cap_b, cap_frontier, pmethod, st,
-                    n_act, n_p,
+                    n_act, n_p, collective_fn,
                 )
                 # An overflowing migrate scatters colliding slots: do
                 # NOT walk (and tally) from that corrupted state — the
@@ -2101,11 +2146,13 @@ class PartitionedEngine:
         nparts, cap_b = self.nparts, self.cap_per_block
         pmethod = self.partition_method
         cap_frontier = self.cap_frontier
+        collective_fn = self._collective_migrate
 
         @jax.jit
         def mig(state, n_pending):
             return _migrate_round(part_L, nparts, cap_b, cap_frontier,
-                                  pmethod, state, n_pending)
+                                  pmethod, state, n_pending,
+                                  collective_fn)
 
         mig = register_entry_point("partition_migrate", mig)
         self._jit_cache[key] = mig

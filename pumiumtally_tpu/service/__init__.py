@@ -27,6 +27,12 @@ many concurrent client sessions:
   per-session flux/score-bank results back bitwise-equal to solo
   runs; ``TallyService(fuse_sessions=False)`` reproduces the
   one-op-at-a-time round-11 path bit for bit.
+- ``SessionRouter`` (server.py, round 13) — pod-scale serving: each
+  host runs its own service + ``SocketFrontend`` worker
+  (``pumiumtally serve``) against its local devices; the router
+  (``pumiumtally route``) pins every session to a home worker at open
+  and forwards its NDJSON ops there, so the multi-session machinery
+  scales horizontally with the same per-session bitwise contract.
 
 Core contract — determinism under concurrency: each session's output
 is BITWISE the solo run of the same campaign, regardless of how the
@@ -48,6 +54,7 @@ from pumiumtally_tpu.service.session import (
 from pumiumtally_tpu.service.server import (
     ServiceDrainingError,
     SessionHandle,
+    SessionRouter,
     SocketFrontend,
     TallyService,
 )
@@ -59,6 +66,7 @@ __all__ = [
     "ServiceDrainingError",
     "SessionClosedError",
     "SessionHandle",
+    "SessionRouter",
     "SessionState",
     "SocketFrontend",
     "TallyService",

@@ -956,3 +956,212 @@ class SocketFrontend:
                 )
             return str(spec["path"])  # facades load .msh/.osh paths
         raise ValueError(f"unknown mesh spec {spec!r} (box/path)")
+
+
+# ---------------------------------------------------------------------------
+# Per-host service workers: the session router (round 13)
+# ---------------------------------------------------------------------------
+
+class SessionRouter:
+    """Thin NDJSON routing front end over several per-host service
+    workers — the horizontal form of the PR 10 service: each host (or
+    process) runs its own ``TallyService`` + ``SocketFrontend`` against
+    its local devices, and clients talk to ONE router address.
+
+    Session-homing rule: a session's facade arrays live on the chips of
+    exactly one worker, so every op for a session must land on the
+    worker that opened it. The router pins each session to a home
+    worker at ``open`` — the least-open-sessions worker, or the
+    request's ``"home": <index>`` hint — and forwards every subsequent
+    op for that id there verbatim. Router session ids are
+    ``"<home>:<worker-sid>"`` (rewritten in both directions), so a
+    client can read its session's home from the id and the reply's
+    ``"home"`` field.
+
+    The protocol is byte-identical to ``SocketFrontend``'s per line —
+    the router adds no ops and removes none; ``ping`` is answered with
+    the aggregate (``draining`` true when ANY worker drains, plus the
+    worker count). One worker connection per client connection, opened
+    lazily: the workers' per-connection session cleanup then makes a
+    vanished client drop its sessions on every worker it touched, with
+    no router-side bookkeeping.
+
+    Trust model: same as ``SocketFrontend`` — no authentication, deploy
+    inside the perimeter. Workers are typically ``pumiumtally serve``
+    processes launched one per host by the job scheduler; the router is
+    ``pumiumtally route --backend host:port ...``.
+    """
+
+    def __init__(self, backends, host: str = "127.0.0.1", port: int = 0,
+                 *, connect_timeout: float = 10.0):
+        if not backends:
+            raise ValueError("SessionRouter needs at least one backend")
+        self.backends = [(str(h), int(p)) for h, p in backends]
+        self.connect_timeout = float(connect_timeout)
+        self._srv = socket.create_server((host, int(port)))
+        self._srv.settimeout(0.25)  # periodic _closing check (see
+        # SocketFrontend.__init__ — same accept-loop liveness reasoning)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closing = False
+        self._count_lock = threading.Lock()
+        self._open_sessions = [0] * len(self.backends)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="pumiumtally-route-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._srv.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+            )
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    # -- per-connection forwarding ---------------------------------------
+    def _serve_conn(self, conn: socket.socket) -> None:
+        files: Dict[int, Any] = {}  # backend idx -> rwb file
+        socks: Dict[int, socket.socket] = {}
+        owned: Dict[str, int] = {}  # router sid -> home backend idx
+        try:
+            with conn, conn.makefile("rwb") as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        reply = self._route(
+                            json.loads(line.decode("utf-8")), files,
+                            socks, owned,
+                        )
+                    except Exception as e:  # noqa: BLE001 — protocol
+                        # boundary, like SocketFrontend._serve_conn:
+                        # every failure (bad session ids, dead workers,
+                        # forwarded errors re-raised) answers
+                        # structured; only a dead CLIENT drops the
+                        # connection.
+                        reply = {
+                            "ok": False,
+                            "error": type(e).__name__,
+                            "message": str(e),
+                            "busy": isinstance(e, ServiceBusyError),
+                        }
+                    f.write(json.dumps(reply, default=float)
+                            .encode("utf-8") + b"\n")
+                    f.flush()
+        except (OSError, json.JSONDecodeError):
+            pass  # peer went away / sent garbage
+        finally:
+            # Closing the worker connections is the whole cleanup: each
+            # worker's own per-connection finally drain-closes the
+            # sessions this client opened through it.
+            with self._count_lock:
+                for sid, b in owned.items():
+                    self._open_sessions[b] -= 1
+            for s in socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _backend_file(self, idx: int, files: Dict[int, Any],
+                      socks: Dict[int, socket.socket]):
+        if idx not in files:
+            s = socket.create_connection(
+                self.backends[idx], timeout=self.connect_timeout
+            )
+            s.settimeout(None)  # ops block until the worker replies
+            socks[idx] = s
+            files[idx] = s.makefile("rwb")
+        return files[idx]
+
+    def _forward(self, idx: int, req: dict, files, socks) -> dict:
+        f = self._backend_file(idx, files, socks)
+        f.write(json.dumps(req, default=float).encode("utf-8") + b"\n")
+        f.flush()
+        line = f.readline()
+        if not line:
+            raise RuntimeError(
+                f"worker {idx} ({self.backends[idx][0]}:"
+                f"{self.backends[idx][1]}) closed the connection"
+            )
+        return json.loads(line.decode("utf-8"))
+
+    def _home_of(self, sid: str) -> tuple:
+        b, sep, rest = str(sid).partition(":")
+        if not sep or not b.isdigit() or int(b) >= len(self.backends):
+            raise ValueError(
+                f"unknown session {sid!r} (router ids look like "
+                f"'<home>:<worker-sid>' with home < "
+                f"{len(self.backends)})"
+            )
+        return int(b), rest
+
+    def _route(self, req: dict, files, socks, owned: Dict[str, int]
+               ) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            # Aggregate health: draining when ANY worker drains (a
+            # drain anywhere means new opens may land on a draining
+            # host — clients should stop submitting).
+            draining = False
+            for i in range(len(self.backends)):
+                r = self._forward(i, {"op": "ping"}, files, socks)
+                draining = draining or bool(r.get("draining"))
+            return {"ok": True, "draining": draining,
+                    "backends": len(self.backends)}
+        if op == "open":
+            home = req.pop("home", None)
+            if home is None:
+                with self._count_lock:
+                    home = self._open_sessions.index(
+                        min(self._open_sessions)
+                    )
+            home = int(home)
+            if not 0 <= home < len(self.backends):
+                raise ValueError(
+                    f"home {home} out of range (have "
+                    f"{len(self.backends)} workers)"
+                )
+            reply = self._forward(home, req, files, socks)
+            if reply.get("ok") and "session" in reply:
+                sid = f"{home}:{reply['session']}"
+                owned[sid] = home
+                with self._count_lock:
+                    self._open_sessions[home] += 1
+                reply = dict(reply, session=sid, home=home)
+            return reply
+        # Every other op carries a session id: forward to its home.
+        home, worker_sid = self._home_of(req.get("session"))
+        reply = self._forward(
+            home, dict(req, session=worker_sid), files, socks,
+        )
+        if op == "close" and reply.get("ok"):
+            sid = f"{home}:{worker_sid}"
+            if owned.pop(sid, None) is not None:
+                with self._count_lock:
+                    self._open_sessions[home] -= 1
+        return reply

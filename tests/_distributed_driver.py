@@ -1,0 +1,363 @@
+"""Subprocess driver for the multi-process distributed tests
+(tests/test_distributed.py, tests/test_multiprocess.py — the pattern
+of tests/_service_driver.py).
+
+Two halves:
+
+- ``main()`` — the WORKER: one ``jax.distributed`` process with its
+  own virtual CPU devices, joining the localhost coordinator, probing
+  that this jaxlib can actually execute cross-process collectives
+  (``assert_collectives_available``), running one seeded campaign arm
+  against the global mesh, and (process 0) saving the fetched global
+  results for the parent to compare bitwise against a single-process
+  reference. A backend that cannot run cross-process collectives (CPU
+  jaxlib without gloo) exits ``UNAVAILABLE_EXIT_CODE`` (77) with the
+  ``DISTRIBUTED-UNAVAILABLE`` marker — the launcher converts that to a
+  pytest SKIP, never a failure.
+
+- ``launch_distributed`` / ``launch_or_skip`` — the LAUNCHER: spawns
+  the worker pair with a free-port RETRY loop (the coordinator port is
+  probed then bound by a different process — a lost race answers
+  "address in use" and simply retries on a fresh port), bounds the
+  wait with ``PUMIUMTALLY_SUBPROC_TIMEOUT`` (default 280 s; the expiry
+  message names the env var), and kills the peer the moment one worker
+  reports unavailable so the skip is prompt instead of waiting out the
+  peer's collective timeout. These two mechanisms + the clear skip are
+  the fix for the pre-existing two-process slow-test flakiness (noted
+  environmental since PR 2).
+
+``build_tally``/``run_campaign``/``collect`` are imported by the
+parity tests to run the IDENTICAL campaign single-process at the same
+global shapes — one code path for both sides of the bitwise contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N = 256
+MESH_ARGS = (1, 1, 1, 3, 3, 3)
+ARMS = ("sharded", "partitioned", "partitioned_scoring")
+_INIT_FAILED_MARKER = "DISTRIBUTED-INIT-FAILED"
+_PORT_RETRY_PATTERNS = ("address already in use", "failed to bind",
+                        "address in use", "errno 98")
+
+
+def _scoring_spec():
+    from pumiumtally_tpu import EnergyFilter, ScoringSpec
+
+    return ScoringSpec(filters=[EnergyFilter([0.0, 1.0, 2.0])],
+                       scores=["flux", "events"])
+
+
+def build_tally(arm: str, mesh_dev):
+    """The campaign facade for one parity arm — called with the global
+    2-process mesh by the worker and the 8-virtual-device
+    single-process mesh by the reference side (same global shapes)."""
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        PumiTally,
+        TallyConfig,
+        build_box,
+    )
+
+    mesh = build_box(*MESH_ARGS)
+    if arm == "sharded":
+        return PumiTally(
+            mesh, N,
+            TallyConfig(device_mesh=mesh_dev, check_found_all=False),
+        )
+    kw = dict(device_mesh=mesh_dev, check_found_all=False,
+              capacity_factor=8.0, migrate_collective=True)
+    if arm == "partitioned_scoring":
+        kw["scoring"] = _scoring_spec()
+    elif arm != "partitioned":
+        raise ValueError(f"unknown arm {arm!r} (one of {ARMS})")
+    return PartitionedPumiTally(mesh, N, TallyConfig(**kw))
+
+
+def run_campaign(t, arm: str) -> None:
+    """Two seeded long-step moves (many partition crossings, hence
+    cross-process migrations in the partitioned arms)."""
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    src = rng.uniform(0.1, 0.9, (N, 3))
+    d1 = rng.uniform(0.1, 0.9, (N, 3))
+    d2 = rng.uniform(0.1, 0.9, (N, 3))
+    w = rng.uniform(0.5, 2.0, N)
+    kw = {}
+    if arm == "partitioned_scoring":
+        kw["energy"] = np.where(np.arange(N) % 2 == 0, 0.5, 1.5)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, d1.reshape(-1).copy(),
+                         np.ones(N, np.int8), w, **kw)
+    t.MoveToNextLocation(None, d2.reshape(-1).copy(),
+                         np.ones(N, np.int8), w, **kw)
+
+
+def collect(t, arm: str) -> dict:
+    """Global results as host numpy — every array the bitwise parity
+    contract covers (flux, positions, element ids, score bank)."""
+    import numpy as np
+
+    from pumiumtally_tpu.parallel.distributed import fetch_global
+
+    out = {
+        "flux": fetch_global(t.flux),
+        "positions": np.asarray(t.positions),
+        "elem_ids": np.asarray(t.elem_ids),
+    }
+    if arm == "partitioned_scoring":
+        out["score_bank"] = fetch_global(t.score_bank)
+    return out
+
+
+# -- worker -----------------------------------------------------------------
+
+def _looks_unavailable(exc: BaseException) -> bool:
+    msg = str(exc)
+    return ("Multiprocess computations aren't implemented" in msg
+            or "gloo" in msg.lower())
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arm", choices=ARMS, required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--coord-port", type=int, required=True)
+    ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--out", default=None,
+                    help="process 0: save the collected global "
+                         "results (.npz) here")
+    args = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags
+        + f" --xla_force_host_platform_device_count="
+          f"{args.devices_per_proc}"
+    ).strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+    import numpy as np
+
+    from pumiumtally_tpu.parallel.distributed import (
+        DistributedUnavailableError,
+        UNAVAILABLE_EXIT_CODE,
+        UNAVAILABLE_MARKER,
+        assert_collectives_available,
+        init_distributed,
+    )
+
+    try:
+        mesh_dev = init_distributed(
+            coordinator_address=f"127.0.0.1:{args.coord_port}",
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    except Exception as e:  # noqa: BLE001 — classified for the launcher
+        # Startup failures (port race, peer never came up) get their
+        # own marker + code so the launcher can retry the port instead
+        # of mis-reading them as collective unavailability.
+        print(f"{_INIT_FAILED_MARKER}: {type(e).__name__}: {e}",
+              flush=True)
+        raise SystemExit(3) from e
+    nglobal = args.num_processes * args.devices_per_proc
+    assert mesh_dev.devices.size == nglobal, mesh_dev
+    print(f"proc {args.process_id}: devices={nglobal}", flush=True)
+
+    try:
+        assert_collectives_available(mesh_dev)
+        t = build_tally(args.arm, mesh_dev)
+        t0 = time.perf_counter()
+        run_campaign(t, args.arm)
+        payload = collect(t, args.arm)  # the fetch fences the device
+        dt = time.perf_counter() - t0
+    except DistributedUnavailableError as e:
+        print(str(e), flush=True)  # carries UNAVAILABLE_MARKER
+        # NO jax.distributed.shutdown() here: the shutdown barrier
+        # would wait on a peer already dead of the same error.
+        raise SystemExit(UNAVAILABLE_EXIT_CODE) from e
+    except Exception as e:  # noqa: BLE001 — backend classification
+        if _looks_unavailable(e):
+            print(f"{UNAVAILABLE_MARKER}: {e}", flush=True)
+            raise SystemExit(UNAVAILABLE_EXIT_CODE) from e
+        raise
+    if args.process_id == 0 and args.out:
+        np.savez(args.out, **payload)
+    # Wall seconds over the fenced campaign (compiles included — the
+    # worker runs cold), parsed by tools/exp_distributed_ab.py.
+    print(f"proc {args.process_id}: campaign-seconds={dt:.6f}",
+          flush=True)
+    print(f"proc {args.process_id}: ARM-OK {args.arm}", flush=True)
+    import jax
+
+    jax.distributed.shutdown()
+    raise SystemExit(0)
+
+
+# -- launcher ---------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_timeout() -> float:
+    """Worker-pair wait bound in seconds (default 280, under the slow
+    tier's per-test budget). PUMIUMTALLY_SUBPROC_TIMEOUT overrides —
+    the expiry message names it so the fix is discoverable."""
+    raw = os.environ.get("PUMIUMTALLY_SUBPROC_TIMEOUT")
+    if raw is None:
+        return 280.0
+    try:
+        t = float(raw)
+        if t <= 0:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"PUMIUMTALLY_SUBPROC_TIMEOUT={raw!r} is not a positive "
+            "number of seconds"
+        ) from None
+    return t
+
+
+class LaunchResult:
+    def __init__(self, skipped: bool, reason: str, returncodes, outputs):
+        self.skipped = skipped
+        self.reason = reason
+        self.returncodes = returncodes
+        self.outputs = outputs
+
+
+def _spawn(script_args, num_processes: int, port: int, timeout: float):
+    """One worker set on one coordinator port. Returns (rcs, outs,
+    timed_out_pids)."""
+    procs, logs = [], []
+    # The coordinator handshake gets its own bound well under the
+    # subprocess wait, so a peer that never starts fails FAST with the
+    # init marker instead of eating the whole budget.
+    coord_timeout = max(15, int(timeout / 4))
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel claims
+        env.pop("RUN_BOTH", None)
+        env.setdefault("PUMIUMTALLY_COORD_TIMEOUT", str(coord_timeout))
+        # Log files, not pipes: a worker blocked on a full pipe would
+        # stall the collective and deadlock the pair.
+        log = tempfile.TemporaryFile(mode="w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--num-processes", str(num_processes),
+             "--process-id", str(pid),
+             "--coord-port", str(port)] + script_args,
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs):
+                break
+            # A worker that already reported unavailable (or a startup
+            # failure) decides the outcome: kill the peer now rather
+            # than waiting out its collective/heartbeat timeout.
+            if any(p.poll() is not None and p.returncode != 0
+                   for p in procs):
+                time.sleep(2.0)  # grace: let the peer exit on its own
+                break
+            time.sleep(0.2)
+    finally:
+        timed_out = [i for i, p in enumerate(procs) if p.poll() is None]
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    outs = []
+    for log in logs:
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    return [p.returncode for p in procs], outs, timed_out
+
+
+def launch_distributed(arm: str, out_path=None, *, num_processes: int = 2,
+                       devices_per_proc: int = 4, attempts: int = 3,
+                       ) -> LaunchResult:
+    from pumiumtally_tpu.parallel.distributed import (
+        UNAVAILABLE_EXIT_CODE,
+        UNAVAILABLE_MARKER,
+    )
+
+    timeout = _wait_timeout()
+    script_args = ["--arm", arm,
+                   "--devices-per-proc", str(devices_per_proc)]
+    if out_path:
+        script_args += ["--out", str(out_path)]
+    for attempt in range(attempts):
+        port = _free_port()
+        rcs, outs, timed_out = _spawn(
+            script_args, num_processes, port, timeout,
+        )
+        blob = "\n".join(outs)
+        if (UNAVAILABLE_MARKER in blob
+                or UNAVAILABLE_EXIT_CODE in rcs):
+            reason = next(
+                (ln for ln in blob.splitlines()
+                 if UNAVAILABLE_MARKER in ln),
+                f"{UNAVAILABLE_MARKER}: worker exited "
+                f"{UNAVAILABLE_EXIT_CODE}",
+            )
+            return LaunchResult(True, reason, rcs, outs)
+        init_failed = _INIT_FAILED_MARKER in blob
+        port_race = any(pat in blob.lower()
+                        for pat in _PORT_RETRY_PATTERNS)
+        if init_failed and port_race and attempt + 1 < attempts:
+            continue  # free-port retry: rebind on a fresh port
+        if timed_out:
+            raise AssertionError(
+                f"distributed workers {timed_out} still running after "
+                f"{timeout:g}s (PUMIUMTALLY_SUBPROC_TIMEOUT extends "
+                f"the bound); outputs:\n{blob[-3000:]}"
+            )
+        return LaunchResult(False, "", rcs, outs)
+    raise AssertionError(
+        f"coordinator failed to bind in {attempts} port attempts; "
+        f"last outputs:\n{blob[-3000:]}"
+    )
+
+
+def launch_or_skip(arm: str, out_path=None, **kw) -> LaunchResult:
+    """Launch the worker set; SKIP the calling test when the backend
+    cannot run cross-process collectives, assert success otherwise."""
+    import pytest
+
+    res = launch_distributed(arm, out_path, **kw)
+    if res.skipped:
+        pytest.skip(res.reason)
+    for pid, (rc, out) in enumerate(zip(res.returncodes, res.outputs)):
+        assert rc == 0, f"proc {pid} rc={rc}:\n{out[-2000:]}"
+    return res
+
+
+if __name__ == "__main__":
+    main()

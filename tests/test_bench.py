@@ -337,6 +337,41 @@ def test_service_fusion_row(bench):
     assert "walk_fused" in res["compiles"]
 
 
+def test_distributed_row(bench):
+    """The pod-scale distributed component row (r13): schema keys
+    present, the BITWISE collective-vs-scatter flux-parity gate
+    asserted (the tool raises otherwise), positive rates and fenced
+    per-move costs in both arms, a migration byte model consistent
+    with the engine's packed layout, and the compiles-healthy
+    contract — ``compiles.timed == 0``: the collective path is one
+    phase-program variant, compiled in warmup. The cross-process
+    subarm either proves 2-process bitwise parity or reports
+    ``available: false`` with the backend's reason (jaxlib without
+    cross-process CPU collectives) — never a failure."""
+    res = bench.run_distributed_ab()
+    for key in ("scatter_moves_per_sec", "collective_moves_per_sec",
+                "collective_overhead_pct", "fenced_scatter_ms_per_move",
+                "fenced_collective_ms_per_move", "flux_parity_bitwise",
+                "migration", "two_process", "compiles", "workload"):
+        assert key in res, key
+    assert res["flux_parity_bitwise"] is True
+    assert res["scatter_moves_per_sec"] > 0
+    assert res["collective_moves_per_sec"] > 0
+    assert res["fenced_scatter_ms_per_move"] > 0
+    assert res["fenced_collective_ms_per_move"] > 0
+    mig = res["migration"]
+    assert mig["modeled_collective_bytes_per_round"] > 0
+    assert mig["float_cols"] >= 7 and mig["int_cols"] >= 8
+    assert mig["capacity"] % mig["devices"] == 0
+    two = res["two_process"]
+    if two["available"]:
+        assert two["parity_bitwise"] is True
+        assert two["processes"] == 2 and two["global_devices"] == 8
+    else:
+        assert two["reason"]
+    assert res["compiles"]["timed"] == 0
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

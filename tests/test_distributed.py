@@ -1,0 +1,369 @@
+"""Pod-scale distributed campaigns (round 13, docs/DESIGN.md
+"Pod-scale campaigns").
+
+Tier-1 pins the whole distributed machinery on ONE process with 8
+virtual CPU devices — the collective programs are identical under
+multi-process partitioning, only device placement changes:
+
+- the collective migration (``make_collective_migrate``: all_gather'd
+  counting-rank keys + ppermute ring) is BITWISE equal to the global
+  scatter ``partition._migrate_impl`` for both partition methods,
+  overflow and non-overflow arms;
+- the partitioned engine with ``migrate_collective=True`` lands flux,
+  positions, element ids, and score banks bitwise equal to the
+  default global-scatter engine (the determinism contract that makes
+  pod campaigns trustworthy);
+- ``SessionRouter`` pins sessions to home workers and forwards NDJSON
+  ops with per-session results bitwise equal to a direct facade;
+- the ``init_distributed`` front door validates its arguments instead
+  of dying in the coordinator handshake.
+
+The slow tier then runs the REAL 2-process version through
+tests/_distributed_driver.py and compares process 0's fetched global
+results bitwise against the in-process single-process reference at the
+same global shapes. On jaxlib builds without cross-process CPU
+collectives (no gloo) the workers exit with the
+``DISTRIBUTED-UNAVAILABLE`` marker and the test SKIPS — never fails.
+"""
+
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from tests._distributed_driver import (
+    ARMS,
+    build_tally,
+    collect,
+    launch_or_skip,
+    run_campaign,
+)
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pumiumtally_tpu import (  # noqa: E402
+    EnergyFilter,
+    PartitionedPumiTally,
+    ScoringSpec,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh  # noqa: E402
+from pumiumtally_tpu.parallel.distributed import (  # noqa: E402
+    fetch_global,
+    global_device_mesh,
+    init_distributed,
+    make_collective_migrate,
+    modeled_migration_collective_bytes,
+    state_pack_columns,
+)
+from pumiumtally_tpu.parallel.partition import _migrate_impl  # noqa: E402
+
+
+# -- collective migration vs global scatter ---------------------------------
+
+def _mkstate(rng, cap, part_L, pending):
+    return {
+        "x": jnp.asarray(rng.standard_normal((cap, 3))),
+        "lelem": jnp.asarray(rng.integers(0, part_L, cap).astype(np.int32)),
+        "pending": jnp.asarray(pending.astype(np.int32)),
+        "pid": jnp.asarray(np.arange(cap, dtype=np.int32)),
+        "alive": jnp.asarray(rng.random(cap) < 0.3),
+        "done": jnp.asarray(rng.random(cap) < 0.5),
+        "exited": jnp.asarray(rng.random(cap) < 0.1),
+        "lost": jnp.asarray(np.zeros(cap, bool)),
+        "dest": jnp.asarray(rng.standard_normal((cap, 3))),
+        "fly": jnp.asarray(rng.integers(0, 2, cap).astype(np.int8)),
+        "w": jnp.asarray(rng.random(cap)),
+        "sbin": jnp.asarray(rng.integers(0, 4, cap).astype(np.int32)),
+        "sfac": jnp.asarray(rng.random((cap, 3))),
+    }
+
+
+@pytest.mark.parametrize("method", ["rank", "argsort"])
+def test_collective_migrate_bitwise_vs_global_scatter(method):
+    """all_gather + ppermute-ring migrate == full-capacity scatter,
+    bit for bit, in both the committing and the overflow-refusing arm."""
+    mesh = global_device_mesh()
+    ndev = int(mesh.devices.size)
+    bpc, cap_b, part_L = 2, 5, 7
+    nparts = ndev * bpc
+    cap = nparts * cap_b
+    rng = np.random.default_rng(0)
+    coll = make_collective_migrate(
+        mesh, part_L=part_L, nparts=nparts, cap_per_block=cap_b,
+        partition_method=method,
+    )
+    ref_fn = jax.jit(
+        lambda s: _migrate_impl(part_L, nparts, cap_b, s, method)
+    )
+
+    # Sparse pendings: the migrate commits (no overflow).
+    pend = np.full(cap, -1)
+    pend[rng.choice(cap, 8, replace=False)] = rng.integers(
+        0, nparts * part_L, 8
+    )
+    st = _mkstate(rng, cap, part_L, pend)
+    ref, ovf_ref = ref_fn(st)
+    got, ovf = jax.jit(coll)(st)
+    assert bool(ovf) == bool(ovf_ref) is False
+    for k in sorted(ref):
+        a, b = np.asarray(ref[k]), np.asarray(got[k])
+        assert a.dtype == b.dtype, (k, a.dtype, b.dtype)
+        np.testing.assert_array_equal(a, b, err_msg=k)
+
+    # Everyone pending to partition 0: overflow, pre-state survives.
+    st = _mkstate(rng, cap, part_L, np.zeros(cap))
+    ref, ovf_ref = ref_fn(st)
+    got, ovf = jax.jit(coll)(st)
+    assert bool(ovf_ref) and bool(ovf)
+    for k in sorted(ref):
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
+        )
+
+
+# -- engine-level on/off parity ---------------------------------------------
+
+def _campaign_arrays(N=3000, seed=3):
+    rng = np.random.default_rng(seed)
+    src = rng.uniform(0.05, 0.95, (N, 3))
+    dest1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)), 0.01, 0.99)
+    dest2 = np.clip(dest1 + rng.normal(scale=0.3, size=(N, 3)), 0.01, 0.99)
+    fly = (rng.uniform(size=N) > 0.1).astype(np.int8)
+    w = rng.uniform(0.5, 2.0, N)
+    return src, dest1, dest2, fly, w
+
+
+def test_partitioned_engine_collective_parity():
+    """migrate_collective=True is bitwise the global-scatter engine:
+    same flux, same positions, same element ids after crossing-heavy
+    moves on the 8-virtual-device mesh."""
+    N = 3000
+    mesh = build_box(1, 1, 1, 5, 5, 5)
+    dm = make_device_mesh(8)
+    src, dest1, dest2, fly, w = _campaign_arrays(N)
+    off = PartitionedPumiTally(mesh, N, TallyConfig(device_mesh=dm))
+    on = PartitionedPumiTally(
+        mesh, N, TallyConfig(device_mesh=dm, migrate_collective=True)
+    )
+    for t in (off, on):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, dest1.reshape(-1).copy(), fly.copy(), w)
+        t.MoveToNextLocation(None, dest2.reshape(-1).copy(),
+                             np.ones(N, np.int8), w)
+    np.testing.assert_array_equal(off.elem_ids, on.elem_ids)
+    assert (np.asarray(off.positions) == np.asarray(on.positions)).all()
+    assert (np.asarray(off.flux) == np.asarray(on.flux)).all()
+
+
+def test_partitioned_engine_collective_parity_scoring():
+    """The scoring-armed engine keeps the bitwise contract too — the
+    collective ships the scoring lanes (sbin / factors) in the same
+    packed slab, so score banks match bit for bit."""
+    N = 3000
+    mesh = build_box(1, 1, 1, 5, 5, 5)
+    dm = make_device_mesh(8)
+    src, dest1, _dest2, fly, w = _campaign_arrays(N)
+    spec = ScoringSpec(filters=[EnergyFilter([0.0, 1.0, 2.0])],
+                       scores=["flux", "events"])
+    en = np.where(np.arange(N) % 2 == 0, 0.5, 1.5)
+    off = PartitionedPumiTally(
+        mesh, N, TallyConfig(device_mesh=dm, scoring=spec)
+    )
+    on = PartitionedPumiTally(
+        mesh, N,
+        TallyConfig(device_mesh=dm, scoring=spec, migrate_collective=True),
+    )
+    for t in (off, on):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, dest1.reshape(-1).copy(), fly.copy(), w,
+                             energy=en)
+    assert (np.asarray(off.flux) == np.asarray(on.flux)).all()
+    assert (np.asarray(off.score_bank) == np.asarray(on.score_bank)).all()
+
+
+def test_migrate_collective_rejects_cap_frontier():
+    with pytest.raises(ValueError, match="cap_frontier"):
+        TallyConfig(migrate_collective=True, cap_frontier=64)
+
+
+# -- front-door helpers -----------------------------------------------------
+
+def test_init_distributed_validates_partial_identifiers():
+    with pytest.raises(ValueError, match="num_processes"):
+        init_distributed(coordinator_address="127.0.0.1:1234")
+    with pytest.raises(ValueError, match="coordinator_address"):
+        init_distributed(num_processes=2, process_id=0)
+    with pytest.raises(ValueError, match="process_id must be in"):
+        init_distributed("127.0.0.1:1234", 2, 2)
+    with pytest.raises(ValueError, match="num_processes must be"):
+        init_distributed("127.0.0.1:1234", 0, 0)
+
+
+def test_fetch_global_passthrough():
+    a = np.arange(6.0)
+    assert fetch_global(a) is a
+    j = jnp.arange(6.0)
+    np.testing.assert_array_equal(fetch_global(j), a)
+
+
+def test_modeled_migration_collective_bytes():
+    rng = np.random.default_rng(1)
+    st = _mkstate(rng, 80, 7, np.full(80, -1))
+    fcols, icols = state_pack_columns(st)
+    # x(3) + dest(3) + w(1) + sfac(3) floats; lelem/pending/pid/sbin
+    # int32 + alive/done/exited/lost bool + fly int8 — 9 int lanes.
+    assert (fcols, icols) == (10, 9)
+    got = modeled_migration_collective_bytes(80, 8, fcols, icols)
+    n_loc = 80 // 8
+    expect = 7 * n_loc * 4 + 7 * (n_loc * (10 * 8 + 9 * 4 + 4))
+    assert got == expect
+
+
+# -- per-host service workers: the router -----------------------------------
+
+def test_session_router_bitwise_and_homing():
+    """Two in-process workers behind a SessionRouter: sessions spread
+    least-loaded, honor explicit home hints, and every forwarded
+    campaign's flux is bitwise the direct facade."""
+    from pumiumtally_tpu import PumiTally, TallyService
+    from pumiumtally_tpu.service import SessionRouter, SocketFrontend
+    from pumiumtally_tpu.service.server import _decode_array, _encode_array
+
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    N = 500
+    svcs = [TallyService(), TallyService()]
+    fes = [SocketFrontend(s, default_mesh=mesh, default_particles=N)
+           for s in svcs]
+    for fe in fes:
+        fe.start()
+    router = SessionRouter([(fe.host, fe.port) for fe in fes])
+    router.start()
+    conn = f = None
+    try:
+        conn = socket.create_connection((router.host, router.port))
+        f = conn.makefile("rwb")
+
+        def rpc(**req):
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            return json.loads(f.readline().decode())
+
+        r = rpc(op="ping")
+        assert r["ok"] and r["backends"] == 2, r
+
+        r1 = rpc(op="open", facade="mono", num_particles=N)
+        r2 = rpc(op="open", facade="mono", num_particles=N)
+        assert r1["ok"] and r2["ok"], (r1, r2)
+        assert r1["home"] != r2["home"], (r1, r2)  # least-loaded spread
+        r3 = rpc(op="open", facade="mono", num_particles=N, home=0)
+        assert r3["ok"] and r3["home"] == 0, r3  # explicit home hint
+
+        rng = np.random.default_rng(5)
+        src = rng.uniform(0.1, 0.9, (N, 3))
+        dst = rng.uniform(0.1, 0.9, (N, 3))
+        ref = PumiTally(mesh, N, TallyConfig(check_found_all=False))
+        ref.CopyInitialPosition(src.reshape(-1).copy())
+        ref.MoveToNextLocation(None, dst.reshape(-1).copy())
+        for sid in (r1["session"], r2["session"]):
+            assert rpc(op="source", session=sid,
+                       positions=_encode_array(src.reshape(-1)))["ok"]
+            assert rpc(op="move", session=sid,
+                       dests=_encode_array(dst.reshape(-1)))["ok"]
+            r = rpc(op="flux", session=sid)
+            assert r["ok"], r
+            flux = _decode_array(r["flux"], np.dtype("<f8"))
+            np.testing.assert_array_equal(flux, np.asarray(ref.flux))
+
+        r = rpc(op="flux", session="notasession")
+        assert not r["ok"] and "unknown session" in r["message"], r
+        assert rpc(op="close", session=r1["session"])["ok"]
+    finally:
+        if f is not None:
+            f.close()
+        if conn is not None:
+            conn.close()
+        router.stop()
+        for fe in fes:
+            fe.stop()
+        for s in svcs:
+            s.shutdown()
+
+
+def test_cli_route_forwards_and_sigterm_exit(tmp_path):
+    """``pumiumtally route`` fronts a ``serve`` worker: a session opened
+    through the router serves flux, and BOTH processes exit 0 on
+    SIGTERM (the preemption-safe contract ``serve`` already pins)."""
+    import signal
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def start(*argv):
+        return subprocess.Popen(
+            [sys.executable, "-m", "pumiumtally_tpu.cli", *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=str(tmp_path), env=env,
+        )
+
+    worker = start("serve", "--port", "0")
+    router = None
+    try:
+        waddr = json.loads(worker.stdout.readline())["serving"]
+        router = start("route", "--backend",
+                       f"{waddr['host']}:{waddr['port']}", "--port", "0")
+        raddr = json.loads(router.stdout.readline())["routing"]
+        assert raddr["backends"] == 1, raddr
+        with socket.create_connection(
+            (raddr["host"], raddr["port"]), timeout=300
+        ) as conn:
+            f = conn.makefile("rwb")
+
+            def rpc(**req):
+                f.write(json.dumps(req).encode() + b"\n")
+                f.flush()
+                return json.loads(f.readline().decode())
+
+            r = rpc(op="open", facade="mono", num_particles=16,
+                    mesh={"box": [1, 1, 1, 2, 2, 2]})
+            assert r["ok"] and r["home"] == 0, r
+            r2 = rpc(op="flux", session=r["session"])
+            assert r2["ok"], r2
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0, router.stderr.read()[-2000:]
+        worker.send_signal(signal.SIGTERM)
+        assert worker.wait(timeout=120) == 0, worker.stderr.read()[-2000:]
+    finally:
+        for proc in (router, worker):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+# -- the real 2-process job (slow tier; SKIPs without gloo) -----------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("arm", ARMS)
+def test_two_process_bitwise_parity(arm, tmp_path):
+    """Two OS processes x 4 virtual devices vs ONE process x 8 virtual
+    devices at the same global shapes: fetched global flux, positions,
+    element ids (and score bank when armed) must match BITWISE."""
+    out = tmp_path / f"{arm}.npz"
+    launch_or_skip(arm, out)
+    assert out.exists(), "worker 0 did not write its results"
+    got = np.load(out)
+    ref_t = build_tally(arm, make_device_mesh(8))
+    run_campaign(ref_t, arm)
+    ref = collect(ref_t, arm)
+    assert sorted(got.files) == sorted(ref)
+    for k in sorted(ref):
+        np.testing.assert_array_equal(got[k], np.asarray(ref[k]),
+                                      err_msg=k)

@@ -8,33 +8,37 @@ jax.distributed job over a localhost coordinator, each contributing 4
 virtual CPU devices to an 8-device global mesh, and the sharded tally
 step's flux psum crosses the process boundary (gloo on CPU — the same
 program rides ICI/DCN on a TPU pod unchanged).
+
+Round 13 de-flaked this pair (ISSUE satellite): the coordinator port
+is retried on a lost bind race, the wait is bounded by
+``PUMIUMTALLY_SUBPROC_TIMEOUT``, and a CPU jaxlib that cannot execute
+cross-process collectives (no gloo) yields a clear SKIP — the workers
+exit ``UNAVAILABLE_EXIT_CODE`` with the ``DISTRIBUTED-UNAVAILABLE``
+marker instead of failing.
 """
 
 import os
-import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 import pytest
 
+from tests._distributed_driver import (
+    _INIT_FAILED_MARKER,
+    _PORT_RETRY_PATTERNS,
+    _free_port,
+    _wait_timeout,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "exp_multiproc.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-@pytest.mark.slow
-def test_two_process_distributed_tally():
-    # Bounded by the workers' communicate(timeout=280) below.
-    import tempfile
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = os.path.join(repo, "tools", "exp_multiproc.py")
-    port = _free_port()
-    procs = []
-    logs = []
+def _run_pair(port: int, timeout: float):
+    """One worker pair on one coordinator port -> (rcs, outputs)."""
+    procs, logs = [], []
     try:
         for pid in (0, 1):
             env = dict(os.environ)
@@ -49,19 +53,67 @@ def test_two_process_distributed_tally():
             log = tempfile.TemporaryFile(mode="w+")
             logs.append(log)
             procs.append(subprocess.Popen(
-                [sys.executable, script], env=env, cwd=repo,
+                [sys.executable, SCRIPT], env=env, cwd=REPO,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
             ))
-        for p in procs:
-            p.wait(timeout=280)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs):
+                break
+            if any(p.poll() is not None and p.returncode != 0
+                   for p in procs):
+                # One worker already gave up (unavailable backend or a
+                # startup failure): kill the peer now instead of
+                # waiting out its collective/heartbeat timeout.
+                time.sleep(2.0)
+                break
+            time.sleep(0.2)
+        timed_out = [i for i, p in enumerate(procs) if p.poll() is None]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for pid, (p, log) in enumerate(zip(procs, logs)):
+    outs = []
+    for log in logs:
         log.seek(0)
-        out = log.read()
-        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-2000:]}"
+        outs.append(log.read())
+        log.close()
+    if timed_out and all(p.returncode == 0 or i in timed_out
+                         for i, p in enumerate(procs)):
+        raise AssertionError(
+            f"distributed workers {timed_out} still running after "
+            f"{timeout:g}s (PUMIUMTALLY_SUBPROC_TIMEOUT extends the "
+            f"bound); outputs:\n" + "\n".join(outs)[-3000:]
+        )
+    return [p.returncode for p in procs], outs
+
+
+@pytest.mark.slow
+def test_two_process_distributed_tally():
+    from pumiumtally_tpu.parallel.distributed import (
+        UNAVAILABLE_EXIT_CODE,
+        UNAVAILABLE_MARKER,
+    )
+
+    timeout = _wait_timeout()
+    attempts = 3
+    for attempt in range(attempts):
+        rcs, outs = _run_pair(_free_port(), timeout)
+        blob = "\n".join(outs)
+        if UNAVAILABLE_MARKER in blob or UNAVAILABLE_EXIT_CODE in rcs:
+            reason = next(
+                (ln for ln in blob.splitlines()
+                 if UNAVAILABLE_MARKER in ln),
+                f"worker exited {UNAVAILABLE_EXIT_CODE}",
+            )
+            pytest.skip(reason)
+        if (_INIT_FAILED_MARKER in blob
+                and any(p in blob.lower() for p in _PORT_RETRY_PATTERNS)
+                and attempt + 1 < attempts):
+            continue  # lost the free-port race: retry on a fresh port
+        break
+    for pid, (rc, out) in enumerate(zip(rcs, outs)):
+        assert rc == 0, f"proc {pid} rc={rc}:\n{out[-2000:]}"
         assert f"proc {pid}: devices=8" in out
         assert f"proc {pid}: partitioned flux=" in out

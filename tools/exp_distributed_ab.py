@@ -1,0 +1,244 @@
+"""Distributed-campaign A/B: collective migration + the 2-process run
+(round 13 — bench.py's "distributed" row consumes the JSON line).
+
+Two layers over the IDENTICAL seeded partitioned workload:
+
+- In-process (this interpreter's devices): the global-scatter migrate
+  vs ``migrate_collective=True`` (all_gather'd counting-rank keys +
+  ppermute ring, parallel/distributed.py). Reported: unfenced rates
+  for both arms, FENCED per-move ms (every move synchronized, so the
+  spread is attributable), the modeled per-round migration-collective
+  bytes (``modeled_migration_collective_bytes`` from the engine's
+  actual packed-state layout), and the compiles-healthy contract —
+  ``compiles.timed == 0``: the collective path adds ONE phase-program
+  variant, compiled in warmup, never in a measured window. Flux
+  parity between the arms is asserted BITWISE before any number is
+  reported — the determinism contract the pod mode rests on.
+
+- Cross-process (subprocess pair via tests/_distributed_driver.py):
+  1 process x 8 virtual CPU devices vs 2 processes x 4, same global
+  shapes, global flux/positions/elem_ids compared BITWISE, with each
+  worker's fenced campaign wall seconds. On jaxlib builds without
+  cross-process CPU collectives (no gloo) this arm reports
+  ``{"available": false, "reason": ...}`` honestly instead of failing
+  — the in-process parity gate still runs, so the row stays green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _make_batches(rng, n: int, batches: int, moves: int):
+    src = rng.uniform(0.1, 0.9, (n, 3))
+    segs = [
+        np.clip(
+            src + rng.normal(scale=0.25, size=(n, 3)), 0.02, 0.98
+        )
+        for _ in range(moves)
+    ]
+    return [(src, segs) for _ in range(batches)]
+
+
+def _drive(t, work):
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+
+
+def _fenced_ms_per_move(t, work, jax):
+    """Mean per-move ms with a device fence after every move — the
+    attributable cost of one step, no cross-move pipelining."""
+    import time
+
+    total = moves = 0.0
+    for src, dests in work:
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        jax.block_until_ready(t.flux)
+        for d in dests:
+            t0 = time.perf_counter()
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+            jax.block_until_ready(t.flux)
+            total += time.perf_counter() - t0
+            moves += 1
+    return total / moves * 1e3
+
+
+def _two_process_arm(arms_timeout_ok: bool = True) -> dict:
+    """1-proc-x-8 vs 2-proc-x-4 CPU subprocess pair at the same global
+    shapes: bitwise npz parity + per-arm fenced campaign seconds."""
+    import tempfile
+
+    from tests._distributed_driver import launch_distributed
+
+    def _seconds(outputs):
+        for out in outputs:
+            m = re.search(r"campaign-seconds=([0-9.]+)", out)
+            if m:
+                return float(m.group(1))
+        return None
+
+    with tempfile.TemporaryDirectory() as td:
+        one = os.path.join(td, "one.npz")
+        two = os.path.join(td, "two.npz")
+        # 2-process arm FIRST: on a jaxlib without gloo it reports
+        # unavailable in seconds, before the 1-process arm is paid for.
+        res2 = launch_distributed(
+            "partitioned", two, num_processes=2, devices_per_proc=4
+        )
+        if res2.skipped:
+            return {"available": False, "reason": res2.reason}
+        res1 = launch_distributed(
+            "partitioned", one, num_processes=1, devices_per_proc=8
+        )
+        if res1.skipped:  # pragma: no cover — 1-proc never skips
+            return {"available": False, "reason": res1.reason}
+        for res in (res1, res2):
+            for pid, rc in enumerate(res.returncodes):
+                if rc != 0:
+                    raise RuntimeError(
+                        f"distributed worker {pid} rc={rc}:\n"
+                        + res.outputs[pid][-2000:]
+                    )
+        a, b = np.load(one), np.load(two)
+        for k in sorted(a.files):
+            if not (a[k] == b[k]).all():
+                raise RuntimeError(
+                    f"2-process global {k} diverged bitwise from the "
+                    "1-process run at the same global shapes"
+                )
+        return {
+            "available": True,
+            "parity_bitwise": True,
+            "processes": 2,
+            "global_devices": 8,
+            "one_proc_campaign_s": _seconds(res1.outputs),
+            "two_proc_campaign_s": _seconds(res2.outputs),
+        }
+
+
+def run_ab(
+    n: int = 50_000,
+    div: int = 12,
+    moves: int = 2,
+    batches: int = 6,
+    two_process: bool = True,
+) -> dict:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import (
+        PartitionedPumiTally,
+        TallyConfig,
+        build_box,
+    )
+    from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.parallel.distributed import (
+        modeled_migration_collective_bytes,
+        state_pack_columns,
+    )
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    dm = make_device_mesh()
+    ndev = int(dm.devices.size)
+    rng = np.random.default_rng(23)
+    work = _make_batches(rng, n, batches, moves)
+    cfg = dict(device_mesh=dm, check_found_all=False,
+               capacity_factor=8.0)
+
+    t_scatter = PartitionedPumiTally(mesh, n, TallyConfig(**cfg))
+    _drive(t_scatter, work[:2])  # warmup: compiles happen here
+    jax.block_until_ready(t_scatter.flux)
+    t0 = time.perf_counter()
+    _drive(t_scatter, work[2:])
+    jax.block_until_ready(t_scatter.flux)
+    scatter_s = time.perf_counter() - t0
+
+    with retrace_guard(raise_on_exceed=False) as guard:
+        t_coll = PartitionedPumiTally(
+            mesh, n, TallyConfig(migrate_collective=True, **cfg)
+        )
+        _drive(t_coll, work[:2])
+        jax.block_until_ready(t_coll.flux)
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            t0 = time.perf_counter()
+            _drive(t_coll, work[2:])
+            jax.block_until_ready(t_coll.flux)
+            coll_s = time.perf_counter() - t0
+
+    # Parity gate: the ppermute-ring migrate must be BITWISE the
+    # global scatter, or the pod mode's determinism contract is gone.
+    if not bool(jnp.all(t_scatter.flux == t_coll.flux)):
+        raise RuntimeError(
+            "collective-migrate flux diverged bitwise from the "
+            "global-scatter engine"
+        )
+
+    st = t_coll.engine.state
+    fcols, icols = state_pack_columns(st)
+    cap = int(st["pending"].shape[0])
+    moves_total = n * moves * (batches - 2)
+    two_proc = (
+        _two_process_arm() if two_process
+        else {"available": False, "reason": "disabled by caller"}
+    )
+    return {
+        "row": "distributed",
+        "scatter_moves_per_sec": moves_total / scatter_s,
+        "collective_moves_per_sec": moves_total / coll_s,
+        "collective_overhead_pct":
+            (coll_s - scatter_s) / scatter_s * 100.0,
+        "fenced_scatter_ms_per_move":
+            _fenced_ms_per_move(t_scatter, work[:2], jax),
+        "fenced_collective_ms_per_move":
+            _fenced_ms_per_move(t_coll, work[:2], jax),
+        "flux_parity_bitwise": True,
+        "migration": {
+            "modeled_collective_bytes_per_round":
+                modeled_migration_collective_bytes(
+                    cap, ndev, fcols, icols
+                ),
+            "float_cols": fcols,
+            "int_cols": icols,
+            "capacity": cap,
+            "devices": ndev,
+        },
+        "two_process": two_proc,
+        # The collective path adds one phase-program variant; it
+        # compiles in warmup — never inside the measured window.
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+        },
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("PUMIUMTALLY_AB_N", 50_000))
+    div = int(os.environ.get("PUMIUMTALLY_AB_DIV", 12))
+    moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+    batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 6))
+    print(json.dumps(run_ab(n=n, div=div, moves=moves, batches=batches),
+                     default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,14 +34,34 @@ def worker(pid: int) -> None:
     import numpy as np
 
     from pumiumtally_tpu import PumiTally, TallyConfig, build_box
-    from pumiumtally_tpu.parallel.device import initialize_distributed
-
-    mesh_dev = initialize_distributed(
-        coordinator_address=f"127.0.0.1:{PORT}",
-        num_processes=2,
-        process_id=pid,
+    from pumiumtally_tpu.parallel.distributed import (
+        UNAVAILABLE_EXIT_CODE,
+        DistributedUnavailableError,
+        assert_collectives_available,
+        init_distributed,
     )
+
+    try:
+        mesh_dev = init_distributed(
+            coordinator_address=f"127.0.0.1:{PORT}",
+            num_processes=2,
+            process_id=pid,
+        )
+    except Exception as e:  # startup failure: classified for the test
+        print(f"DISTRIBUTED-INIT-FAILED: {type(e).__name__}: {e}",
+              flush=True)
+        raise SystemExit(3) from e
     assert mesh_dev.devices.size == 8, mesh_dev
+    try:
+        # Probe BEFORE the campaign: a CPU jaxlib without gloo cannot
+        # execute cross-process collectives at all — exit with the
+        # skip marker (the test turns it into a SKIP, not a failure).
+        assert_collectives_available(mesh_dev)
+    except DistributedUnavailableError as e:
+        print(str(e), flush=True)  # carries DISTRIBUTED-UNAVAILABLE
+        # No jax.distributed.shutdown(): the barrier would wait on a
+        # peer that died of the same error.
+        raise SystemExit(UNAVAILABLE_EXIT_CODE) from e
     n = 64
     mesh = build_box(1, 1, 1, 3, 3, 3)
     rng = np.random.default_rng(0)

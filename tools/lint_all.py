@@ -41,7 +41,7 @@ JAXLINT_TARGETS = [
     "pumiumtally_tpu/", "bench.py", "examples/", "tools/exp_stats_ab.py",
     "tools/exp_resilience_ab.py", "tools/exp_sentinel_ab.py",
     "tools/exp_scoring_ab.py", "tools/exp_service_ab.py",
-    "tools/exp_fusion_ab.py",
+    "tools/exp_fusion_ab.py", "tools/exp_distributed_ab.py",
 ]
 
 

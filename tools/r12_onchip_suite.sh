@@ -1,0 +1,90 @@
+#!/bin/bash
+# r12 on-chip suite (PR 13 — the round-13 pod-scale distributed
+# campaign layer; suites are numbered by PR like r8-r11 before it,
+# one less than the docs/DESIGN.md round they measure... the r12/PR-12
+# batch-fusion round measured itself inside r11's suite, so the
+# numbering realigns here).
+# Fired by a probe loop (tools/r5_probe_loop.sh pattern) the moment
+# the TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK headline
+# bench first (a short window must still yield a fresh cached
+# measurement), then the full bench (whose row set now includes the
+# DISTRIBUTED component row in-process), then THIS round's
+# measurement —
+#   distributed_ab: collective (all_gather'd counting-rank keys +
+#     ppermute ring) vs global-scatter migration at campaign shape,
+#     with the BITWISE flux-parity gate and the zero-compile
+#     measured-pass contract enforced inside the tool, the modeled
+#     per-round migration-collective bytes next to the measured
+#     rates, and the 1-proc-vs-2-proc subprocess parity subarm (on a
+#     TPU host the CPU subarm exercises gloo if the installed jaxlib
+#     carries it; "available": false is an honest report, not a
+#     failure). On-chip this decides the round-13 bet: SHIP
+#     migrate_collective default-on for pod topologies if the
+#     collective arm >= 1.0x scatter on-chip (on CPU it measured
+#     ~3.3x — explicit collectives beat GSPMD's resharding of the
+#     full-capacity scatter; on TPU the scatter lowers better, so
+#     parity is the bar), KILL the default (keep it opt-in) if the
+#     ppermute ring costs > 1.2x scatter —
+# then the inherited subsystem A/Bs and engine experiments; chipless
+# AOT compiles go last (the remote compile helper remains the prime
+# wedge suspect).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r12_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r12 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_SCORING=0 PUMIUMTALLY_BENCH_RESILIENCE=0 PUMIUMTALLY_BENCH_SENTINEL=0 PUMIUMTALLY_BENCH_SERVICE=0 PUMIUMTALLY_BENCH_SERVICE_FUSION=0 PUMIUMTALLY_BENCH_DISTRIBUTED=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-13 measurement: collective vs global-scatter migration at
+# campaign shape (larger than the in-bench row), plus the 2-process
+# parity subarm. Decides the ship/kill rule in the header.
+run distributed_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_distributed_ab.py
+# The round-12 fusion and round-11 serving-tax re-measures, unchanged
+# shapes so rounds compare like-for-like.
+run fusion_ab 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=1,4,8,16 PUMIUMTALLY_AB_TRIALS=3 python tools/exp_fusion_ab.py
+run service_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_service_ab.py
+# Inherited subsystem A/Bs (r7-r10 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run scoring_ab  1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=6 python tools/exp_scoring_ab.py
+run sentinel_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_sentinel_ab.py
+run resilience_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_resilience_ab.py
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects).
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
