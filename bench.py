@@ -437,6 +437,32 @@ def run_table_precision_ab() -> dict | None:
     )
 
 
+def run_pallas_walk_ab() -> dict | None:
+    """Component row: the one-kernel Pallas walk (r17,
+    tools/exp_pallas_walk_ab.py run_ab) — fused select/refine/scatter
+    with grid-pipelined table streaming (walk_kernel='pallas') vs the
+    bf16 gather sub-split on the identical partitioned workload, both
+    arms forced into the blocked regime. The tool enforces its gates
+    before reporting any rate: a kernel-level INTERPRET-mode bitwise
+    pin vs walk_local, bitwise positions/elem_ids between the timed
+    arms, flux in the reassociation class, conservation, and the
+    compiles-healthy contract (``compiles.timed == 0``). The record
+    carries the 80 B vs 52 B modeled bytes/crossing provenance. On CPU
+    the pallas arm runs in interpret mode — the row certifies
+    correctness and arms the on-chip ship/kill decision
+    (docs/PERF_NOTES.md); the CPU "speedup" is NOT that number.
+    Reduced shape (interpret mode is slow); best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_pallas_walk_ab
+
+    return exp_pallas_walk_ab.run_ab(
+        n=min(N, 8192), div=min(MESH_DIV, 6), moves=2, trials=2,
+        block_elems=512,
+    )
+
+
 def run_batch_stats() -> dict | None:
     """Component row: the batch-statistics subsystem's cost and its
     trigger behavior (tools/exp_stats_ab.py run_ab) — stats-on vs
@@ -1036,6 +1062,12 @@ def _measure_and_report() -> None:
             distributed = run_distributed_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# distributed A/B failed: {e}", file=sys.stderr)
+    pallas_walk = None
+    if os.environ.get("PUMIUMTALLY_BENCH_PALLAS_WALK", "1") != "0":
+        try:
+            pallas_walk = run_pallas_walk_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# pallas-walk A/B failed: {e}", file=sys.stderr)
     blocked = None
     if os.environ.get("PUMIUMTALLY_BENCH_VMEM", "1") != "0":
         try:
@@ -1204,6 +1236,14 @@ def _measure_and_report() -> None:
         # "available": false without gloo), and the compiles-healthy
         # contract (compiles.timed == 0).
         "distributed": distributed,
+        # One-kernel Pallas walk (r17): fused select/refine/scatter
+        # with streamed block tables vs the bf16 gather sub-split,
+        # interpret-mode bitwise pin + bitwise positions between arms
+        # enforced inside the tool, 80 B vs 52 B modeled
+        # bytes/crossing, compiles.timed == 0. On CPU the pallas arm
+        # is interpret-mode — the on-chip ship/kill call uses the
+        # r13 suite's Mosaic-compiled rate, not this row's speedup.
+        "pallas_walk": pallas_walk,
         "vmem_blocked": None if blocked is None else {
             "moves_per_sec": blocked["moves_per_sec"],
             "blocks_per_chip": blocked["blocks_per_chip"],

@@ -87,7 +87,7 @@ class PartitionedPumiTally(PumiTally):
             cond_every=self.config.resolved_cond_every(),
             min_window=self.config.resolved_min_window(),
             vmem_walk_max_elems=self.config.walk_vmem_max_elems,
-            block_kernel=self.config.walk_block_kernel,
+            block_kernel=self.config.resolved_walk_kernel(),
             partition_method=self.config.resolved_partition_method(),
             table_dtype=self._table_dtype,
             cap_frontier=self.config.cap_frontier,

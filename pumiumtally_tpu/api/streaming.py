@@ -717,17 +717,23 @@ class StreamingPartitionedTally(StreamingTally):
             resolve_block_kernel,
         )
 
-        # The Mosaic scoped-VMEM clamp applies only to the vmem block
-        # kernel; the gather block kernel has no such ceiling. A bf16
-        # two-tier config routes blocked walks through the gather
-        # kernel (same resolution the engines apply), with the block
-        # element bound at 2x — the half-width select tier keeps
-        # resident bytes constant.
+        # The Mosaic scoped-VMEM clamp applies to the vmem block kernel
+        # and to the pallas streaming kernel (whose resident per-block
+        # operands obey the same scoped-stack law at the bf16 2x
+        # ceiling); the gather block kernel has no such ceiling. A bf16
+        # two-tier config with walk_kernel='vmem' routes blocked walks
+        # through the gather kernel (same resolution the engines
+        # apply), with the block element bound at 2x — the half-width
+        # select tier keeps resident bytes constant.
         block_kernel = resolve_block_kernel(
-            self.config.walk_block_kernel, self._table_dtype
+            self.config.resolved_walk_kernel(), self._table_dtype
         )
         if block_kernel == "vmem":
             vmem_bound = effective_vmem_bound(self.config.walk_vmem_max_elems)
+        elif block_kernel == "pallas":
+            vmem_bound = effective_vmem_bound(
+                self.config.walk_vmem_max_elems, "bfloat16"
+            )
         else:
             vmem_bound = self.config.walk_vmem_max_elems
         part = build_partition(
@@ -756,7 +762,7 @@ class StreamingPartitionedTally(StreamingTally):
                 cond_every=self.config.resolved_cond_every(),
                 min_window=self.config.resolved_min_window(),
                 vmem_walk_max_elems=vmem_bound,
-                block_kernel=self.config.walk_block_kernel,
+                block_kernel=self.config.resolved_walk_kernel(),
                 partition_method=self.config.resolved_partition_method(),
                 cap_frontier=self.config.cap_frontier,
                 scoring=self.config.scoring,

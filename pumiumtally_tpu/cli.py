@@ -305,7 +305,15 @@ def cmd_aot_check(args) -> None:
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     jobs = [("walk kernel (single chip)",
              [sys.executable, os.path.join(tools, "aot_vmem_compile.py"),
-              "2048", "1024", "1024", "4", "1"])]
+              "2048", "1024", "1024", "4", "1"]),
+            # The round-17 one-kernel walk. Its harness carries its own
+            # SIGALRM deadlines and reports a structured SKIP (rc 0)
+            # where the topology client would hang — shown as green
+            # with the skip reason in the tail, never a wedge.
+            ("one-kernel pallas walk (single chip)",
+             [sys.executable,
+              os.path.join(tools, "aot_pallas_walk_compile.py"),
+              "--quick"])]
     if args.multichip:
         jobs.append(("multi-chip phase programs",
                      [sys.executable,

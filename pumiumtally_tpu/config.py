@@ -73,6 +73,12 @@ RETRACE_BUDGETS: dict = {
     # joined the phase cache key: still max 11 (the collective-vs-
     # scatter parity tests peak at 6 — one extra phase variant per
     # engine pair, compiled once), so the budget holds unchanged.
+    # Re-measured in r17 after use_pallas_walk joined the phase key
+    # (PUMIUMTALLY_RETRACE_RECORD over tests/test_pallas_walk.py +
+    # the bench pallas_walk row): max 6 — the pallas-vs-gather parity
+    # tests drive three engines back to back but the pallas round
+    # program is one phase variant per engine, compiled once — so the
+    # budget holds unchanged again.
     "cascade_phase": 12,
     # Profiled-phase programs (parallel/partition.py component-budget
     # instrumentation): one jitted single-round program per
@@ -402,6 +408,27 @@ class TallyConfig:
     #              supported, bitwise-comparable semantics to the
     #              unblocked partitioned walk.
     walk_block_kernel: str = "vmem"
+    # Which kernel family runs the partitioned local walk (round 17,
+    # ops/pallas_walk.py; supersedes walk_block_kernel as the primary
+    # selector while keeping it as the legacy escape hatch):
+    #   "gather" — the status-quo resolution (default): defer to
+    #              walk_block_kernel exactly as before this knob
+    #              existed, so an untuned config's traces stay
+    #              byte-identical (walk_block_kernel="vmem" is inert
+    #              without walk_vmem_max_elems).
+    #   "vmem"   — force the f32 one-hot VMEM kernel family
+    #              (equivalent to walk_block_kernel="vmem").
+    #   "pallas" — the one-kernel two-tier Pallas walk: bf16 select +
+    #              f32 single-face refine + deterministic flux (and
+    #              scoring-lane) scatter fused into ONE kernel per
+    #              particle tile, with the block tables double-buffered
+    #              by the grid pipeline past the fits-in-VMEM case
+    #              (52 B/crossing streamed vs the 80 B f32 gather —
+    #              ops/pallas_walk.py modeled_walk_bytes). Requires
+    #              walk_table_dtype="bfloat16" (validated below);
+    #              walk_vmem_max_elems sizes the streamed blocks
+    #              (unset = one resident block per chip).
+    walk_kernel: str = "gather"
     # Batch statistics (pumiumtally_tpu/stats, docs/DESIGN.md "Batch
     # statistics"): when True, every facade keeps two extra [E] device
     # lanes (per-batch flux sum and sum of squares, original element
@@ -551,6 +578,21 @@ class TallyConfig:
                 "walk_block_kernel must be 'vmem' or 'gather', "
                 f"got {self.walk_block_kernel!r}"
             )
+        if self.walk_kernel not in ("gather", "vmem", "pallas"):
+            raise ValueError(
+                "walk_kernel must be 'gather', 'vmem' or 'pallas', "
+                f"got {self.walk_kernel!r}"
+            )
+        if (
+            self.walk_kernel == "pallas"
+            and self.resolved_table_dtype() != "bfloat16"
+        ):
+            raise ValueError(
+                "walk_kernel='pallas' is the two-tier streaming kernel "
+                "and needs the bf16 select tier — set "
+                "walk_table_dtype='bfloat16' (got "
+                f"{self.resolved_table_dtype()!r})"
+            )
         if self.batch_stats_trigger is not None:
             from pumiumtally_tpu.stats.triggers import TriggerSpec
 
@@ -630,6 +672,17 @@ class TallyConfig:
         from pumiumtally_tpu.ops.walk import _resolve_table_dtype
 
         return _resolve_table_dtype(self.walk_table_dtype or "auto")
+
+    def resolved_walk_kernel(self) -> str:
+        """The block-kernel selector the partitioned engines receive.
+        ``walk_kernel="gather"`` (the default) is the STATUS-QUO
+        resolution: defer to the legacy ``walk_block_kernel`` knob so
+        untuned configs build byte-identical engines (that knob's
+        "vmem" default is inert without ``walk_vmem_max_elems``);
+        anything else names the kernel family outright."""
+        if self.walk_kernel == "gather":
+            return self.walk_block_kernel
+        return self.walk_kernel
 
     def resolved_partition_method(self) -> str:
         """Partition-permutation method with the default applied

@@ -1,0 +1,512 @@
+"""One-kernel Pallas walk: fused two-tier select/refine/scatter with
+double-buffered table streaming.
+
+``ops/vmem_walk.py`` proved the one-hot MXU form of the partitioned
+walk — table pinned in VMEM, the whole while_loop on-chip, flux
+accumulated as a matmul — but only for the f32 single-tier table and
+only below the fits-in-VMEM element ceiling. This kernel generalizes
+it along both axes:
+
+1. **Two-tier tables** (docs/PERF_NOTES.md "Table precision tiers"):
+   the fetched row is the half-width bf16 SELECT row, lifted to the
+   working dtype ONCE per table block (``_lift_bf16`` — the exact
+   bit-shift upcast) and fetched by the same one-hot matmul; the
+   winning face's full-precision refinement plane comes from a second
+   one-hot matmul against the block's ``[Lp, 4·WALK_PLANE_WIDTH]``
+   refinement operand with an exact 4-way face select. Selection and
+   refinement then run the SAME row-level helpers as the gather walk
+   (``ops/walk.py select_rows_lo / refine_plane_hi``), so positions,
+   elements, pause points and iteration counts are BITWISE-identical
+   to ``walk_local``'s two-tier path — the fetch is exact (one-hot
+   rows: 0·v = 0, 1·v = v, sum of zeros is exact), and everything
+   after the fetch is literally the same trace. Only the flux (and
+   scoring-lane) accumulation differs: per-tile matmul partials
+   summed at the end instead of cascaded scatter-adds — the
+   scatter-order FP reassociation class partitioned mode already
+   documents.
+
+2. **Streaming past the VMEM ceiling**: the grid is
+   ``(blocks, tiles-per-block)`` over the engine's sub-split block
+   tables, and Pallas' grid pipeline DOUBLE-BUFFERS the block inputs —
+   while grid step ``(b, t)`` walks, the ``(b, t+1)`` / ``(b+1, 0)``
+   table blocks are prefetching into VMEM. A partition bigger than
+   VMEM therefore streams through the kernel block by block at the
+   two-tier byte floor (``modeled_walk_bytes``: 52 B/crossing vs the
+   80 B f32 gather; the resident ``blocks == 1`` case degenerates to
+   the vmem prototype's zero-table-traffic regime) instead of
+   rerouting to the gather kernel.
+
+3. **In-kernel scoring lanes** (the first block kernel with a scoring
+   lowering): each crossing's lane update ``(elem·stride + bin + k,
+   colv·fac)`` becomes a dense ``[w_tile, stride]`` value matrix —
+   ``val[w, j] = Σ_k [sbin[w]+k == j] · colv_k[w] · fac[w, k]`` — and
+   one ``ohᵀ·val`` matmul accumulates the block's ``[Lp, stride]``
+   bank partial on-chip. The DROP sentinel (``scoring/binding.py``:
+   ``bin_off = bank_size``, far past any stride) never matches a
+   column, so dropped lanes die exactly like the gather path's
+   ``mode="drop"`` scatter. Values are the same per-crossing products
+   as ``score_pair``; only the accumulation order differs (the same
+   benign class as flux).
+
+Engines route here via ``TallyConfig.walk_kernel = "pallas"``
+(``parallel/partition.py resolve_block_kernel``); the default config
+keeps every existing trace byte-identical. Mosaic's block-shape /
+while-carry laws are inherited from ``ops/vmem_walk.py`` (module
+docstring there); the two table operands use whole-array minors
+(16 and 20 lanes), which rank-2 blocks permit. The shared-helper
+einsum/argmin select is the part of this kernel the chipless AOT
+harness (tools/aot_pallas_walk_compile.py) exists to certify — the
+interpret path never checks Mosaic's op coverage.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pumiumtally_tpu.mesh.tetmesh import (
+    WALK_PLANE_WIDTH,
+    WALK_TABLE_LO_WIDTH,
+    WALK_TABLE_WIDTH,
+)
+from pumiumtally_tpu.ops.vmem_walk import (
+    TILE_1D,
+    W_TILE_DEFAULT,
+    _round_up,
+    backend_needs_interpret,
+)
+from pumiumtally_tpu.ops.walk import (
+    _lift_bf16,
+    refine_plane_hi,
+    select_rows_lo,
+)
+
+# The refinement operand's packed block layout: element g's four
+# [WALK_PLANE_WIDTH] face rows of ``table_hi`` flattened into one
+# [4·WALK_PLANE_WIDTH] row, so ONE one-hot matmul fetches every
+# candidate plane and the winner is an exact 4-way column select
+# (row g of the packed block, cols [f·W, (f+1)·W) ≡ table_hi row
+# g·4+f — pure relayout, no value changes).
+HI_BLOCK_COLS = 4 * WALK_PLANE_WIDTH
+
+
+def modeled_walk_bytes(kernel: str, table_dtype: str = "float32") -> int:
+    """Modeled HBM table traffic per crossing, from the packed-layout
+    constants (the ``state_pack_columns`` discipline: the model is
+    derived from the same constants that build the tables, so a layout
+    change reprices it automatically — mirrors
+    ``parallel/distributed.py modeled_migration_collective_bytes``).
+
+    - ``gather``/``float32``: one [WALK_TABLE_WIDTH] f32 row per
+      crossing — the measured ~80 B floor (docs/PERF_NOTES.md).
+    - ``gather``/``bfloat16`` and ``pallas``/``bfloat16``: the
+      two-tier 52 B model — one bf16 SELECT row plus ONE f32
+      refinement plane. The pallas kernel STREAMS these bytes as
+      sequential block DMA (amortized over the block's crossings)
+      instead of random row gathers; the per-crossing model is the
+      same 52 B, approached from the bandwidth-friendly side.
+    - ``vmem``/``float32``: 0 — the resident table pays no
+      per-crossing HBM traffic at all (the regime the pallas kernel
+      degenerates to at ``blocks == 1``).
+    """
+    if kernel == "vmem":
+        if table_dtype != "float32":
+            raise ValueError(
+                "the vmem kernel has no two-tier lowering "
+                "(ops/vmem_walk.py); use kernel='pallas' for bfloat16"
+            )
+        return 0
+    if kernel not in ("gather", "pallas"):
+        raise ValueError(
+            f"kernel must be 'gather', 'vmem' or 'pallas', got {kernel!r}"
+        )
+    if table_dtype == "float32":
+        if kernel == "pallas":
+            raise ValueError(
+                "the pallas walk kernel is two-tier only "
+                "(walk_table_dtype='bfloat16')"
+            )
+        return WALK_TABLE_WIDTH * 4  # 80 B: one packed f32 row
+    if table_dtype == "bfloat16":
+        # 52 B: bf16 select row + ONE f32 refinement plane.
+        return WALK_TABLE_LO_WIDTH * 2 + WALK_PLANE_WIDTH * 4
+    raise ValueError(
+        f"table_dtype must be 'float32' or 'bfloat16', got {table_dtype!r}"
+    )
+
+
+def pack_hi_blocks(
+    table_hi: jnp.ndarray, blocks: int, L: int, Lp: int
+) -> jnp.ndarray:
+    """``[blocks·L·4, WALK_PLANE_WIDTH]`` refinement tier →
+    ``[blocks·Lp, HI_BLOCK_COLS]`` per-block MXU operand (element-major
+    flatten of each element's four face planes, rows zero-padded to the
+    TILE_1D multiple — padded rows are never selected by the one-hot).
+    Pure relayout: ``packed[b·Lp + g, f·W + j] == table_hi[(b·L + g)·4
+    + f, j]``."""
+    packed = table_hi.reshape(blocks, L, HI_BLOCK_COLS)
+    if Lp != L:
+        packed = jnp.concatenate(
+            [packed,
+             jnp.zeros((blocks, Lp - L, HI_BLOCK_COLS), table_hi.dtype)],
+            axis=1,
+        )
+    return packed.reshape(blocks * Lp, HI_BLOCK_COLS)
+
+
+def pad_lo_blocks(
+    table_lo: jnp.ndarray, blocks: int, L: int, Lp: int
+) -> jnp.ndarray:
+    """``[blocks·L, WALK_TABLE_LO_WIDTH]`` select tier →
+    ``[blocks·Lp, WALK_TABLE_LO_WIDTH]`` with zero-padded block rows
+    (same contract as ``pack_hi_blocks``; bf16 zeros lift to 0.0)."""
+    if Lp == L:
+        return table_lo
+    cols = table_lo.shape[1]
+    return jnp.concatenate(
+        [table_lo.reshape(blocks, L, cols),
+         jnp.zeros((blocks, Lp - L, cols), table_lo.dtype)], axis=1
+    ).reshape(blocks * Lp, cols)
+
+
+def pallas_walk_local(
+    table_lo: jnp.ndarray,  # [blocks*L, WALK_TABLE_LO_WIDTH] bf16 select
+    table_hi: jnp.ndarray,  # [blocks*L*4, WALK_PLANE_WIDTH] refinement
+    x: jnp.ndarray,  # [S,3]
+    lelem: jnp.ndarray,  # [S] block-local element ids
+    dest: jnp.ndarray,  # [S,3]
+    flying: jnp.ndarray,  # [S] int8
+    weight: jnp.ndarray,  # [S]
+    done: jnp.ndarray,  # [S] bool
+    exited: jnp.ndarray,  # [S] bool
+    flux: jnp.ndarray,  # [blocks*L] owned flux
+    *,
+    tally: bool,
+    tol: float,
+    max_iters: int,
+    w_tile: int = W_TILE_DEFAULT,
+    interpret: Optional[bool] = None,
+    vma: Optional[frozenset] = None,
+    blocks: int = 1,
+    scoring=None,  # ScoreOps over this chip's [blocks*L*stride] bank
+) -> Tuple[jnp.ndarray, ...]:
+    """Drop-in for ``parallel.partition.walk_local``'s two-tier path
+    (minus its cascade knobs): returns ``(x, lelem, done, exited,
+    pending, flux, iters)`` — plus the accumulated score bank as an
+    EIGHTH element when ``scoring`` is armed — with identical
+    pause/boundary semantics. Positions/elements/pending are bitwise
+    ``walk_local``; flux and lanes differ only in accumulation order
+    (module docstring).
+
+    ``blocks``: streaming sub-split, same layout contract as
+    ``vmem_walk_local`` — ``blocks`` stacked block tables, slots
+    grouped by block (``cap_b = S // blocks``, ``lelem`` block-local,
+    flux ``[blocks*L]``), grid ``(blocks, tiles)`` with the block
+    tables double-buffered by the grid pipeline. Requires
+    ``S % blocks == 0`` and ``cap_b % w_tile == 0``.
+
+    ``vma``: see ``vmem_walk_local`` — engines disable varying-axis
+    checking for pallas round programs instead; kept for a jax whose
+    interpret path preserves the tags.
+    """
+    from jax.experimental import pallas as pl
+
+    if table_lo.dtype != jnp.bfloat16:
+        raise ValueError(
+            "pallas_walk_local needs the bf16 SELECT tier "
+            f"(got {table_lo.dtype}); build the partition with "
+            "table_dtype='bfloat16'"
+        )
+    if interpret is None:
+        interpret = backend_needs_interpret()
+    fdtype = x.dtype
+    hdtype = table_hi.dtype
+    blocks = int(blocks)
+    L = table_lo.shape[0] // blocks
+    n = x.shape[0]
+    score_on = scoring is not None
+    if score_on:
+        if not tally:
+            raise ValueError("scoring requires a tallying walk")
+        s_kinds = scoring.kinds
+        stride = scoring.bank.shape[0] // flux.shape[0]
+        n_scores = len(s_kinds)
+        sbin, sfac, bank = scoring.bin_off, scoring.fac, scoring.bank
+    if n == 0:  # walk_local handles the empty batch; match it
+        out = (x, lelem, done, exited, jnp.full((0,), -1, jnp.int32),
+               flux, jnp.asarray(0, jnp.int32))
+        return out + (bank,) if score_on else out
+    w_tile = _round_up(max(int(w_tile), 1), TILE_1D)
+    if blocks > 1:
+        # Sub-split layout is engine-arranged: no padding here, the
+        # slot grouping IS the block routing.
+        if n % blocks or (n // blocks) % w_tile:
+            raise ValueError(
+                f"blocked pallas walk needs slots divisible into "
+                f"blocks x k x w_tile, got S={n}, blocks={blocks}, "
+                f"w_tile={w_tile}"
+            )
+        pad = 0
+    else:
+        pad = (-n) % w_tile
+        if pad:
+            def padv(a, fill):
+                return jnp.concatenate(
+                    [a, jnp.full((pad, *a.shape[1:]), fill, a.dtype)]
+                )
+
+            x, dest = padv(x, 0.0), padv(dest, 0.0)
+            lelem = padv(lelem, 0)
+            flying = padv(flying, 0)
+            weight = padv(weight, 0.0)
+            done = padv(done, True)  # pad slots are inert
+            exited = padv(exited, False)
+            if score_on:
+                sbin = padv(sbin, 0)  # inert slots add exact zeros
+                sfac = padv(sfac, 0.0)
+
+    d0 = dest - x
+    seg_len = jnp.linalg.norm(d0, axis=1)
+    eff_w = jnp.where(flying.astype(bool), weight * seg_len, 0.0)
+    T = (n + pad) // w_tile // blocks  # tiles per block
+    max_iters = int(max_iters)
+    Lp = _round_up(L, TILE_1D)
+    lo_p = pad_lo_blocks(table_lo, blocks, L, Lp)
+    hi_p = pack_hi_blocks(table_hi, blocks, L, Lp)
+
+    def kernel(*refs):
+        refs = list(refs)
+        (lo_ref, hi_ref, x_ref, lelem_ref, dest_ref, effw_ref, done_ref,
+         exited_ref) = refs[:8]
+        i = 8
+        if score_on:
+            sbin_ref, sfac_ref = refs[i:i + 2]
+            i += 2
+        (s_out, lelem_out, done_out, exited_out, pending_out,
+         it_out) = refs[i:i + 6]
+        i += 6
+        flux_out = refs[i] if tally else None
+        i += int(tally)
+        bank_out = refs[i] if score_on else None
+
+        x0 = x_ref[:]
+        # walk_local's two-tier advance rebuilds dest from the carried
+        # ray invariants (dest_c = x0 + d0) — reproduce that EXACT
+        # float, not the original dest, or parity is off by an ulp.
+        d0_c = dest_ref[:] - x0
+        dest_c = x0 + d0_c
+        effw_c = effw_ref[:]
+        one_k = jnp.asarray(1.0, fdtype)
+        # Lift the whole bf16 block ONCE per grid step (elementwise and
+        # exact, so lift-then-fetch == fetch-then-lift bitwise); the
+        # while body then fetches working-dtype rows.
+        lo_v = _lift_bf16(lo_ref[:], fdtype)
+        hi_v = hi_ref[:]
+        iota = lax.broadcasted_iota(jnp.int32, (w_tile, Lp), 1)
+        if vma and hasattr(lax, "pvary"):
+            # See vmem_walk_local: iota computed from no input stays
+            # "unvarying" under shard_map's vma checking.
+            iota = lax.pvary(iota, tuple(vma))
+        if score_on:
+            j_iota = lax.broadcasted_iota(jnp.int32, (w_tile, stride), 1)
+            if vma and hasattr(lax, "pvary"):
+                j_iota = lax.pvary(j_iota, tuple(vma))
+            sbin_c = sbin_ref[:]
+            sfac_c = sfac_ref[:]
+
+        # flux/bank/iters live in per-BLOCK output blocks revisited by
+        # every tile t (index_map ignores t): zero on the block's first
+        # tile, reduce in VMEM across tiles — the revisited-block
+        # reduction pattern from vmem_walk_local.
+        t_id = pl.program_id(1)
+
+        @pl.when(t_id == 0)
+        def _init():
+            it_out[:] = jnp.zeros_like(it_out)
+            if tally:
+                flux_out[:] = jnp.zeros_like(flux_out)
+            if score_on:
+                bank_out[:] = jnp.zeros_like(bank_out)
+
+        # Loop state in the per-tile OUTPUT refs + two-scalar while
+        # carry; seeds derived from kernel inputs — both Mosaic/vma
+        # laws inherited from vmem_walk_local (see the long comments
+        # there; do not "simplify").
+        s_out[:] = x0[:, 0] * jnp.asarray(0, fdtype)
+        lelem_out[:] = lelem_ref[:]
+        done_out[:] = done_ref[:]
+        exited_out[:] = exited_ref[:]
+        pending_out[:] = (lelem_ref[:] - lelem_ref[:]) - 1
+
+        def body(carry):
+            it, _n_active = carry
+            s = s_out[:]
+            lelem = lelem_out[:]
+            done = done_out[:] != 0
+            exited = exited_out[:] != 0
+            pending = pending_out[:]
+            active = (~done) & (pending < 0)
+            oh = lelem[:, None] == iota
+            oh_f = oh.astype(fdtype)
+            # One-hot row fetch is exact for finite table values
+            # (0·v = 0, 1·v = v, + 0 exact) — bitwise the gather.
+            row = jnp.dot(oh_f, lo_v, preferred_element_type=fdtype)
+            s_sel, f_exit = select_rows_lo(row, s, dest_c, d0_c, tol,
+                                           one_k)
+            oh_h = oh_f if hdtype == fdtype else oh.astype(hdtype)
+            hi4 = jnp.dot(oh_h, hi_v, preferred_element_type=hdtype)
+            # Winning face's plane: exact 4-way column select (pure
+            # selection — no arithmetic touches the values).
+            cols = []
+            for j in range(WALK_PLANE_WIDTH):
+                v = hi4[:, 3 * WALK_PLANE_WIDTH + j]
+                for f in (2, 1, 0):
+                    v = jnp.where(
+                        f_exit == f, hi4[:, f * WALK_PLANE_WIDTH + j], v
+                    )
+                cols.append(v)
+            plane = jnp.stack(cols, axis=1)
+            s_exit, nxt = refine_plane_hi(plane, s, s_sel, dest_c, d0_c,
+                                          tol, one_k)
+            # walk_local's advance tail, verbatim.
+            reached = s_exit >= one_k
+            s_new = jnp.where(reached, one_k, s_exit)
+            hit_boundary = (~reached) & (nxt == -1)
+            goes_remote = (~reached) & (nxt <= -2)
+            if tally:
+                contrib = jnp.where(active, (s_new - s) * effw_c, 0.0)
+            if score_on:
+                crossed = (active & ~reached).astype(contrib.dtype)
+                # score_pair's lane values as a dense [w_tile, stride]
+                # matrix: column j = bin_off + k collects
+                # colv_k · fac[:, k] (module docstring; sentinel
+                # bin_off sits far past stride and never matches).
+                val = (contrib * 0)[:, None] * jnp.zeros(
+                    (1, stride), fdtype
+                )
+                for k, kind in enumerate(s_kinds):
+                    colv = contrib if kind == "track" else crossed
+                    hit = (sbin_c + jnp.int32(k))[:, None] == j_iota
+                    val = val + jnp.where(
+                        hit, (colv * sfac_c[:, k])[:, None],
+                        jnp.asarray(0.0, fdtype),
+                    )
+            moving = active & ~reached & ~hit_boundary & ~goes_remote
+            lelem = jnp.where(moving, nxt, lelem)
+            s = jnp.where(active, s_new, s)
+            pending = jnp.where(active & goes_remote, -nxt - 2, pending)
+            done = done | (active & (reached | hit_boundary))
+            exited = exited | (active & hit_boundary)
+            s_out[:] = s
+            lelem_out[:] = lelem
+            done_out[:] = done.astype(jnp.int32)
+            exited_out[:] = exited.astype(jnp.int32)
+            pending_out[:] = pending
+            if tally:
+                flux_out[:] = flux_out[:] + jnp.dot(
+                    contrib[None, :], oh_f,
+                    preferred_element_type=flux_out.dtype,
+                )[0]
+            if score_on:
+                # ohᵀ · val: the block's [Lp, stride] lane partial.
+                bank_out[:] = bank_out[:] + lax.dot_general(
+                    oh_f, val, (((0,), (0,)), ((), ())),
+                    preferred_element_type=bank_out.dtype,
+                )
+            n_active = jnp.sum(
+                ((~done) & (pending < 0)).astype(jnp.int32)
+            )
+            return it + jnp.int32(1), n_active
+
+        def cond(carry):
+            it, n_active = carry
+            return (it < max_iters) & (n_active > 0)
+
+        n0 = jnp.sum((done_ref[:] == 0).astype(jnp.int32))
+        it, _ = lax.while_loop(cond, body, (jnp.int32(0), n0))
+        it_out[:] = jnp.maximum(it_out[:], it)
+
+    S = T * w_tile * blocks
+    tile = lambda: pl.BlockSpec(  # noqa: E731
+        (w_tile,), lambda b, t: (b * T + t,))
+    tile3 = lambda: pl.BlockSpec(  # noqa: E731
+        (w_tile, 3), lambda b, t: (b * T + t, 0))
+    in_specs = [
+        pl.BlockSpec((Lp, WALK_TABLE_LO_WIDTH), lambda b, t: (b, 0)),
+        pl.BlockSpec((Lp, HI_BLOCK_COLS), lambda b, t: (b, 0)),
+        tile3(), tile(), tile3(), tile(), tile(), tile(),
+    ]
+    if score_on:
+        in_specs += [
+            tile(),
+            pl.BlockSpec((w_tile, n_scores), lambda b, t: (b * T + t, 0)),
+        ]
+
+    def sds(shape, dtype):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    out_specs = [
+        tile(), tile(), tile(), tile(), tile(),
+        pl.BlockSpec((TILE_1D,), lambda b, t: (b,)),
+    ]
+    out_shape = [
+        sds((S,), fdtype),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((S,), jnp.int32),
+        sds((blocks * TILE_1D,), jnp.int32),
+    ]
+    if tally:
+        out_specs.append(pl.BlockSpec((Lp,), lambda b, t: (b,)))
+        out_shape.append(sds((blocks * Lp,), flux.dtype))
+    if score_on:
+        out_specs.append(pl.BlockSpec((Lp, stride), lambda b, t: (b, 0)))
+        out_shape.append(sds((blocks * Lp, stride), bank.dtype))
+    inputs = [lo_p, hi_p, x, lelem, dest, eff_w,
+              done.astype(jnp.int32), exited.astype(jnp.int32)]
+    if score_on:
+        inputs += [sbin, sfac]
+    outs = pl.pallas_call(
+        kernel,
+        grid=(blocks, T),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+    s_o, lelem_o, done_o, exited_o, pending_o, iters = outs[:6]
+    i = 6
+    if tally:
+        fpart = outs[i]
+        i += 1
+    if score_on:
+        bpart = outs[i]
+    s_o, lelem_o = s_o[:n], lelem_o[:n]
+    done_o = done_o[:n] != 0
+    exited_o = exited_o[:n] != 0
+    pending_o = pending_o[:n]
+    # d0 was computed AFTER padding, so these slices are exactly the
+    # unpadded invariants (reconstructing x0 as dest - d0 would be off
+    # by an ulp — float subtraction does not invert addition).
+    dest, d0, x0 = dest[:n], d0[:n], x[:n]
+    if tally:
+        # Per-block accumulated partials: drop the row padding, flatten
+        # back to the [blocks*L] flux layout.
+        flux = flux + fpart.reshape(blocks, Lp)[:, :L].reshape(blocks * L)
+    if score_on:
+        bank = bank + bpart.reshape(blocks, Lp, stride)[:, :L, :].reshape(
+            blocks * L * stride
+        )
+    # Same materialization rule as walk_local.
+    x_fin = jnp.where(
+        (done_o & ~exited_o)[:, None], dest, x0 + s_o[:, None] * d0
+    )
+    out = (x_fin, lelem_o, done_o, exited_o, pending_o, flux,
+           jnp.max(iters))
+    return out + (bank,) if score_on else out

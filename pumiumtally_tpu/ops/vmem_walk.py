@@ -102,12 +102,15 @@ VMEM_FEASIBLE_MAX_ELEMS = 8192
 # at the binding w_tile=1024 the scoped stack is tile-driven (r5 law
 # above), so halved TABLE bytes should extend the feasible block
 # length ~2x. UNVERIFIED until the next chip window's AOT sweep
-# (tools/r6_onchip_suite.sh) — this kernel does not yet LOWER the
+# (tools/r13_onchip_suite.sh) — THIS kernel does not lower the
 # two-tier walk (bf16 lanes cannot hold adjacency ids, and a resident
-# f32 refinement operand would give back the saving), so the constant
-# exists for the armed experiment and the sub-split sizing math only;
-# engines route bf16 blocked walks through the gather kernel
-# (parallel/partition.py resolve_block_kernel).
+# f32 refinement operand would give back the saving); the two-tier
+# lowering lives in ops/pallas_walk.py (walk_kernel='pallas', round
+# 17), which streams both tiers through the grid pipeline under this
+# same 2x ceiling. With walk_kernel='vmem', engines route bf16
+# blocked walks through the gather kernel and LOG the reroute
+# (parallel/partition.py resolve_block_kernel) so the silent-fallback
+# era is over — the constant still sizes that sub-split.
 VMEM_FEASIBLE_MAX_ELEMS_BF16 = 2 * VMEM_FEASIBLE_MAX_ELEMS
 
 
@@ -150,10 +153,11 @@ def effective_vmem_bound(
     then rejects the configuration).
 
     ``table_dtype="bfloat16"`` applies the PROJECTED bf16 select-tier
-    ceiling (VMEM_FEASIBLE_MAX_ELEMS_BF16) — today that path never
-    reaches this kernel (engines reroute bf16 blocked walks to the
-    gather kernel), so the parameter arms the next chip window's AOT
-    sweep without a code change."""
+    ceiling (VMEM_FEASIBLE_MAX_ELEMS_BF16). That path never reaches
+    THIS kernel (engines reroute bf16 blocked walks to the gather
+    kernel, with a logged diagnostic), but it is the binding sub-split
+    bound for the pallas streaming kernel (ops/pallas_walk.py), whose
+    per-block resident operands obey the same scoped-stack law."""
     if bound is None:
         return None
     bound = int(bound)

@@ -318,20 +318,16 @@ def _lift_bf16(x, fdtype):
     return f if jnp.dtype(fdtype) == jnp.float32 else f.astype(fdtype)
 
 
-def select_faces_lo(table_lo, s, elem, dest, d0, tol, one):
-    """bf16 SELECT tier: candidate crossings of all four faces from the
-    half-width bf16 row, returning the per-face candidate minimum and
-    the winning face index. Shared by the replicated walk and the
-    partitioned ``walk_local`` so the selection semantics cannot drift
-    between engines. The candidate values are computed in the walk's
-    working dtype FROM bf16-rounded planes — the only precision lost is
-    the one-time storage rounding, so two candidates must tie within
-    ~bf16 epsilon before a wrong face can win (docs/PERF_NOTES.md tie
-    analysis)."""
-    fdtype = s.dtype
-    row = _lift_bf16(
-        table_lo[elem], fdtype  # [N,WALK_TABLE_LO_WIDTH] — the 32 B gather
-    )
+def select_rows_lo(row, s, dest, d0, tol, one):
+    """SELECT-tier math on already-fetched (and already-lifted) rows:
+    candidate crossings of all four faces from the half-width row,
+    returning the per-face candidate minimum and the winning face
+    index. Split out of ``select_faces_lo`` so the one-kernel Pallas
+    walk (ops/pallas_walk.py), whose row fetch is a one-hot matmul
+    against the streamed table block rather than a gather, runs the
+    IDENTICAL selection trace — since ``_lift_bf16`` is elementwise,
+    lift-then-fetch equals fetch-then-lift bitwise, and parity between
+    the kernels reduces to the fetch itself."""
     n = row.shape[0]
     fn = row[:, WALK_TABLE_LO_NORMALS].reshape(n, 4, 3)
     fo = row[:, WALK_TABLE_LO_OFFSETS]
@@ -355,6 +351,41 @@ def select_faces_lo(table_lo, s, elem, dest, d0, tol, one):
     return jnp.min(s_f, axis=1), jnp.argmin(s_f, axis=1)
 
 
+def select_faces_lo(table_lo, s, elem, dest, d0, tol, one):
+    """bf16 SELECT tier: candidate crossings of all four faces from the
+    half-width bf16 row, returning the per-face candidate minimum and
+    the winning face index. Shared by the replicated walk and the
+    partitioned ``walk_local`` so the selection semantics cannot drift
+    between engines. The candidate values are computed in the walk's
+    working dtype FROM bf16-rounded planes — the only precision lost is
+    the one-time storage rounding, so two candidates must tie within
+    ~bf16 epsilon before a wrong face can win (docs/PERF_NOTES.md tie
+    analysis)."""
+    fdtype = s.dtype
+    row = _lift_bf16(
+        table_lo[elem], fdtype  # [N,WALK_TABLE_LO_WIDTH] — the 32 B gather
+    )
+    return select_rows_lo(row, s, dest, d0, tol, one)
+
+
+def refine_plane_hi(plane, s, s_sel, dest, d0, tol, one):
+    """REFINEMENT-tier math on already-fetched winning-face planes
+    (``[N,WALK_PLANE_WIDTH]``). Split out of ``refine_face_hi`` for the
+    same reason as ``select_rows_lo``: the Pallas walk fetches the
+    plane through its streamed table block and must run the identical
+    refinement trace. Returns ``(s_exit, next_elem)``."""
+    nw = plane[:, 0:3]
+    aw = jnp.einsum("nc,nc->n", nw, d0)
+    bw = plane[:, 3] - jnp.einsum("nc,nc->n", nw, dest) + aw
+    genuine = aw * (one - s) > tol
+    s_ref = jnp.where(genuine, bw / jnp.where(genuine, aw, one), s_sel)
+    s_ref = jnp.maximum(s_ref, s)
+    # No bf16 candidate at all (s_sel = inf): destination inside the
+    # current tet — keep inf so the caller's reached test fires.
+    s_exit = jnp.where(jnp.isinf(s_sel), s_sel, s_ref)
+    return s_exit, plane[:, 4].astype(jnp.int32)
+
+
 def refine_face_hi(table_hi, s, elem, f_exit, s_sel, dest, d0, tol, one):
     """Full-precision REFINEMENT tier: ONE [WALK_PLANE_WIDTH]-row
     gather (20 B) of the WINNING face recomputes its crossing exactly —
@@ -369,16 +400,7 @@ def refine_face_hi(table_hi, s, elem, f_exit, s_sel, dest, d0, tol, one):
     walk would commit, and the max(s) clamp still forbids backward
     steps."""
     plane = table_hi[elem * 4 + f_exit]  # [N,WALK_PLANE_WIDTH]
-    nw = plane[:, 0:3]
-    aw = jnp.einsum("nc,nc->n", nw, d0)
-    bw = plane[:, 3] - jnp.einsum("nc,nc->n", nw, dest) + aw
-    genuine = aw * (one - s) > tol
-    s_ref = jnp.where(genuine, bw / jnp.where(genuine, aw, one), s_sel)
-    s_ref = jnp.maximum(s_ref, s)
-    # No bf16 candidate at all (s_sel = inf): destination inside the
-    # current tet — keep inf so the caller's reached test fires.
-    s_exit = jnp.where(jnp.isinf(s_sel), s_sel, s_ref)
-    return s_exit, plane[:, 4].astype(jnp.int32)
+    return refine_plane_hi(plane, s, s_sel, dest, d0, tol, one)
 
 
 def _advance_geometry(mesh, s, elem, dest, d0, tol, one, lo_select=False):

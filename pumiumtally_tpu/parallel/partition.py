@@ -177,14 +177,42 @@ def derive_blocks_per_chip(
 
 
 def resolve_block_kernel(block_kernel: str, table_dtype: str) -> str:
-    """The block kernel a two-tier partition actually runs: the vmem
-    one-hot kernel has no two-tier lowering yet (bf16 adjacency lanes
-    are impossible — 8 mantissa bits — and a resident f32 refinement
-    operand would give back the VMEM the select tier saved; see
-    ops/vmem_walk.py), so bf16 partitions route blocked walks through
-    the GATHER block kernel, whose resident-block benefit is exactly
-    what the half-width select tier doubles."""
+    """The block kernel a partition actually runs.
+
+    The vmem one-hot kernel has no two-tier lowering (bf16 adjacency
+    lanes are impossible — 8 mantissa bits — and a resident f32
+    refinement operand would give back the VMEM the select tier saved;
+    see ops/vmem_walk.py), so bf16 partitions route blocked walks
+    through the GATHER block kernel, whose resident-block benefit is
+    exactly what the half-width select tier doubles. Since round 17
+    that reroute is a LOGGED diagnostic, not a silent downgrade: the
+    two-tier one-kernel walk exists (``walk_kernel='pallas'``,
+    ops/pallas_walk.py) and is the intended destination for bf16
+    blocked configurations.
+
+    ``"pallas"`` is two-tier ONLY — its select fetch is a bf16 matmul
+    and its refinement operand is the per-face tier — so a float32
+    partition cannot run it; that mismatch is a configuration error,
+    not a reroute (TallyConfig validates the same pair earlier with
+    the config-level message; this guard catches engine-level callers
+    and prebuilt-partition overrides)."""
+    if block_kernel == "pallas":
+        if table_dtype != "bfloat16":
+            raise ValueError(
+                "block_kernel='pallas' needs the bf16 two-tier tables "
+                f"(got table_dtype={table_dtype!r}); build the "
+                "partition with table_dtype='bfloat16'"
+            )
+        return block_kernel
     if table_dtype == "bfloat16" and block_kernel == "vmem":
+        from pumiumtally_tpu.utils.logging import get_logger
+
+        get_logger().info(
+            "bfloat16 tables with block_kernel='vmem': the vmem "
+            "kernel has no two-tier lowering — rerouting blocked "
+            "walks to the gather kernel (set walk_kernel='pallas' "
+            "for the two-tier one-kernel walk, ops/pallas_walk.py)"
+        )
         return "gather"
     return block_kernel
 
@@ -1205,10 +1233,10 @@ class PartitionedEngine:
         # The gather block kernel has no Mosaic scoped-VMEM stack, so
         # its block size is not clamped (the measured sweet spot is
         # L<=~3k, above the vmem ceiling — docs/PERF_NOTES.md round 4).
-        if block_kernel not in ("vmem", "gather"):
+        if block_kernel not in ("vmem", "gather", "pallas"):
             raise ValueError(
-                f"block_kernel must be 'vmem' or 'gather', got "
-                f"{block_kernel!r}"
+                f"block_kernel must be 'vmem', 'gather' or 'pallas', "
+                f"got {block_kernel!r}"
             )
         if partition_method not in PARTITION_METHODS:
             raise ValueError(
@@ -1225,8 +1253,10 @@ class PartitionedEngine:
         self.table_dtype = table_dtype
         block_kernel = resolve_block_kernel(block_kernel, table_dtype)
         if scoring is not None and block_kernel == "vmem":
-            # No scoring lowering in the one-hot Pallas kernel — same
-            # reroute as the bf16 tier (resolve_block_kernel).
+            # No scoring lowering in the f32 one-hot kernel — same
+            # reroute as the bf16 tier (resolve_block_kernel). The
+            # two-tier pallas kernel DOES lower scoring lanes
+            # (ops/pallas_walk.py), so it is not rerouted here.
             block_kernel = "gather"
         self.block_kernel = block_kernel
         self.scoring = scoring
@@ -1238,6 +1268,16 @@ class PartitionedEngine:
             from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
 
             vmem_walk_max_elems = effective_vmem_bound(vmem_walk_max_elems)
+        elif block_kernel == "pallas":
+            # The pallas kernel's resident table block is the bf16
+            # select tier: clamp through the projected bf16 ceiling
+            # (the streamed refinement operand rides the same scoped
+            # stack — re-measured by the next chip window's AOT sweep).
+            from pumiumtally_tpu.ops.vmem_walk import effective_vmem_bound
+
+            vmem_walk_max_elems = effective_vmem_bound(
+                vmem_walk_max_elems, "bfloat16"
+            )
         if part is not None:
             self.part = part
             nparts = self.part.ndev  # build_partition's part count
@@ -1258,7 +1298,7 @@ class PartitionedEngine:
         self.two_tier = self.part.table_hi is not None
         self.blocks_per_chip = nparts // self.ndev
         cap_b = int(-(-self.n // nparts) * capacity_factor + 1)
-        if self.blocks_per_chip > 1 and block_kernel == "vmem":
+        if self.blocks_per_chip > 1 and block_kernel in ("vmem", "pallas"):
             # The blocked vmem kernel tiles each block's slot group:
             # round the per-block capacity up to whole tiles. The
             # gather block kernel only needs cap divisible by blocks
@@ -1336,9 +1376,23 @@ class PartitionedEngine:
             and not self.two_tier
             and scoring is None
         )
-        if self.blocks_per_chip > 1 and not self.use_vmem_walk and (
-            block_kernel != "gather"
-        ):
+        # The two-tier one-kernel walk (ops/pallas_walk.py): always-on
+        # once selected — blocks=1 runs the whole chip partition as one
+        # resident block, blocks>1 streams the sub-split block tables
+        # through the grid pipeline (no L-ceiling gate; the bound above
+        # only SIZES the blocks). Adjacency rides the refinement tier,
+        # so the int sidecar has nothing to feed the kernel.
+        if block_kernel == "pallas" and self.part.adj_int is not None:
+            raise ValueError(
+                "block_kernel='pallas' needs row-resident adjacency "
+                "(the refinement tier's adj lane), but this partition "
+                "carries the int-adjacency sidecar — rebuild without "
+                "force_split_adj or use walk_kernel='gather'"
+            )
+        self.use_pallas_walk = block_kernel == "pallas"
+        if self.blocks_per_chip > 1 and not (
+            self.use_vmem_walk or self.use_pallas_walk
+        ) and block_kernel != "gather":
             raise ValueError(
                 "sub-split partitions (blocks_per_chip > 1) with "
                 "block_kernel='vmem' need the VMEM walk, but this "
@@ -1714,6 +1768,7 @@ class PartitionedEngine:
         stride = self.score_stride
 
         use_vmem = self.use_vmem_walk
+        use_pallas = self.use_pallas_walk
 
         def round_kernel(table, *rest):
             rest = list(rest)
@@ -1725,7 +1780,33 @@ class PartitionedEngine:
             else:
                 x, lelem, dest, fly, w, done, exited, flux, n_act = rest
                 sbin = sfac = bank = None
-            if use_vmem:
+            if use_pallas:
+                # One-kernel two-tier walk: select/refine/scatter fused
+                # per particle tile, block tables streamed by the grid
+                # pipeline (ops/pallas_walk.py). Same layout contract
+                # as the vmem sub-split; scoring lanes lower in-kernel.
+                from pumiumtally_tpu.ops.pallas_walk import (
+                    pallas_walk_local,
+                )
+
+                sc = (
+                    ScoreOps(s_kinds, bank, sbin, sfac) if score_on
+                    else None
+                )
+                res = pallas_walk_local(
+                    table, hi, x, lelem, dest, fly, w, done, exited,
+                    flux, tally=tally, tol=tol, max_iters=max_iters,
+                    blocks=blocks, scoring=sc,
+                )
+                x, lelem, done, exited, pending, flux = res[:6]
+                if score_on:
+                    bank = res[7]
+                # The Pallas kernel sweeps every block unconditionally.
+                n_disp = jnp.sum(jnp.zeros_like(lelem)) + blocks
+                n_act = jnp.sum(
+                    (~done).reshape(blocks, -1), axis=1, dtype=jnp.int32
+                )
+            elif use_vmem:
                 from pumiumtally_tpu.ops.vmem_walk import vmem_walk_local
 
                 x, lelem, done, exited, pending, flux, _ = vmem_walk_local(
@@ -1917,7 +1998,7 @@ class PartitionedEngine:
             mesh=self.device_mesh,
             in_specs=(pp,) * n_in,
             out_specs=(pp,) * n_out_pp + (P(), P(), P()),
-            **shard_map_check_kwargs(not use_vmem),
+            **shard_map_check_kwargs(not (use_vmem or use_pallas)),
         )
 
     def _phase_key(self, kind: str, tally: bool, variant: tuple = ()
@@ -1933,7 +2014,8 @@ class PartitionedEngine:
         forced-full-migrate)."""
         return (kind, tally, self.cap_per_chip, self.max_rounds,
                 self.max_iters, self.tol, self.cond_every,
-                self.min_window, self.use_vmem_walk, self.blocks_per_chip,
+                self.min_window, self.use_vmem_walk, self.use_pallas_walk,
+                self.blocks_per_chip,
                 self.partition_method, self.cap_frontier,
                 self.migrate_collective, id(self.part),
                 None if self.scoring is None else self.scoring.static_key(),
@@ -2437,7 +2519,9 @@ class PartitionedEngine:
         jit-cache keys carry ``cap_per_chip``)."""
         old_cb = self.cap_per_block
         new_cb = int(old_cb * float(factor)) + 1
-        if self.blocks_per_chip > 1 and self.block_kernel == "vmem":
+        if self.blocks_per_chip > 1 and self.block_kernel in (
+            "vmem", "pallas"
+        ):
             from pumiumtally_tpu.ops.vmem_walk import W_TILE_DEFAULT
 
             new_cb = -(-new_cb // W_TILE_DEFAULT) * W_TILE_DEFAULT

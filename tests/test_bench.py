@@ -372,6 +372,37 @@ def test_distributed_row(bench):
     assert res["compiles"]["timed"] == 0
 
 
+def test_pallas_walk_row(bench):
+    """The one-kernel Pallas walk component row (r17): schema keys
+    present, the tool's gates ran (interpret-mode bitwise pin vs
+    walk_local, bitwise positions/elem_ids between the timed arms —
+    it exits hard otherwise), the pallas arm really streamed
+    (blocks > 1), the 80 B vs 52 B modeled bytes provenance, and the
+    compiles-healthy contract — ``compiles.timed == 0``: the pallas
+    round program is one phase-program variant, compiled in warmup."""
+    res = bench.run_pallas_walk_ab()
+    for key in ("gather_moves_per_sec", "pallas_moves_per_sec",
+                "speedup", "fenced_gather_ms_per_move",
+                "fenced_pallas_ms_per_move", "interpret_parity",
+                "blocks_per_chip", "modeled_bytes_per_crossing",
+                "compiles", "workload"):
+        assert key in res, key
+    assert res["interpret_parity"]["bitwise"] is True
+    assert res["interpret_parity"]["pauses"] > 0
+    assert res["interpret_parity"]["exits"] > 0
+    assert res["gather_moves_per_sec"] > 0
+    assert res["pallas_moves_per_sec"] > 0
+    assert res["fenced_gather_ms_per_move"] > 0
+    assert res["fenced_pallas_ms_per_move"] > 0
+    assert res["blocks_per_chip"] > 1  # the streaming regime
+    mb = res["modeled_bytes_per_crossing"]
+    assert mb["gather_f32"] == 80
+    assert mb["gather_bf16"] == mb["pallas_bf16"] == 52
+    assert res["compiles"]["timed"] == 0
+    # On this suite's CPU backend the pallas arm is interpret-mode.
+    assert res["pallas_interpret_mode"] is (res["backend"] != "tpu")
+
+
 def test_frontier_ab_row(bench):
     """The frontier-migrate component row: both front sizes present,
     positive timings for both arms, and the tool's slab-invariance

@@ -681,3 +681,27 @@ def test_distributed_subsystem_registered_and_pragma_free():
     # would silently drop its CI coverage).
     with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
         assert "tools/exp_distributed_ab.py" in fh.read()
+
+
+def test_pallas_walk_kernel_registered_and_pragma_free():
+    """The one-kernel Pallas walk (r17) must be IN the self-check's
+    file set (ops/ is inside the package tree the self-check lints)
+    and hold the strongest form of the clean contract: zero violations
+    with zero pragmas — the kernel body is a grid-pipelined pallas_call
+    whose while-loop state lives in output refs, with no host syncs
+    reachable from the trace. The bench-consumed A/B tool is covered
+    the same way (it is in tools/lint_all.py's jaxlint targets)."""
+    from pumiumtally_tpu.analysis import lint_paths
+
+    kern = os.path.join(REPO, "pumiumtally_tpu", "ops", "pallas_walk.py")
+    ab = os.path.join(REPO, "tools", "exp_pallas_walk_ab.py")
+    assert lint_paths([kern, ab]) == []
+    for f in (kern, ab):
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the pallas walk ships pragma-free"
+            )
+    # tools/lint_all.py actually targets the A/B tool (a slip here
+    # would silently drop its CI coverage).
+    with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
+        assert "tools/exp_pallas_walk_ab.py" in fh.read()

@@ -1,0 +1,278 @@
+"""Pallas one-kernel walk A/B: bf16 gather sub-split vs the fused
+streaming kernel (round 17 — bench.py's "pallas_walk" row consumes the
+JSON line).
+
+Two layers over the IDENTICAL seeded partitioned workload:
+
+- Parity first (the gate numbers are meaningless without): a
+  kernel-level INTERPRET-mode pin — ``pallas_walk_local`` run with
+  ``interpret=True`` against ``walk_local``'s two-tier path on a mixed
+  pause/exit/hold workload, positions/elements/pending BITWISE, flux in
+  the documented reassociation class. Interpret mode executes the exact
+  kernel arithmetic on CPU, so this gate is backend-independent and
+  runs before any rate is reported (sys.exit(1) on violation).
+
+- Rates: both ENGINES (``walk_kernel='gather'`` vs ``'pallas'``, both
+  on the bf16 two-tier tables, both forced into the blocked regime by
+  ``walk_vmem_max_elems`` so the pallas arm actually STREAMS) at bench
+  shape, timed passes INTERLEAVED between arms (PERF_NOTES r5
+  measurement note), median per arm, plus FENCED per-move ms and the
+  compiles-healthy contract — ``compiles.timed == 0``: the pallas round
+  program is one phase-program variant, compiled in warmup, never in a
+  measured window. Cross-arm flux agreement and the conservation gate
+  are enforced on the timed arms too.
+
+- Bytes provenance: ``modeled_walk_bytes`` — the 80 B/crossing f32
+  gather model vs the 52 B two-tier model both arms share (the pallas
+  arm approaches it as sequential block DMA instead of random row
+  gathers; the A/B exists to measure whether that matters on chip).
+
+On CPU the pallas arm runs in pallas INTERPRET mode — a correctness
+vehicle, not a rate (expect a large slowdown; the recorded CPU
+"speedup" is NOT the ship/kill number). The ship/kill rule for the
+on-chip decision lives in docs/PERF_NOTES.md "One-kernel walk": SHIP
+at >= 1.3x blocked-walk rate on chip, KILL below 1.05x.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/exp_pallas_walk_ab.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N = int(os.environ.get("PUMIUMTALLY_AB_N", 16_384))
+DIV = int(os.environ.get("PUMIUMTALLY_AB_DIV", 8))  # 8^3 cells = 3072 tets
+MOVES = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
+TRIALS = int(os.environ.get("PUMIUMTALLY_AB_TRIALS", 2))
+BLOCK_ELEMS = int(os.environ.get("PUMIUMTALLY_AB_BLOCK_ELEMS", 1024))
+CONSERVATION_RTOL = 1e-6
+# Flux between the arms differs only in accumulation order (per-tile
+# matmul partials vs cascaded scatter-adds): a few f32 ulps per bin,
+# compounding to ~1e-6 of the peak bin over a multi-pass campaign.
+# 5e-6 holds that class with margin while still catching any real
+# corruption (a wrong crossing shifts whole track segments, 1e-2+).
+CROSS_ARM_RTOL = 5e-6
+
+
+def _interpret_parity_gate() -> dict:
+    """The kernel-level interpret-mode pin (module docstring). Returns
+    the gate's evidence record; raises SystemExit on violation."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.ops.pallas_walk import pallas_walk_local
+    from pumiumtally_tpu.parallel.partition import (
+        build_partition,
+        walk_local,
+    )
+
+    # The mixed pause/exit/hold/dead workload the parity pin needs —
+    # mirrors tests/test_pallas_walk.py's _chip_workload.
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    part = build_partition(mesh, 4, table_dtype="bfloat16")
+    rng = np.random.default_rng(17)
+    chip, n = 1, 1500
+    table = part.table[chip * part.L: (chip + 1) * part.L]
+    hi = part.table_hi[chip * part.L * 4: (chip + 1) * part.L * 4]
+    orig = np.asarray(part.orig_of_glid).reshape(4, part.L)[chip]
+    owned = np.flatnonzero(orig >= 0)
+    lelem = rng.choice(owned, size=n).astype(np.int32)
+    coords = np.asarray(mesh.coords)
+    tets = np.asarray(mesh.tet2vert)
+    cent = coords[tets[orig[lelem]]].mean(axis=1)
+    fly = (rng.random(n) > 0.15).astype(np.int8)
+    dest = np.where(fly[:, None] == 1,
+                    cent + rng.normal(scale=0.25, size=(n, 3)), cent)
+    args = (jnp.asarray(cent), jnp.asarray(lelem), jnp.asarray(dest),
+            jnp.asarray(fly), jnp.asarray(rng.uniform(0.5, 2.0, n)),
+            jnp.asarray(rng.random(n) < 0.1), jnp.zeros(n, bool),
+            jnp.zeros((part.L,), jnp.float32))
+    kw = dict(tally=True, tol=1e-8, max_iters=4096)
+    ref = walk_local(table, *args, table_hi=hi, **kw)
+    out = pallas_walk_local(table, hi, *args, interpret=True, **kw)
+    names = ("x", "lelem", "done", "exited", "pending")
+    for name, a, b in zip(names, out[:5], ref[:5]):
+        if not bool(jnp.all(a == b)):
+            print(f"# FATAL: interpret parity gate — {name} not bitwise "
+                  "vs walk_local", file=sys.stderr)
+            sys.exit(1)
+    flux_rel = float(
+        jnp.max(jnp.abs(out[5] - ref[5])
+                / jnp.maximum(jnp.abs(ref[5]), 1e-30))
+    )
+    if flux_rel > 1e-6:
+        print(f"# FATAL: interpret parity gate — flux divergence "
+              f"{flux_rel:.2e} outside the reassociation class",
+              file=sys.stderr)
+        sys.exit(1)
+    pauses = int(jnp.sum(out[4] >= 0))
+    exits = int(jnp.sum(out[3]))
+    if pauses == 0 or exits == 0:
+        print("# FATAL: interpret parity workload exercised no "
+              "pauses/exits — the gate proves nothing", file=sys.stderr)
+        sys.exit(1)
+    return {"bitwise": True, "flux_max_rel": flux_rel,
+            "pauses": pauses, "exits": exits, "particles": n}
+
+
+def run_ab(
+    n: int = N, div: int = DIV, moves: int = MOVES, trials: int = TRIALS,
+    block_elems: int = BLOCK_ELEMS,
+) -> dict:
+    """Measure both engine arms; return the summary record (module
+    docstring). Raises SystemExit on any gate failure — a silently
+    corrupted arm must not report a rate."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.ops.pallas_walk import modeled_walk_bytes
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    gate = _interpret_parity_gate()
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    rng = np.random.default_rng(29)
+    src = rng.uniform(0.05, 0.95, (n, 3))
+    dests = [
+        np.clip(src + rng.normal(scale=0.2, size=(n, 3)), 0.02, 0.98)
+    ]
+    for _ in range(moves - 1):
+        dests.append(np.clip(
+            dests[-1] + rng.normal(scale=0.2, size=(n, 3)), 0.02, 0.98
+        ))
+
+    def build(kernel):
+        return PartitionedPumiTally(mesh, n, TallyConfig(
+            walk_table_dtype="bfloat16", walk_kernel=kernel,
+            walk_vmem_max_elems=block_elems, capacity_factor=3.0,
+            check_found_all=False,
+        ))
+
+    def drive(t):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        for d in dests:
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+        jax.block_until_ready(t.flux)
+
+    def fenced_ms(t):
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        jax.block_until_ready(t.flux)
+        total = 0.0
+        for d in dests:
+            t0 = time.perf_counter()
+            t.MoveToNextLocation(None, d.reshape(-1).copy())
+            jax.block_until_ready(t.flux)
+            total += time.perf_counter() - t0
+        return total / len(dests) * 1e3
+
+    # Warmup: TWO passes per arm — the second pass compiles one more
+    # cascade-phase variant (re-sourcing on a warm engine), and the
+    # timed window must see none.
+    t_gather = build("gather")
+    drive(t_gather)
+    drive(t_gather)
+    with retrace_guard(raise_on_exceed=False) as guard:
+        t_pallas = build("pallas")
+        drive(t_pallas)
+        drive(t_pallas)
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            times = {"gather": [], "pallas": []}
+            for _ in range(trials):
+                for k, t in (("gather", t_gather), ("pallas", t_pallas)):
+                    t0 = time.perf_counter()
+                    drive(t)
+                    times[k].append(time.perf_counter() - t0)
+    assert t_pallas.engine.use_pallas_walk
+    assert t_pallas.engine.blocks_per_chip > 1  # really streaming
+
+    # Cross-arm gates on the timed arms: positions/elements BITWISE
+    # (the kernel seam's own pin), flux in the reassociation class.
+    if not bool(jnp.all(jnp.asarray(t_pallas.positions)
+                        == jnp.asarray(t_gather.positions))):
+        print("# FATAL: pallas arm positions not bitwise vs gather arm",
+              file=sys.stderr)
+        sys.exit(1)
+    if not bool(jnp.all(jnp.asarray(t_pallas.elem_ids)
+                        == jnp.asarray(t_gather.elem_ids))):
+        print("# FATAL: pallas arm elem_ids not bitwise vs gather arm",
+              file=sys.stderr)
+        sys.exit(1)
+    f_g = np.asarray(t_gather.flux, np.float64)
+    f_p = np.asarray(t_pallas.flux, np.float64)
+    rel = float(np.abs(f_p - f_g).max()
+                / max(np.abs(f_g).max(), 1e-30))
+    if rel > CROSS_ARM_RTOL:
+        print(f"# FATAL: cross-arm flux divergence {rel:.2e}",
+              file=sys.stderr)
+        sys.exit(1)
+    expect = float(sum(
+        np.linalg.norm(
+            np.asarray(b, np.float64) - np.asarray(a, np.float64), axis=1
+        ).sum()
+        for a, b in zip([src] + dests[:-1], dests)
+    ))
+    for k, f in (("gather", f_g), ("pallas", f_p)):
+        # Each drive (2 warmups + the timed trials) re-sources and
+        # re-walks the same campaign, accumulating into one flux.
+        per_pass = f.sum() / (2 + trials)
+        crel = abs(per_pass - expect) / expect
+        if crel > CONSERVATION_RTOL:
+            print(f"# FATAL: {k} arm conservation off by {crel:.2e}",
+                  file=sys.stderr)
+            sys.exit(1)
+
+    rate = {k: n * moves / float(np.median(ts))
+            for k, ts in times.items()}
+    return {
+        "row": "pallas_walk",
+        "gather_moves_per_sec": rate["gather"],
+        "pallas_moves_per_sec": rate["pallas"],
+        "speedup": rate["pallas"] / rate["gather"],
+        "fenced_gather_ms_per_move": fenced_ms(t_gather),
+        "fenced_pallas_ms_per_move": fenced_ms(t_pallas),
+        "interpret_parity": gate,
+        "backend": jax.default_backend(),
+        "pallas_interpret_mode": jax.default_backend() not in (
+            "tpu", "axon"
+        ),
+        "blocks_per_chip": int(t_pallas.engine.blocks_per_chip),
+        "modeled_bytes_per_crossing": {
+            "gather_f32": modeled_walk_bytes("gather"),
+            "gather_bf16": modeled_walk_bytes("gather", "bfloat16"),
+            "pallas_bf16": modeled_walk_bytes("pallas", "bfloat16"),
+            "vmem_resident": modeled_walk_bytes("vmem"),
+        },
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles": n, "mesh_tets": 6 * div ** 3, "moves": moves,
+            "trials": trials, "block_elems": block_elems,
+        },
+    }
+
+
+def main() -> None:
+    if "--quick" in sys.argv:
+        rec = run_ab(n=4096, div=6, moves=2, trials=1, block_elems=512)
+    else:
+        rec = run_ab()
+    print(json.dumps(rec, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/bin/bash
+# r13 on-chip suite (PR 17 — the one-kernel Pallas walk round; suites
+# number by PR-line like r8-r12 before it).
+# Fired by a probe loop (tools/r5_probe_loop.sh pattern) the moment
+# the TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK headline
+# bench first (a short window must still yield a fresh cached
+# measurement), then the full bench (whose row set now includes the
+# PALLAS_WALK component row in interpret mode), then THIS round's
+# measurement —
+#   pallas_walk_ab: the fused select/refine/scatter kernel with
+#     grid-pipelined table streaming (walk_kernel='pallas',
+#     ops/pallas_walk.py) vs the bf16 gather sub-split at campaign
+#     shape, both arms in the blocked regime. On a TPU backend the
+#     pallas arm Mosaic-compiles (interpret only on CPU), so THIS
+#     stage produces the round-17 decision number; the tool's gates
+#     (interpret-mode bitwise pin, bitwise positions between arms,
+#     conservation, compiles.timed == 0) all still apply. Ship/kill
+#     rule (docs/PERF_NOTES.md "One-kernel walk"): SHIP
+#     walk_kernel='pallas' as the blocked bf16 default if the pallas
+#     arm >= 1.3x the gather sub-split walk rate on-chip (the 52 B
+#     streaming model says the headroom is there), KILL (keep the
+#     knob opt-in) below 1.05x.
+# then the inherited subsystem A/Bs and engine experiments; chipless
+# AOT compiles go last (the remote compile helper remains the prime
+# wedge suspect — and the new pallas AOT harness carries its own
+# SIGALRM deadlines, so a dead topology client reports SKIP instead
+# of wedging the suite).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r13_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r13 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|SKIP|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_SCORING=0 PUMIUMTALLY_BENCH_RESILIENCE=0 PUMIUMTALLY_BENCH_SENTINEL=0 PUMIUMTALLY_BENCH_SERVICE=0 PUMIUMTALLY_BENCH_SERVICE_FUSION=0 PUMIUMTALLY_BENCH_DISTRIBUTED=0 PUMIUMTALLY_BENCH_PALLAS_WALK=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-17 measurement: the one-kernel streamed walk vs the bf16
+# gather sub-split at campaign shape, Mosaic-compiled on the chip.
+# Decides the ship/kill rule in the header.
+run pallas_walk_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_TRIALS=3 PUMIUMTALLY_AB_BLOCK_ELEMS=8192 python tools/exp_pallas_walk_ab.py
+# The round-13..16 re-measures, unchanged shapes so rounds compare
+# like-for-like.
+run distributed_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_distributed_ab.py
+run fusion_ab 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=1,4,8,16 PUMIUMTALLY_AB_TRIALS=3 python tools/exp_fusion_ab.py
+run service_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_service_ab.py
+# Inherited subsystem A/Bs (r7-r10 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run scoring_ab  1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=6 python tools/exp_scoring_ab.py
+run sentinel_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_sentinel_ab.py
+run resilience_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_resilience_ab.py
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects). The pallas
+# harness self-limits with SIGALRM deadlines — SKIP, never a wedge.
+run aot_pallas  1200 python tools/aot_pallas_walk_compile.py
+run aot_pallas_blocked 1200 python tools/aot_pallas_walk_compile.py 4096 1024 2048 6 2
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
