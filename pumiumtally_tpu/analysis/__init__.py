@@ -1,21 +1,48 @@
-"""jaxlint — a JAX-aware trace-safety analyzer for the tally engine.
+"""jaxlint — a JAX-aware static analyzer for the tally engine.
 
 ruff and clang-tidy (.github/workflows/static-analysis.yml) are the
-generic correctness backstop; this package is the JAX-specific one: it
-understands where the TRACE BOUNDARY lies (``jax.jit`` /
-``lax.while_loop`` / ``lax.scan`` / ``shard_map`` / ``pallas_call``
-bodies) and flags the failure modes that actually bite a JAX/TPU
-codebase — hidden host synchronization in the hot loops (JL001),
-Python control flow on traced arrays (JL002), donated-buffer reuse
-(JL003), retrace-bait static arguments (JL004), and module-state
-mutation under trace (JL005). Pure stdlib: no jax import, no code
-execution — safe for CI.
+generic correctness backstop; this package is the JAX-specific one,
+organised as four passes over one shared parse + module index:
+
+* **Trace safety (JL000–JL005)** — understands where the TRACE
+  BOUNDARY lies (``jax.jit`` / ``lax.while_loop`` / ``lax.scan`` /
+  ``shard_map`` / ``pallas_call`` bodies) and flags hidden host
+  synchronization in hot loops (JL001), Python control flow on traced
+  arrays (JL002), donated-buffer reuse (JL003), retrace-bait static
+  arguments (JL004), and module-state mutation under trace (JL005).
+* **Collective safety (JL101–JL104)** — axis names used inside
+  ``shard_map`` bodies must appear in the mesh/axis-spec (JL101),
+  statically enumerable ``ppermute`` perms must be total permutations
+  (JL102), per-shard scalars returned un-psum'd from collective bodies
+  (JL103), and ``lax.cond``/``while_loop`` predicates derived from
+  shard-local values around collectives — divergent-control deadlock
+  bait (JL104).
+* **Pallas kernels (JL201–JL204)** — BlockSpec working sets bounded
+  against the ``ops/vmem_walk.py`` VMEM feasibility model (JL201),
+  ref discipline: no input-ref writes or output-ref reads-before-write
+  (JL202), grid/block divisibility (JL203), and host calls in kernel
+  bodies (JL204).
+* **Host concurrency (JL301–JL303)** — for the ``service/`` and
+  ``resilience/`` layers: shared state written from multiple thread
+  entry points without a recognized lock (JL301), lock-ordering cycles
+  (JL302), and blocking calls while holding a lock (JL303). Thread
+  entry points come from the ``THREAD_ROOTS`` registry in
+  ``analysis/concurrency.py``.
+
+Pure stdlib: no jax import, no code execution — safe for CI.
 
 Usage::
 
     python -m pumiumtally_tpu.analysis pumiumtally_tpu/   # lint a tree
-    python -m pumiumtally_tpu.analysis --explain JL001    # rule docs
+    python -m pumiumtally_tpu.analysis --format json ...  # machine use
+    python -m pumiumtally_tpu.analysis --contracts        # facade audit
+    python -m pumiumtally_tpu.analysis --explain JL101    # rule docs
     python tools/jaxlint.py ...                           # same CLI
+
+``--contracts`` audits the five tally facades (monolithic, sharded,
+streaming, partitioned, streaming_partitioned) against the shared hook
+surface — batch-close, move-end, checkpoint rows, lane-bank registry,
+fusion-key — and prints the drift table referenced by ROADMAP item 5.
 
 Suppression (justification REQUIRED — see docs/STATIC_ANALYSIS.md)::
 
@@ -27,6 +54,7 @@ analysis cannot (cache-key instability observable only at run time) —
 is ``pumiumtally_tpu.utils.profiling.retrace_guard``.
 """
 
+from pumiumtally_tpu.analysis.contracts import audit_contracts
 from pumiumtally_tpu.analysis.core import (
     Analyzer,
     Diagnostic,
@@ -41,6 +69,7 @@ __all__ = [
     "Diagnostic",
     "RULES",
     "Rule",
+    "audit_contracts",
     "iter_python_files",
     "lint_paths",
     "lint_source",

@@ -7,6 +7,7 @@ contract as ruff, so CI can run them side by side.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -17,8 +18,9 @@ from pumiumtally_tpu.analysis.rules import RULES
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pumiumtally_tpu.analysis",
-        description="jaxlint: JAX-aware trace-safety analyzer "
-        "(rules JL001-JL005; docs/STATIC_ANALYSIS.md)",
+        description="jaxlint: JAX-aware static analyzer (trace safety "
+        "JL00x, collective safety JL1xx, Pallas kernels JL2xx, host "
+        "concurrency JL3xx; docs/STATIC_ANALYSIS.md)",
     )
     ap.add_argument(
         "paths", nargs="*", default=["pumiumtally_tpu"],
@@ -31,6 +33,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true",
         help="list rule ids and summaries and exit",
+    )
+    ap.add_argument(
+        "--contracts", action="store_true",
+        help="audit the five tally facades against the shared hook "
+        "surface instead of linting (exit 1 on a missing hook)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json: stable machine-readable schema)",
     )
     args = ap.parse_args(argv)
 
@@ -46,6 +57,18 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         print(f"{rule.id}: {rule.summary}\n\n{rule.doc}")
         return 0
+    if args.contracts:
+        # Lazy import: the auditor is independent of the lint pipeline.
+        from pumiumtally_tpu.analysis.contracts import (
+            audit_contracts,
+            render_json,
+            render_text,
+        )
+
+        report, code = audit_contracts()
+        render = render_json if args.format == "json" else render_text
+        print(render(report))
+        return code
 
     # A typo'd path must not read as "clean" (ruff's contract too):
     # every argument has to resolve to something lintable.
@@ -55,8 +78,21 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
     diags = lint_paths(args.paths)
-    for d in diags:
-        print(d.render())
+    if args.format == "json":
+        # Stable schema, pinned in tests/test_jaxlint.py: a JSON array
+        # of {path, line, rule, message} objects, sorted like the text
+        # output.  Always an array, even when clean.
+        print(json.dumps(
+            [
+                {"path": d.path, "line": d.line, "rule": d.rule,
+                 "message": d.message}
+                for d in diags
+            ],
+            indent=2,
+        ))
+    else:
+        for d in diags:
+            print(d.render())
     if diags:
         print(f"jaxlint: {len(diags)} issue(s) found", file=sys.stderr)
         return 1

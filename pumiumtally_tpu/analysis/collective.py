@@ -1,0 +1,640 @@
+"""jaxlint collective-safety pass: rules JL101-JL104 (pure stdlib).
+
+The shard_map programs (``parallel/sharded.py`` scaffolds, the PR 12
+collective migrate, the partitioned round programs) fail in ways the
+trace-safety rules cannot see: an axis name that is not in the mesh
+spec (JL101), a ``ppermute`` whose pair list is not a bijection
+(JL102), a per-shard partial total escaping through a replicated
+out_spec (JL103), and — the one that deadlocks real hardware rather
+than erroring — a collective guarded by shard-local control flow
+(JL104).
+
+Everything here is best-effort STATIC reasoning with a hard
+no-false-positive bias: a check only fires when the relevant operand
+(axis name, permutation list, out_spec, predicate) is statically
+enumerable; the engine's own runtime-parameterized idioms
+(``axis_name(mesh)`` variables, ``[(i, (i+1) % n)]`` comprehension
+rings, spec tuples built by concatenation) are skipped, not guessed
+at. See docs/STATIC_ANALYSIS.md for the per-rule contracts.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from pumiumtally_tpu.analysis.core import Diagnostic, _ModuleIndex
+
+# lax collectives -> positional index of their axis-name argument.
+_COLLECTIVES: dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "pbroadcast": 1, "pshuffle": 1, "pvary": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+# Collectives whose RESULT is globally combined/replicated — they
+# clear per-shard-reduction taint (JL103) and replicate predicates
+# (JL104).
+_REPLICATING = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all",
+}
+# jnp reductions that collapse the shard-LOCAL block (JL103 sources);
+# also recognized as methods (``x.sum()``).
+_REDUCTIONS = {
+    "sum", "mean", "max", "min", "prod", "any", "all", "count_nonzero",
+}
+
+
+def _is_lax_collective(index: _ModuleIndex, call: ast.Call) -> Optional[str]:
+    """The collective's short name if ``call`` is a ``jax.lax``
+    collective, else None."""
+    d = index.dotted(call.func)
+    if not d:
+        return None
+    leaf = d.split(".")[-1]
+    if leaf in _COLLECTIVES and (
+        d.startswith("jax.lax.") or d.startswith("jax.")
+    ):
+        return leaf
+    return None
+
+
+def _axis_literals(call: ast.Call, leaf: str) -> Optional[tuple[str, ...]]:
+    """Literal axis name(s) of a collective call, or None when the
+    axis operand is not statically a string (a variable, an
+    ``axis_name(mesh)`` result, ...)."""
+    pos = _COLLECTIVES[leaf]
+    node: Optional[ast.AST] = None
+    if pos < len(call.args):
+        node = call.args[pos]
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis_names"):
+            node = kw.value
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _is_partition_spec(index: _ModuleIndex, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = index.dotted(node.func)
+    return bool(d) and d.split(".")[-1] in ("PartitionSpec", "P")
+
+
+def _spec_axes(node: ast.Call) -> Optional[set[str]]:
+    """Literal axis names of one P(...) call; None when any operand is
+    non-literal (the declared set would be incomplete)."""
+    axes: set[str] = set()
+    for a in node.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            axes.add(a.value)
+        elif isinstance(a, ast.Constant) and a.value is None:
+            continue
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            for e in a.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, str
+                ):
+                    axes.add(e.value)
+                else:
+                    return None
+        else:
+            return None
+    return axes
+
+
+@dataclasses.dataclass
+class _Site:
+    """One statically-discovered shard_map application."""
+
+    line: int
+    body: Optional[ast.AST]  # FunctionDef / Lambda, when resolvable
+    owner: Optional[ast.AST]  # scope the site appears in
+    in_specs: Optional[ast.AST]
+    out_specs: Optional[ast.AST]
+    declared_axes: Optional[set[str]]  # None = not statically known
+
+
+def _collect_declared_axes(
+    index: _ModuleIndex,
+    mesh: Optional[ast.AST],
+    in_specs: Optional[ast.AST],
+    out_specs: Optional[ast.AST],
+) -> Optional[set[str]]:
+    """Union of literal axis names across the mesh/in_specs/out_specs
+    expressions, or None when the declared set cannot be COMPLETE:
+    any spec container holding a non-literal element (a ``pp = P(ax)``
+    variable, a concatenated tuple, a dict comprehension) makes the
+    bound unknowable, and JL101 must not guess."""
+    axes: set[str] = set()
+    found = False
+
+    def take_spec(node: ast.AST) -> bool:
+        """Fold one spec expression; False = not fully literal."""
+        nonlocal axes, found
+        if isinstance(node, ast.Constant) and node.value is None:
+            return True
+        if _is_partition_spec(index, node):
+            got = _spec_axes(node)  # type: ignore[arg-type]
+            if got is None:
+                return False
+            axes |= got
+            found = True
+            return True
+        return False
+
+    for specs in (in_specs, out_specs):
+        if specs is None:
+            continue
+        elts = (
+            list(specs.elts)
+            if isinstance(specs, (ast.Tuple, ast.List))
+            else [specs]
+        )
+        for e in elts:
+            if not take_spec(e):
+                return None
+    if isinstance(mesh, ast.Call):
+        d = index.dotted(mesh.func)
+        leaf = d.split(".")[-1] if d else ""
+        if leaf in ("Mesh", "make_mesh", "AbstractMesh"):
+            names: Optional[ast.AST] = (
+                mesh.args[1] if len(mesh.args) > 1 else None
+            )
+            for kw in mesh.keywords:
+                if kw.arg == "axis_names":
+                    names = kw.value
+            got = _const_str_set(names)
+            if got is None:
+                return None
+            axes |= got
+            found = True
+    return axes if found else None
+
+
+def _const_str_set(node: Optional[ast.AST]) -> Optional[set[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def _shard_map_kwargs(call: ast.Call) -> dict[str, ast.AST]:
+    kwargs: dict[str, ast.AST] = {}
+    # shard_map(f, mesh, in_specs, out_specs) positional fallback.
+    for i, name in enumerate(("mesh", "in_specs", "out_specs")):
+        if i + 1 < len(call.args):
+            kwargs[name] = call.args[i + 1]
+    for kw in call.keywords:
+        if kw.arg:
+            kwargs[kw.arg] = kw.value
+    return kwargs
+
+
+def _resolve_body(
+    index: _ModuleIndex,
+    op: Optional[ast.AST],
+    owner: Optional[ast.AST],
+    line: int,
+) -> Optional[ast.AST]:
+    if op is None:
+        return None
+    if isinstance(op, ast.Lambda):
+        return op
+    if isinstance(op, ast.Name):
+        return index.resolve_in_scope(op.id, owner, line)
+    return None
+
+
+def _walk_with_owner(roots, owner=None):
+    """(node, enclosing-function) pairs over a subtree."""
+    stack = [(owner, r) for r in roots]
+    while stack:
+        own, n = stack.pop()
+        yield n, own
+        nxt = (
+            n
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            else own
+        )
+        stack.extend((nxt, c) for c in ast.iter_child_nodes(n))
+
+
+def _discover_sites(tree: ast.Module, index: _ModuleIndex) -> list[_Site]:
+    sites: list[_Site] = []
+
+    def is_sm(node: ast.AST) -> bool:
+        d = index.dotted(node)
+        return bool(d) and d.split(".")[-1] == "shard_map"
+
+    def add(call: ast.Call, body, owner) -> None:
+        kw = _shard_map_kwargs(call)
+        sites.append(
+            _Site(
+                line=call.lineno,
+                body=body,
+                owner=owner,
+                in_specs=kw.get("in_specs"),
+                out_specs=kw.get("out_specs"),
+                declared_axes=_collect_declared_axes(
+                    index,
+                    kw.get("mesh"),
+                    kw.get("in_specs"),
+                    kw.get("out_specs"),
+                ),
+            )
+        )
+
+    for node, owner in _walk_with_owner(tree.body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @partial(shard_map, mesh=..., ...) / @shard_map(...)
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                fd = index.dotted(dec.func)
+                if is_sm(dec.func):
+                    add(dec, node, owner)
+                elif fd in ("functools.partial", "partial") and dec.args \
+                        and is_sm(dec.args[0]):
+                    add(dec, node, owner)
+        elif isinstance(node, ast.Call):
+            if is_sm(node.func) and node.args:
+                add(
+                    node,
+                    _resolve_body(index, node.args[0], owner, node.lineno),
+                    owner,
+                )
+            else:
+                fd = index.dotted(node.func)
+                if fd in ("functools.partial", "partial") and node.args \
+                        and is_sm(node.args[0]) and len(node.args) > 1:
+                    add(
+                        node,
+                        _resolve_body(
+                            index, node.args[1], owner, node.lineno
+                        ),
+                        owner,
+                    )
+    return sites
+
+
+def _out_spec_positions(
+    index: _ModuleIndex, out_specs: Optional[ast.AST]
+) -> Optional[list[str]]:
+    """Per-output-position spec classification: "replicated" (a
+    literal empty ``P()``), "varying" (a literal ``P`` with axes), or
+    "unknown". None when out_specs is not a literal tuple/list (or a
+    single spec)."""
+
+    def classify(node: ast.AST) -> str:
+        if _is_partition_spec(index, node):
+            axes = _spec_axes(node)  # type: ignore[arg-type]
+            if axes is None:
+                return "unknown"
+            return "replicated" if not axes else "varying"
+        return "unknown"
+
+    if out_specs is None:
+        return None
+    if isinstance(out_specs, (ast.Tuple, ast.List)):
+        return [classify(e) for e in out_specs.elts]
+    cls = classify(out_specs)
+    return [cls] if cls != "unknown" else None
+
+
+class _BodyState:
+    """Single forward pass over a shard_map body: which names are
+    shard-VARYING (derived from sharded inputs) and which carry an
+    un-psum'd per-shard REDUCTION (JL103 taint)."""
+
+    def __init__(
+        self,
+        index: _ModuleIndex,
+        body: ast.AST,
+        in_positions: Optional[list[str]],
+    ) -> None:
+        self.index = index
+        self.varying: set[str] = set()
+        self.reduced: set[str] = set()
+        params = []
+        if isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = body.args
+            params = list(a.posonlyargs) + list(a.args)
+            if a.vararg:
+                params.append(a.vararg)
+        for i, p in enumerate(params):
+            spec = (
+                in_positions[i]
+                if in_positions and i < len(in_positions)
+                else "unknown"
+            )
+            if spec != "replicated":
+                self.varying.add(p.arg)
+
+    def is_varying(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.varying
+        if isinstance(node, ast.Call):
+            leaf = _is_lax_collective(self.index, node)
+            if leaf in _REPLICATING:
+                return False
+        return any(
+            self.is_varying(c) for c in ast.iter_child_nodes(node)
+        )
+
+    def is_reduced(self, node: ast.AST) -> bool:
+        """Whether ``node`` may BE (or carry) an un-psum'd per-shard
+        reduction."""
+        if isinstance(node, ast.Name):
+            return node.id in self.reduced
+        if isinstance(node, ast.Call):
+            leaf = _is_lax_collective(self.index, node)
+            if leaf in _REPLICATING:
+                return False
+            d = self.index.dotted(node.func)
+            red = bool(d) and d.split(".")[-1] in _REDUCTIONS and (
+                d.startswith("jax.numpy.") or d.startswith("jax.")
+            )
+            if not red and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _REDUCTIONS \
+                    and not self.index.is_module_func(node.func):
+                red = True  # x.sum() method form
+            if red and (
+                any(self.is_varying(a) for a in node.args)
+                or any(self.is_varying(k.value) for k in node.keywords)
+            ):
+                return True
+        return any(
+            self.is_reduced(c) for c in ast.iter_child_nodes(node)
+        )
+
+    def absorb(self, stmt: ast.stmt) -> None:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return
+        value = stmt.value
+        if value is None:
+            return
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        # Elementwise tuple unpack keeps the maps precise for the
+        # `a, b = f(x), g(x)` style; otherwise the flags smear over
+        # every target (conservative).
+        if (
+            len(targets) == 1
+            and isinstance(targets[0], (ast.Tuple, ast.List))
+            and isinstance(value, (ast.Tuple, ast.List))
+            and len(targets[0].elts) == len(value.elts)
+        ):
+            pairs = list(zip(targets[0].elts, value.elts))
+        else:
+            pairs = [(t, value) for t in targets]
+        for tgt, val in pairs:
+            names = (
+                [tgt.id] if isinstance(tgt, ast.Name)
+                else [e.id for e in getattr(tgt, "elts", [])
+                      if isinstance(e, ast.Name)]
+            )
+            var = self.is_varying(val)
+            red = self.is_reduced(val)
+            for name in names:
+                (self.varying.add if var else self.varying.discard)(name)
+                (self.reduced.add if red else self.reduced.discard)(name)
+
+
+def _body_stmts(body: ast.AST) -> list[ast.stmt]:
+    """The body's statements in lexical order, descending into
+    compound statements but NOT nested function defs (those run when
+    called, with their own rules)."""
+    out: list[ast.stmt] = []
+    roots = body.body if isinstance(body.body, list) else []
+    stack = list(reversed(roots))
+    while stack:
+        s = stack.pop()
+        out.append(s)
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sub: list[ast.stmt] = []
+        for field in ("body", "orelse", "finalbody"):
+            sub.extend(getattr(s, field, []) or [])
+        for h in getattr(s, "handlers", []) or []:
+            sub.extend(h.body)
+        stack.extend(reversed(sub))
+    return out
+
+
+def _contains_collective(index: _ModuleIndex, fn: Optional[ast.AST]) -> bool:
+    if fn is None:
+        return False
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and _is_lax_collective(index, n):
+            return True
+    return False
+
+
+def _closure_reads(fn: ast.AST) -> set[str]:
+    """Names loaded in ``fn`` that are not its own params or locals."""
+    params = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            params.add(a.vararg.arg)
+    local_stores = {
+        n.id
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+    return {
+        n.id
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        and n.id not in params and n.id not in local_stores
+    }
+
+
+def check(tree: ast.Module, index: _ModuleIndex, path: str
+          ) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    sites = _discover_sites(tree, index)
+
+    # JL102 is site-independent: a literal non-bijective perm is wrong
+    # wherever it appears.
+    for node, _ in _walk_with_owner(tree.body):
+        if isinstance(node, ast.Call) and \
+                _is_lax_collective(index, node) == "ppermute":
+            _check_perm(node, path, diags)
+
+    for site in sites:
+        body = site.body
+        if body is None:
+            continue
+        in_positions = _out_spec_positions(index, site.in_specs)
+        out_positions = _out_spec_positions(index, site.out_specs)
+        state = _BodyState(index, body, in_positions)
+
+        # JL101: literal axis names vs the statically-declared set.
+        if site.declared_axes is not None:
+            for n in ast.walk(body):
+                if not isinstance(n, ast.Call):
+                    continue
+                leaf = _is_lax_collective(index, n)
+                if leaf is None:
+                    continue
+                axes = _axis_literals(n, leaf)
+                for ax in axes or ():
+                    if ax not in site.declared_axes:
+                        diags.append(Diagnostic(
+                            path, n.lineno, "JL101",
+                            f"collective `{leaf}` uses axis {ax!r} "
+                            "which is not declared by this shard_map's "
+                            "mesh/axis specs "
+                            f"({sorted(site.declared_axes)})",
+                        ))
+
+        # Forward pass: taint + JL104 divergent-control checks, in
+        # statement order so predicates see the right state.
+        stmts = (
+            _body_stmts(body)
+            if isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else []
+        )
+        for stmt in stmts:
+            for expr in ast.walk(stmt):
+                if isinstance(expr, ast.Call):
+                    _check_divergent(
+                        expr, state, index, body, path, diags
+                    )
+            state.absorb(stmt)
+
+        # JL103: reduction-tainted returns through replicated specs.
+        returns: list[ast.AST] = []
+        if isinstance(body, ast.Lambda):
+            returns = [body.body]
+        else:
+            returns = [
+                s.value for s in stmts
+                if isinstance(s, ast.Return) and s.value is not None
+            ]
+        for ret in returns:
+            elts = (
+                list(ret.elts) if isinstance(ret, ast.Tuple) else [ret]
+            )
+            for i, elt in enumerate(elts):
+                spec = (
+                    out_positions[i]
+                    if out_positions and i < len(out_positions)
+                    else "unknown"
+                )
+                if spec == "replicated" and state.is_reduced(elt):
+                    diags.append(Diagnostic(
+                        path, elt.lineno, "JL103",
+                        "per-shard reduction returned through a "
+                        f"replicated P() out_spec (position {i}); "
+                        "psum it over the mesh axis first",
+                    ))
+    return diags
+
+
+def _check_perm(node: ast.Call, path: str, diags: list[Diagnostic]) -> None:
+    perm: Optional[ast.AST] = node.args[2] if len(node.args) > 2 else None
+    for kw in node.keywords:
+        if kw.arg == "perm":
+            perm = kw.value
+    if not isinstance(perm, (ast.List, ast.Tuple)):
+        return  # computed perm (comprehension ring, ...): skip
+    pairs: list[tuple[int, int]] = []
+    for e in perm.elts:
+        if not (isinstance(e, (ast.Tuple, ast.List))
+                and len(e.elts) == 2
+                and all(isinstance(x, ast.Constant)
+                        and isinstance(x.value, int) for x in e.elts)):
+            return  # not statically enumerable
+        pairs.append((e.elts[0].value, e.elts[1].value))
+    if not pairs:
+        return
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    problem = None
+    if len(set(srcs)) != len(srcs):
+        problem = "duplicate source shard"
+    elif len(set(dsts)) != len(dsts):
+        problem = "duplicate destination shard"
+    elif set(srcs) != set(dsts):
+        problem = "source and destination sets differ"
+    if problem:
+        diags.append(Diagnostic(
+            path, node.lineno, "JL102",
+            f"ppermute perm {pairs} is not a total permutation "
+            f"({problem}); unnamed destinations receive zeros",
+        ))
+
+
+def _check_divergent(
+    call: ast.Call,
+    state: _BodyState,
+    index: _ModuleIndex,
+    body: ast.AST,
+    path: str,
+    diags: list[Diagnostic],
+) -> None:
+    d = index.dotted(call.func)
+    leaf = d.split(".")[-1] if d else ""
+    if leaf not in ("cond", "while_loop") or not d or \
+            not d.startswith("jax."):
+        return
+
+    def operand(i: int) -> Optional[ast.AST]:
+        if i >= len(call.args):
+            return None
+        return _resolve_body(index, call.args[i], body, call.lineno)
+
+    if leaf == "cond":
+        pred = call.args[0] if call.args else None
+        branches = [operand(1), operand(2)]
+        if pred is None:
+            return
+        shard_local = state.is_varying(pred) or state.is_reduced(pred)
+        has_coll = any(_contains_collective(index, b) for b in branches)
+    else:  # while_loop
+        cond_fn = operand(0)
+        body_fn = operand(1)
+        if cond_fn is None:
+            return
+        reads = _closure_reads(cond_fn)
+        shard_local = bool(
+            reads & (state.varying | state.reduced)
+        )
+        has_coll = _contains_collective(index, body_fn) or \
+            _contains_collective(index, cond_fn)
+    if shard_local and has_coll:
+        diags.append(Diagnostic(
+            path, call.lineno, "JL104",
+            f"`lax.{leaf}` predicate derives from a shard-local value "
+            "and its operand contains a collective: shards can "
+            "diverge and the collective deadlocks; derive the "
+            "predicate from a psum'd (replicated) value",
+        ))

@@ -1,0 +1,429 @@
+"""jaxlint host-concurrency pass: rules JL301-JL303 (pure stdlib).
+
+The service layer (``service/``, ``resilience/``) is the one part of
+the engine where plain Python threading rules apply and where the
+tests can only pin invariants with timing-sensitive scenarios. This
+pass checks the three mechanical invariants statically:
+
+- JL301 — instance state written from two different THREAD ROOTS
+  where at least one write holds no lock. The roots are declared in
+  ``THREAD_ROOTS`` below: per registered class, which methods are
+  entered by which thread (the service worker loop, socket
+  accept/connection threads, client calls, the signal-initiated drain
+  path). Classes NOT in the registry are exempt by design — e.g.
+  ``service/session.py``'s ``TallySession`` is documented as
+  guarded-by the owning ``TallyService`` lock and holds no lock of
+  its own.
+- JL302 — lock-ordering cycles in the acquired-while-holding graph
+  (nested ``with`` blocks, following one level of same-class method
+  calls). Lock identity is ``ClassName.attr`` / module-level name;
+  the graph is per-module.
+- JL303 — unbounded blocking calls (`Future.result()`, `join()`,
+  `queue.get()`, socket `recv`/`accept`, untimed `wait`) while a
+  recognized lock is held. ``Condition.wait`` ON the held condition
+  is exempt (it releases the lock), as is any call with a timeout.
+
+Locks are attributes assigned ``threading.Lock/RLock/Condition/
+Semaphore`` in the class body, or module-level names so assigned.
+``__init__`` writes are exempt from JL301 (the object is not shared
+until construction returns).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from pumiumtally_tpu.analysis.core import Diagnostic, _ModuleIndex
+
+# Thread-root registry: class name -> {method: root kind}. The
+# special key "*public*" declares every public (non-underscore)
+# method not otherwise listed as entered by that root kind. Only
+# classes listed here are analyzed for JL301 — declaring the roots is
+# the contract that makes "written from >= 2 roots" decidable.
+THREAD_ROOTS: dict[str, dict[str, str]] = {
+    # The multi-session service: ONE worker thread owns device work;
+    # client threads call the public API; the signal dispatcher
+    # (resilience.install_drain_owner) trips the drain flag via
+    # request_drain semantics.
+    "TallyService": {
+        "_worker_loop": "worker",
+        "request_drain": "signal-dispatcher",
+        "*public*": "client",
+    },
+    # Socket frontends: an accept-loop thread spawns one thread per
+    # connection; stop()/start() come from the owning (client) thread.
+    "SocketFrontend": {
+        "_accept_loop": "accept-thread",
+        "_serve_conn": "connection-thread",
+        "*public*": "client",
+    },
+    "SessionRouter": {
+        "_accept_loop": "accept-thread",
+        "_serve_conn": "connection-thread",
+        "*public*": "client",
+    },
+}
+
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+
+# Mutating container methods (shared shape with core's JL005 set).
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "sort", "reverse",
+}
+
+_BLOCKING_METHODS = {"result", "join", "get", "wait", "wait_for"}
+_SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "accept"}
+
+
+def _is_lock_ctor(index: _ModuleIndex, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = index.dotted(node.func)
+    return bool(d) and d.split(".")[-1] in _LOCK_CTORS and (
+        d.startswith("threading.") or d.startswith("multiprocessing.")
+    )
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _Write:
+    line: int
+    attr: str
+    locked: bool  # lexically under a recognized lock at the site
+
+
+@dataclasses.dataclass
+class _SelfCall:
+    method: str
+    locked: bool
+    held: tuple[str, ...]
+    line: int
+
+
+@dataclasses.dataclass
+class _Blocking:
+    line: int
+    desc: str
+    held: tuple[str, ...]  # lock ids held at the call
+
+
+@dataclasses.dataclass
+class _MethodFacts:
+    writes: list[_Write]
+    calls: list[_SelfCall]
+    edges: list[tuple[str, str, int]]  # (held, acquired, line)
+    acquires: list[str]
+    blocking: list[_Blocking]
+
+
+class _ClassScan:
+    """Per-class lock inventory + per-method facts."""
+
+    def __init__(self, cls: ast.ClassDef, index: _ModuleIndex,
+                 module_locks: set[str]) -> None:
+        self.cls = cls
+        self.index = index
+        self.module_locks = module_locks
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: set[str] = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr and _is_lock_ctor(index, n.value):
+                        self.lock_attrs.add(attr)
+        self.facts: dict[str, _MethodFacts] = {
+            name: self._scan_method(m)
+            for name, m in self.methods.items()
+        }
+
+    # -- lock identity ---------------------------------------------------
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.lock_attrs:
+            return f"{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return expr.id
+        return None
+
+    # -- method scan -----------------------------------------------------
+    def _scan_method(self, fn: ast.FunctionDef) -> _MethodFacts:
+        facts = _MethodFacts([], [], [], [], [])
+
+        def visit(stmts: list, held: tuple[str, ...]) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    # Nested defs (callbacks) run later, with held
+                    # state unknown: scan with no lock held.
+                    visit(s.body, ())
+                    continue
+                if isinstance(s, ast.With):
+                    acquired = []
+                    inner = held
+                    for item in s.items:
+                        lid = self._lock_id(item.context_expr)
+                        if lid is not None:
+                            for h in inner:
+                                facts.edges.append((h, lid, s.lineno))
+                            facts.acquires.append(lid)
+                            acquired.append(lid)
+                            inner = inner + (lid,)
+                    self._scan_exprs(s, inner, facts)
+                    visit(s.body, inner)
+                    continue
+                self._scan_exprs(s, held, facts)
+                for field in ("body", "orelse", "finalbody"):
+                    visit(getattr(s, field, []) or [], held)
+                for h in getattr(s, "handlers", []) or []:
+                    visit(h.body, held)
+        visit(fn.body, ())
+        return facts
+
+    def _scan_exprs(self, stmt: ast.stmt, held: tuple[str, ...],
+                    facts: _MethodFacts) -> None:
+        locked = bool(held)
+        # Attribute writes (assignment, augmented, container element).
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            base = t
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                attr = _self_attr(base)
+                if attr is not None:
+                    facts.writes.append(
+                        _Write(stmt.lineno, attr, locked)
+                    )
+                    break
+                base = base.value
+        for n in _own_exprs(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            # self.method(...) calls (for reachability + lock context).
+            if isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == "self" and \
+                    n.func.attr in self.methods:
+                facts.calls.append(
+                    _SelfCall(n.func.attr, locked, held, n.lineno)
+                )
+                continue
+            # self.attr.append(...) container mutators.
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _MUTATORS:
+                attr = _self_attr(n.func.value)
+                if attr is not None:
+                    facts.writes.append(
+                        _Write(n.lineno, attr, locked)
+                    )
+            if locked:
+                desc = self._blocking_desc(n, held)
+                if desc:
+                    facts.blocking.append(
+                        _Blocking(n.lineno, desc, held)
+                    )
+
+    def _blocking_desc(self, call: ast.Call,
+                       held: tuple[str, ...]) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            d = self.index.dotted(f)
+            if d == "time.sleep":
+                return "time.sleep"
+            return None
+        name = f.attr
+        has_timeout = bool(call.args) or any(
+            kw.arg == "timeout" for kw in call.keywords
+        )
+        if name in _SOCKET_METHODS:
+            return f".{name}()"
+        if name not in _BLOCKING_METHODS:
+            return None
+        if name == "wait_for":
+            has_timeout = len(call.args) > 1 or any(
+                kw.arg == "timeout" for kw in call.keywords
+            )
+        if has_timeout:
+            return None
+        if name in ("wait", "wait_for"):
+            # Condition.wait on the HELD condition releases the lock.
+            lid = self._lock_id(f.value)
+            if lid is not None and lid in held:
+                return None
+        if name == "get" and call.keywords:
+            return None  # q.get(block=...) variants: assume bounded
+        return f".{name}()"
+
+
+def _own_exprs(stmt: ast.stmt):
+    """Expression nodes of one statement, excluding nested statement
+    bodies and nested defs (same contract as core._iter_stmt_exprs)."""
+    stack: list[ast.AST] = []
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        vs = value if isinstance(value, list) else [value]
+        stack.extend(v for v in vs if isinstance(v, ast.AST))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _root_methods(scan: _ClassScan, registry: dict[str, str]
+                  ) -> dict[str, str]:
+    roots: dict[str, str] = {}
+    for name, kind in registry.items():
+        if name == "*public*":
+            continue
+        if name in scan.methods:
+            roots[name] = kind
+    public_kind = registry.get("*public*")
+    if public_kind:
+        for name in scan.methods:
+            if not name.startswith("_") and name not in roots:
+                roots[name] = public_kind
+    return roots
+
+
+def _check_shared_state(scan: _ClassScan, roots: dict[str, str],
+                        path: str, diags: list[Diagnostic]) -> None:
+    # Reachability over (method, called-with-lock-held) states.
+    # attr -> root kinds that can write it; and the unlocked write
+    # sites reachable with no lock held anywhere on the call chain.
+    writers: dict[str, set[str]] = {}
+    unsafe: dict[str, set[tuple[int, str]]] = {}
+    for root, kind in roots.items():
+        seen: set[tuple[str, bool]] = set()
+        stack: list[tuple[str, bool]] = [(root, False)]
+        while stack:
+            method, held = stack.pop()
+            if (method, held) in seen:
+                continue
+            seen.add((method, held))
+            facts = scan.facts.get(method)
+            if facts is None:
+                continue
+            for w in facts.writes:
+                writers.setdefault(w.attr, set()).add(kind)
+                if not w.locked and not held:
+                    unsafe.setdefault(w.attr, set()).add(
+                        (w.line, kind)
+                    )
+            for c in facts.calls:
+                stack.append((c.method, held or c.locked))
+    for attr, kinds in sorted(writers.items()):
+        if len(kinds) < 2:
+            continue
+        for line, kind in sorted(unsafe.get(attr, ())):
+            diags.append(Diagnostic(
+                path, line, "JL301",
+                f"`self.{attr}` is written from multiple thread roots "
+                f"({', '.join(sorted(kinds))}) but this "
+                f"{kind}-root write holds no lock "
+                f"(locks: {sorted(scan.lock_attrs) or 'none'})",
+            ))
+
+
+def _check_lock_order(edges: list[tuple[str, str, int]], path: str,
+                      diags: list[Diagnostic]) -> None:
+    graph: dict[str, set[str]] = {}
+    edge_line: dict[tuple[str, str], int] = {}
+    for a, b, line in edges:
+        if a == b:
+            continue  # re-entrant acquire (RLock/Condition pair)
+        graph.setdefault(a, set()).add(b)
+        edge_line.setdefault((a, b), line)
+
+    reported: set[frozenset] = set()
+
+    def dfs(start: str) -> Optional[list[str]]:
+        stack = [(start, [start])]
+        while stack:
+            node, trail = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    return trail + [start]
+                if nxt not in trail:
+                    stack.append((nxt, trail + [nxt]))
+        return None
+
+    for start in sorted(graph):
+        cycle = dfs(start)
+        if cycle is None:
+            continue
+        key = frozenset(cycle)
+        if key in reported:
+            continue
+        reported.add(key)
+        line = min(
+            edge_line.get((cycle[i], cycle[i + 1]), 1)
+            for i in range(len(cycle) - 1)
+        )
+        diags.append(Diagnostic(
+            path, line, "JL302",
+            "lock-ordering cycle: " + " -> ".join(cycle)
+            + "; pick one global acquisition order",
+        ))
+
+
+def check(tree: ast.Module, index: _ModuleIndex, path: str
+          ) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    module_locks = {
+        t.id
+        for stmt in tree.body
+        if isinstance(stmt, ast.Assign)
+        for t in stmt.targets
+        if isinstance(t, ast.Name) and _is_lock_ctor(index, stmt.value)
+    }
+    all_edges: list[tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan(node, index, module_locks)
+        for facts in scan.facts.values():
+            all_edges.extend(facts.edges)
+            # One level of same-class calls: locks the callee acquires
+            # while the caller holds others form ordering edges too.
+            for c in facts.calls:
+                callee = scan.facts.get(c.method)
+                if callee is None:
+                    continue
+                for a in c.held:
+                    for b2 in callee.acquires:
+                        all_edges.append((a, b2, c.line))
+            for b in facts.blocking:
+                diags.append(Diagnostic(
+                    path, b.line, "JL303",
+                    f"blocking call `{b.desc}` while holding "
+                    f"{', '.join(sorted(set(b.held)))}; waits belong "
+                    "outside the lock (the worker needs it to make "
+                    "progress)",
+                ))
+        registry = THREAD_ROOTS.get(node.name)
+        if registry:
+            roots = _root_methods(scan, registry)
+            _check_shared_state(scan, roots, path, diags)
+    _check_lock_order(all_edges, path, diags)
+    return diags

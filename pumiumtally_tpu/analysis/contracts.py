@@ -1,0 +1,342 @@
+"""Facade contract auditor (``python -m pumiumtally_tpu.analysis
+--contracts``).
+
+The package ships five user-facing tally facades (ROADMAP item 5):
+
+    monolithic              PumiTally                     api/tally.py
+    sharded                 PumiTally(device_mesh=...)    api/tally.py
+    streaming               StreamingTally                api/streaming.py
+    partitioned             PartitionedPumiTally          api/partitioned.py
+    streaming_partitioned   StreamingPartitionedTally     api/streaming.py
+
+All five must implement the same hook surface — the points where the
+service layer, checkpointing, and batch fusion attach:
+
+    batch-close       close_batch
+    move-end          MoveToNextLocation
+    checkpoint-rows   checkpoint_now
+    lane-bank         score_bank
+    fusion-key        _fusion_key
+
+Like the rest of jaxlint this auditor is pure stdlib-AST: the api
+modules import jax, so they are parsed, never imported.  For every
+(facade, hook) cell it reports where the hook is defined (inherited
+vs overridden, with file:line) and whether an override's signature is
+compatible with the base definition.  Compatible means: identical
+parameter names/order/default-ness, or the base parameter list
+extended only by trailing defaulted parameters.  Anything else is
+rendered as ``DRIFT`` — informational (exit 0); a MISSING hook is a
+contract break (exit 1).
+
+The audit also cross-checks ``utils/checkpoint.py::_engine_kind``:
+every facade kind must be dispatchable so checkpoint state rows carry
+the right engine tag.  ``sharded`` is the monolithic class with a
+device mesh, so it shares the ``monolithic`` kind.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+#: facade name -> (class name, module path relative to package root).
+FACADES: List[Tuple[str, str, str]] = [
+    ("monolithic", "PumiTally", "api/tally.py"),
+    ("sharded", "PumiTally", "api/tally.py"),
+    ("streaming", "StreamingTally", "api/streaming.py"),
+    ("partitioned", "PartitionedPumiTally", "api/partitioned.py"),
+    (
+        "streaming_partitioned",
+        "StreamingPartitionedTally",
+        "api/streaming.py",
+    ),
+]
+
+#: hook surface: (contract point, method name).
+HOOKS: List[Tuple[str, str]] = [
+    ("batch-close", "close_batch"),
+    ("move-end", "MoveToNextLocation"),
+    ("checkpoint-rows", "checkpoint_now"),
+    ("lane-bank", "score_bank"),
+    ("fusion-key", "_fusion_key"),
+]
+
+#: facade -> the tag _engine_kind must be able to produce for it.
+ENGINE_KINDS = {
+    "monolithic": "monolithic",
+    "sharded": "monolithic",  # same class, mesh-selected arm
+    "streaming": "streaming",
+    "partitioned": "partitioned",
+    "streaming_partitioned": "streaming_partitioned",
+}
+
+_API_MODULES = ("api/tally.py", "api/streaming.py", "api/partitioned.py")
+
+
+def package_root() -> str:
+    """Repo-relative package dir, valid from any cwd."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# AST harvest
+
+
+@dataclass(frozen=True)
+class _Method:
+    cls: str
+    module: str  # path relative to the package root
+    line: int
+    args: ast.arguments
+    is_property: bool
+
+
+@dataclass
+class _Class:
+    name: str
+    module: str
+    line: int
+    bases: List[str]
+    methods: Dict[str, _Method]
+
+
+def _harvest(root: str) -> Dict[str, _Class]:
+    classes: Dict[str, _Class] = {}
+    for rel in _API_MODULES:
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods: Dict[str, _Method] = {}
+            for item in node.body:
+                if not isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                is_prop = any(
+                    (isinstance(d, ast.Name) and d.id == "property")
+                    or (
+                        isinstance(d, ast.Attribute)
+                        and d.attr in ("setter", "getter")
+                    )
+                    for d in item.decorator_list
+                )
+                if item.name in methods and not is_prop:
+                    continue  # keep the getter for properties
+                methods[item.name] = _Method(
+                    cls=node.name,
+                    module=rel,
+                    line=item.lineno,
+                    args=item.args,
+                    is_property=is_prop,
+                )
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            classes[node.name] = _Class(
+                name=node.name,
+                module=rel,
+                line=node.lineno,
+                bases=bases,
+                methods=methods,
+            )
+    return classes
+
+
+def _mro(classes: Dict[str, _Class], name: str) -> List[_Class]:
+    """Linear base chain (the facade hierarchy is single-inheritance)."""
+    chain: List[_Class] = []
+    seen = set()
+    while name in classes and name not in seen:
+        seen.add(name)
+        cls = classes[name]
+        chain.append(cls)
+        name = cls.bases[0] if cls.bases else ""
+    return chain
+
+
+def _find_hook(
+    classes: Dict[str, _Class], facade_cls: str, method: str
+) -> Optional[_Method]:
+    for cls in _mro(classes, facade_cls):
+        if method in cls.methods:
+            return cls.methods[method]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Signature compatibility
+
+
+def _sig_shape(args: ast.arguments) -> List[Tuple[str, bool]]:
+    """(name, has_default) per positional param, ``self`` dropped;
+    vararg/kwonly params appended with sentinel markers."""
+    pos = list(args.posonlyargs) + list(args.args)
+    n_default = len(args.defaults)
+    shape: List[Tuple[str, bool]] = []
+    for i, a in enumerate(pos):
+        if i == 0 and a.arg in ("self", "cls"):
+            continue
+        shape.append((a.arg, i >= len(pos) - n_default))
+    if args.vararg is not None:
+        shape.append(("*" + args.vararg.arg, True))
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        shape.append((a.arg, d is not None))
+    if args.kwarg is not None:
+        shape.append(("**" + args.kwarg.arg, True))
+    return shape
+
+
+def _compat(base: ast.arguments, override: ast.arguments) -> str:
+    """'ok' | 'ok(+extras)' | 'DRIFT'."""
+    b, o = _sig_shape(base), _sig_shape(override)
+    if b == o:
+        return "ok"
+    if len(o) > len(b) and o[: len(b)] == b and all(
+        d for _, d in o[len(b):]
+    ):
+        return "ok(+extras)"
+    return "DRIFT"
+
+
+# ---------------------------------------------------------------------------
+# _engine_kind coverage
+
+
+def _engine_kinds_dispatched(root: str) -> set:
+    path = os.path.join(root, "utils/checkpoint.py")
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "_engine_kind"
+        ):
+            return {
+                n.value.value
+                for n in ast.walk(node)
+                if isinstance(n, ast.Return)
+                and isinstance(n.value, ast.Constant)
+                and isinstance(n.value.value, str)
+            }
+    return set()
+
+
+# ---------------------------------------------------------------------------
+# Audit
+
+
+def audit_contracts(root: Optional[str] = None) -> Tuple[dict, int]:
+    """Returns (report, exit_code): 0 clean/drift-only, 1 contract
+    break (missing hook or undispatchable engine kind)."""
+    root = root or package_root()
+    classes = _harvest(root)
+    kinds = _engine_kinds_dispatched(root)
+    exit_code = 0
+    rows = []
+    for facade, cls_name, module in FACADES:
+        if cls_name not in classes:
+            rows.append(
+                {"facade": facade, "class": cls_name, "module": module,
+                 "error": "class not found"}
+            )
+            exit_code = 1
+            continue
+        hooks = {}
+        base_cls = _mro(classes, cls_name)[-1].name
+        for point, method in HOOKS:
+            m = _find_hook(classes, cls_name, method)
+            if m is None:
+                hooks[point] = {"method": method, "status": "MISSING"}
+                exit_code = 1
+                continue
+            base_def = _find_hook(classes, base_cls, method)
+            if m.cls == cls_name and base_def is not None and (
+                base_def.cls != cls_name
+            ):
+                status = "override:" + _compat(base_def.args, m.args)
+            elif m.cls == cls_name:
+                status = "defines"
+            else:
+                status = "inherit"
+            hooks[point] = {
+                "method": method,
+                "status": status,
+                "defined_in": "%s:%d" % (m.module, m.line),
+                "class": m.cls,
+            }
+        kind = ENGINE_KINDS[facade]
+        kind_ok = kind in kinds
+        if not kind_ok:
+            exit_code = 1
+        rows.append(
+            {
+                "facade": facade,
+                "class": cls_name,
+                "module": module,
+                "engine_kind": kind,
+                "engine_kind_dispatched": kind_ok,
+                "hooks": hooks,
+            }
+        )
+    report = {
+        "facades": rows,
+        "hook_points": [p for p, _ in HOOKS],
+        "engine_kinds_dispatched": sorted(kinds),
+    }
+    return report, exit_code
+
+
+def render_text(report: dict) -> str:
+    points = report["hook_points"]
+    grid = [["facade"] + points + ["engine-kind"]]
+    for row in report["facades"]:
+        if "error" in row:
+            grid.append(
+                [row["facade"], "!! " + row["error"]]
+                + [""] * len(points)
+            )
+            continue
+        cells = [row["facade"]]
+        for p in points:
+            h = row["hooks"][p]
+            if h["status"] == "MISSING":
+                cells.append("MISSING")
+            else:
+                cells.append(
+                    "%s %s"
+                    % (h["status"], h["defined_in"].split("/")[-1])
+                )
+        kind = row["engine_kind"]
+        cells.append(
+            kind if row["engine_kind_dispatched"] else kind + "(!)"
+        )
+        grid.append(cells)
+    widths = [
+        max(len(r[i]) for r in grid) for i in range(len(grid[0]))
+    ]
+    lines = []
+    for i, r in enumerate(grid):
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+        if i == 0:
+            lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    lines.append("")
+    lines.append(
+        "_engine_kind dispatches: %s"
+        % ", ".join(report["engine_kinds_dispatched"])
+    )
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=True)

@@ -501,6 +501,17 @@ class Analyzer:
             self._check_traced_function(root, spec, index, seen)
         self._check_donation(tree, index)
         self._check_static_defaults(tree, index)
+        # The JL1xx/2xx/3xx passes share this parse + index and feed
+        # the same dedup/pragma pipeline below. Imported lazily:
+        # the pass modules import Diagnostic/_ModuleIndex from here.
+        from pumiumtally_tpu.analysis import (
+            collective,
+            concurrency,
+            pallas,
+        )
+
+        for check in (collective.check, pallas.check, concurrency.check):
+            self.diags.extend(check(tree, index, self.path))
         # Nested defs are reachable both through their own walk and the
         # enclosing function's — keep the first of any exact duplicate.
         unique: dict[tuple, Diagnostic] = {}

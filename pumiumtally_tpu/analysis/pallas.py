@@ -1,0 +1,458 @@
+"""jaxlint Pallas-kernel pass: rules JL201-JL204 (pure stdlib).
+
+The Pallas kernels (``ops/vmem_walk.py``, ``ops/pallas_walk.py``) are
+checked on hardware this repo usually cannot reach — Mosaic's
+scoped-VMEM limit, ref-role discipline and block/array divisibility
+all surface only at AOT-compile time (ROADMAP "standing caveat").
+This pass front-loads the statically-decidable share of those checks:
+
+- JL201 sums the block-resident bytes declared by LITERAL BlockSpec
+  shapes against the measured feasibility model (the
+  ``VMEM_FEASIBLE_MAX_ELEMS`` constant documented in ops/vmem_walk.py;
+  mirrored here because the analyzer must not import jax).
+- JL202 splits kernel params into input/output refs by counting a
+  literal ``in_specs`` list and flags input-ref writes plus
+  output-ref reads that precede every in-flow write.
+- JL203 checks literal out_shape dims divide by their out_specs block
+  dims.
+- JL204 forbids host-effect calls (print/open/os./time./logging)
+  inside kernel bodies — `pl.debug_print` is the device-side tool.
+
+Same no-false-positive bias as the collective pass: runtime-sized
+blocks, `+=`-assembled spec lists and `*refs` kernels are skipped,
+not guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from pumiumtally_tpu.analysis.core import Diagnostic, _ModuleIndex
+
+# Mirror of the ops/vmem_walk.py feasibility model (the analyzer is
+# jax-free by contract, so the constants cannot be imported): the
+# largest measured-feasible resident operand at the production
+# particle tile is the [VMEM_FEASIBLE_MAX_ELEMS, TABLE_PAD_COLS] f32
+# table block — 1 MiB of declared block bytes. Blocks declaring more
+# than that hit Mosaic's "exceeded scoped vmem limit" on every chip
+# generation (it is a compiler constant, not physical VMEM).
+_VMEM_FEASIBLE_MAX_ELEMS = 8192
+_TABLE_PAD_COLS = 32
+VMEM_BLOCK_BUDGET_BYTES = _VMEM_FEASIBLE_MAX_ELEMS * _TABLE_PAD_COLS * 4
+
+# dtype leaf name -> element bytes (for ShapeDtypeStruct-declared
+# outputs; inputs default to 4 — the kernels are f32/int32 by the
+# Mosaic rank-1 tiling law documented in ops/vmem_walk.py).
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+}
+
+_HOST_CALL_NAMES = {"print", "open", "input", "breakpoint"}
+_HOST_CALL_PREFIXES = ("os.", "time.", "logging.", "sys.", "io.")
+
+_MUTATING_ASSIGN = (ast.Assign, ast.AugAssign, ast.AnnAssign)
+
+
+def _module_int_consts(tree: ast.Module) -> dict[str, int]:
+    """Module-level integer constants, folded in definition order
+    (``TILE_1D = 1024``; ``BF16_MAX = 2 * MAX``)."""
+    consts: dict[str, int] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            val = _fold_int(stmt.value, consts)
+            name = stmt.targets[0].id
+            if val is not None:
+                consts[name] = val
+            else:
+                consts.pop(name, None)
+    return consts
+
+
+def _fold_int(node: Optional[ast.AST], consts: dict[str, int]
+              ) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _fold_int(node.operand, consts)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        lo = _fold_int(node.left, consts)
+        hi = _fold_int(node.right, consts)
+        if lo is None or hi is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return lo + hi
+        if isinstance(node.op, ast.Sub):
+            return lo - hi
+        if isinstance(node.op, ast.Mult):
+            return lo * hi
+        if isinstance(node.op, ast.FloorDiv) and hi != 0:
+            return lo // hi
+        if isinstance(node.op, ast.Mod) and hi != 0:
+            return lo % hi
+    return None
+
+
+def _is_call_leaf(index: _ModuleIndex, node: ast.AST, leaf: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = index.dotted(node.func)
+    return bool(d) and d.split(".")[-1] == leaf
+
+
+def _block_shape(index: _ModuleIndex, spec: ast.AST
+                 ) -> Optional[list[Optional[ast.AST]]]:
+    """The block-shape dim expressions of one literal BlockSpec call,
+    or None when the call/shape is not statically structured."""
+    if not _is_call_leaf(index, spec, "BlockSpec"):
+        return None
+    call = spec  # type: ignore[assignment]
+    shape: Optional[ast.AST] = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "block_shape":
+            shape = kw.value
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        return list(shape.elts)
+    return None
+
+
+def _spec_list(node: Optional[ast.AST]) -> Optional[list[ast.AST]]:
+    """Elements of a LITERAL in_specs/out_specs list, or None
+    (``+=``-assembled or otherwise runtime-shaped lists — the
+    pallas_walk.py variant — are not statically countable)."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return list(node.elts)
+    return None
+
+
+def check(tree: ast.Module, index: _ModuleIndex, path: str
+          ) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    consts = _module_int_consts(tree)
+
+    stack: list[tuple[Optional[ast.AST], ast.AST]] = [
+        (None, n) for n in tree.body
+    ]
+    while stack:
+        owner, node = stack.pop()
+        nxt = (
+            node
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            )
+            else owner
+        )
+        stack.extend((nxt, c) for c in ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        d = index.dotted(node.func)
+        if not d or d.split(".")[-1] != "pallas_call":
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        kernel: Optional[ast.AST] = None
+        if node.args:
+            op = node.args[0]
+            if isinstance(op, ast.Lambda):
+                kernel = op
+            elif isinstance(op, ast.Name):
+                kernel = index.resolve_in_scope(
+                    op.id, owner, node.lineno
+                )
+        _check_vmem_budget(node, kwargs, index, consts, path, diags)
+        _check_divisibility(kwargs, index, consts, path, diags)
+        if isinstance(kernel, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_ref_discipline(node, kernel, kwargs, index, path,
+                                  diags)
+            _check_host_calls(kernel, index, path, diags)
+    return diags
+
+
+# -- JL201 ----------------------------------------------------------------
+def _check_vmem_budget(
+    call: ast.Call,
+    kwargs: dict[str, ast.AST],
+    index: _ModuleIndex,
+    consts: dict[str, int],
+    path: str,
+    diags: list[Diagnostic],
+) -> None:
+    out_dtypes = _out_dtype_bytes(kwargs.get("out_shape"), index)
+    total = 0
+    resolved_any = False
+    for which in ("in_specs", "out_specs"):
+        specs = _spec_list(kwargs.get(which))
+        if specs is None and which == "out_specs" and \
+                kwargs.get("out_specs") is not None:
+            specs = [kwargs["out_specs"]]  # single un-listed spec
+        for i, spec in enumerate(specs or []):
+            dims = _block_shape(index, spec)
+            if dims is None:
+                continue
+            elems = 1
+            ok = True
+            for dim in dims:
+                v = _fold_int(dim, consts)
+                if v is None:
+                    ok = False
+                    break
+                elems *= v
+            if not ok:
+                continue
+            resolved_any = True
+            bytes_per = 4
+            if which == "out_specs" and i < len(out_dtypes) and \
+                    out_dtypes[i] is not None:
+                bytes_per = out_dtypes[i]
+            total += elems * bytes_per
+    if resolved_any and total > VMEM_BLOCK_BUDGET_BYTES:
+        diags.append(Diagnostic(
+            path, call.lineno, "JL201",
+            f"declared BlockSpec working set is {total} bytes "
+            f"({total // 1024} KiB), beyond the "
+            f"{VMEM_BLOCK_BUDGET_BYTES // 1024} KiB feasibility model "
+            "(VMEM_FEASIBLE_MAX_ELEMS, ops/vmem_walk.py); Mosaic will "
+            "reject this at AOT compile",
+        ))
+
+
+def _out_dtype_bytes(out_shape: Optional[ast.AST], index: _ModuleIndex
+                     ) -> list[Optional[int]]:
+    structs = _spec_list(out_shape)
+    if structs is None:
+        structs = [out_shape] if out_shape is not None else []
+    out: list[Optional[int]] = []
+    for s in structs:
+        b: Optional[int] = None
+        if s is not None and _is_call_leaf(index, s, "ShapeDtypeStruct"):
+            dt: Optional[ast.AST] = (
+                s.args[1] if len(s.args) > 1 else None
+            )
+            for kw in s.keywords:
+                if kw.arg == "dtype":
+                    dt = kw.value
+            dd = index.dotted(dt) if dt is not None else None
+            if dd:
+                b = _DTYPE_BYTES.get(dd.split(".")[-1])
+        out.append(b)
+    return out
+
+
+# -- JL203 ----------------------------------------------------------------
+def _check_divisibility(
+    kwargs: dict[str, ast.AST],
+    index: _ModuleIndex,
+    consts: dict[str, int],
+    path: str,
+    diags: list[Diagnostic],
+) -> None:
+    shapes = _spec_list(kwargs.get("out_shape"))
+    if shapes is None and kwargs.get("out_shape") is not None:
+        shapes = [kwargs["out_shape"]]
+    specs = _spec_list(kwargs.get("out_specs"))
+    if specs is None and kwargs.get("out_specs") is not None:
+        specs = [kwargs["out_specs"]]
+    if not shapes or not specs:
+        return
+    for pos, (sd, sp) in enumerate(zip(shapes, specs)):
+        if not _is_call_leaf(index, sd, "ShapeDtypeStruct"):
+            continue
+        arr: Optional[ast.AST] = sd.args[0] if sd.args else None
+        for kw in sd.keywords:
+            if kw.arg == "shape":
+                arr = kw.value
+        if not isinstance(arr, (ast.Tuple, ast.List)):
+            continue
+        dims = _block_shape(index, sp)
+        if dims is None:
+            continue
+        for arr_dim, blk_dim in zip(arr.elts, dims):
+            a = _fold_int(arr_dim, consts)
+            b = _fold_int(blk_dim, consts)
+            if a is None or b is None or b <= 0:
+                continue
+            if a % b != 0:
+                diags.append(Diagnostic(
+                    path, sp.lineno, "JL203",
+                    f"output {pos}: array dim {a} is not divisible by "
+                    f"its BlockSpec block dim {b}; the trailing block "
+                    "reads out of bounds",
+                ))
+
+
+# -- JL202 ----------------------------------------------------------------
+def _check_ref_discipline(
+    call: ast.Call,
+    kernel: ast.FunctionDef,
+    kwargs: dict[str, ast.AST],
+    index: _ModuleIndex,
+    path: str,
+    diags: list[Diagnostic],
+) -> None:
+    specs = _spec_list(kwargs.get("in_specs"))
+    if specs is None:
+        return  # runtime-assembled in_specs (pallas_walk.py): skip
+    n_in = len(specs)
+    params = [
+        p.arg
+        for p in (list(kernel.args.posonlyargs) + list(kernel.args.args))
+    ]
+    vararg = kernel.args.vararg.arg if kernel.args.vararg else None
+    if n_in > len(params):
+        return  # inputs spill into the vararg: roles ambiguous
+    inputs = set(params[:n_in])
+    outputs = set(params[n_in:])
+    aliases: dict[str, str] = {}  # local alias -> underlying ref name
+
+    def resolve(name: str) -> Optional[str]:
+        seen = set()
+        while name in aliases and name not in seen:
+            seen.add(name)
+            name = aliases[name]
+        if name in inputs or name in outputs or name == vararg:
+            return name
+        return None
+
+    def ref_of(expr: ast.AST) -> Optional[str]:
+        """The ref a subscript/name expression designates, following
+        vararg indexing (``flux_outs[0]`` is an output ref)."""
+        if isinstance(expr, ast.Name):
+            return resolve(expr.id)
+        if isinstance(expr, ast.Subscript):
+            return ref_of(expr.value)
+        return None
+
+    def role(name: str) -> str:
+        return "input" if name in inputs else "output"
+
+    # In-flow statements: the kernel's own flow plus decorated nested
+    # defs (`@pl.when(...)` blocks execute at their definition point);
+    # bare nested defs (while_loop bodies) run later — excluded from
+    # the read-before-write ordering, included for input-ref writes.
+    flow: list[tuple[ast.stmt, bool]] = []  # (stmt, in_flow)
+
+    def collect(stmts: list, in_flow: bool) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                collect(s.body, in_flow and bool(s.decorator_list))
+                continue
+            flow.append((s, in_flow))
+            for field in ("body", "orelse", "finalbody"):
+                collect(getattr(s, field, []) or [], in_flow)
+            for h in getattr(s, "handlers", []) or []:
+                collect(h.body, in_flow)
+
+    collect(kernel.body, True)
+
+    first_write: dict[str, int] = {}
+    writes: list[tuple[int, str]] = []
+    reads: list[tuple[int, str, bool]] = []  # (line, ref, in_flow)
+
+    for stmt, in_flow in flow:
+        # Alias bookkeeping: `a = b` / `a = b[i]` where b is a ref.
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            tgt = stmt.targets[0].id
+            src: Optional[str] = None
+            v = stmt.value
+            if isinstance(v, ast.Name):
+                src = v.id
+            elif isinstance(v, ast.Subscript) and \
+                    isinstance(v.value, ast.Name) and \
+                    resolve(v.value.id) == vararg and vararg:
+                src = v.value.id  # vararg element IS a ref
+            if src is not None and resolve(src) is not None:
+                aliases[tgt] = src
+        # Writes: subscript stores + pl.store.
+        tgts: list[ast.AST] = []
+        if isinstance(stmt, _MUTATING_ASSIGN):
+            tgts = (
+                list(stmt.targets) if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+        for t in tgts:
+            if isinstance(t, ast.Subscript):
+                name = ref_of(t)
+                if name:
+                    writes.append((stmt.lineno, name))
+                    if in_flow:
+                        first_write.setdefault(name, stmt.lineno)
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                d = index.dotted(n.func)
+                leaf = d.split(".")[-1] if d else ""
+                if leaf == "store" and n.args:
+                    name = ref_of(n.args[0])
+                    if name:
+                        writes.append((n.lineno, name))
+                        if in_flow:
+                            first_write.setdefault(name, n.lineno)
+                elif leaf == "load" and n.args:
+                    name = ref_of(n.args[0])
+                    if name:
+                        reads.append((n.lineno, name, in_flow))
+            elif isinstance(n, ast.Subscript) and \
+                    isinstance(n.ctx, ast.Load):
+                name = ref_of(n)
+                if name and name != vararg:
+                    reads.append((n.lineno, name, in_flow))
+
+    for line, name in writes:
+        if role(name) == "input":
+            diags.append(Diagnostic(
+                path, line, "JL202",
+                f"kernel writes input ref `{name}` (param of "
+                f"`{kernel.name}` backed by in_specs); input blocks "
+                "may alias the operand — write an output ref",
+            ))
+    seen_read: set[tuple[int, str]] = set()
+    for line, name, in_flow in reads:
+        if not in_flow or role(name) != "output":
+            continue
+        fw = first_write.get(name)
+        if fw is not None and line >= fw:
+            continue
+        if (line, name) in seen_read:
+            continue
+        seen_read.add((line, name))
+        diags.append(Diagnostic(
+            path, line, "JL202",
+            f"kernel reads output ref `{name}` before any write "
+            "seeds it; output blocks are uninitialized until written",
+        ))
+
+
+# -- JL204 ----------------------------------------------------------------
+def _check_host_calls(
+    kernel: ast.FunctionDef,
+    index: _ModuleIndex,
+    path: str,
+    diags: list[Diagnostic],
+) -> None:
+    for n in ast.walk(kernel):
+        if not isinstance(n, ast.Call):
+            continue
+        bad: Optional[str] = None
+        if isinstance(n.func, ast.Name) and n.func.id in _HOST_CALL_NAMES:
+            bad = n.func.id
+        else:
+            d = index.dotted(n.func)
+            if d and d.startswith(_HOST_CALL_PREFIXES) and \
+                    index.is_module_func(n.func):
+                bad = d
+        if bad:
+            diags.append(Diagnostic(
+                path, n.lineno, "JL204",
+                f"host-side call `{bad}` inside Pallas kernel "
+                f"`{kernel.name}` runs at trace time only (or fails "
+                "to lower); use pl.debug_print / move I/O outside "
+                "the pallas_call",
+            ))

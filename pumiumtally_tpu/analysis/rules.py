@@ -171,3 +171,306 @@ hidden retrace dependency when the mutated state feeds later traces.
 Keep traced bodies pure; do host-side bookkeeping at the facade layer.
 """,
 )
+
+_rule(
+    "JL101",
+    "collective uses an axis name not declared by the enclosing "
+    "shard_map mesh/axis specs",
+    """
+Inside a `shard_map` body, every collective (`lax.psum`, `ppermute`,
+`all_gather`, `axis_index`, ...) names the mesh axis it reduces or
+permutes over. An axis name that does not appear in the call site's
+`in_specs`/`out_specs`/`axis_names`/mesh declaration raises
+`NameError: unbound axis name` at trace time — but only on the first
+trace of that code path, which for the rarely-taken resume/retry
+programs can be deep into a campaign.
+
+    bad:
+        f = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                      out_specs=P("dp"))
+        def body(x):
+            return lax.psum(x, "data")        # axis "data" not in specs
+
+    good:
+        def body(x):
+            return lax.psum(x, "dp")          # declared axis
+
+Only statically-literal axis names are checked; axis names carried in
+variables (the engine's `axis_name(mesh)` idiom) are skipped.
+""",
+)
+
+_rule(
+    "JL102",
+    "ppermute permutation is not a total permutation",
+    """
+`lax.ppermute` sends shard i's value to shard j for each `(i, j)` pair;
+a device NOT named as a destination receives ZEROS (not its own value),
+and a device named twice is undefined. A statically-enumerable `perm`
+that is not a total permutation (duplicate sources, duplicate
+destinations, or source/destination sets that differ) is therefore
+almost always a dropped-shard bug — the collective migrate ring relies
+on every hop being a bijection.
+
+    bad:
+        lax.ppermute(x, "dp", perm=[(0, 1), (2, 1)])   # 1 hit twice,
+                                                       # 0 and 2 starve
+
+    good:
+        lax.ppermute(x, "dp", perm=[(0, 1), (1, 2), (2, 0)])  # ring
+
+Only literal pair lists are checked; computed permutations (the
+`[(i, (i+1) % ndev) ...]` comprehension) are skipped.
+""",
+)
+
+_rule(
+    "JL103",
+    "per-shard reduction returned from a shard_map body through a "
+    "replicated (P()) out_spec without a psum",
+    """
+A `jnp.sum`/`jnp.max`/... inside a `shard_map` body reduces only the
+LOCAL shard. Returning that value through an out_spec of `P()` (fully
+replicated) claims all shards agree — they do not, and shard_map's
+replication checker rejects the program (or, with checking disabled,
+one shard's partial total silently wins).
+
+    bad:
+        f = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                      out_specs=P())
+        def body(x):
+            return jnp.sum(x)                 # local partial total
+
+    good:
+        def body(x):
+            return lax.psum(jnp.sum(x), "dp")  # true global total
+
+Flagged only when the out_spec at the returned position is a literal
+empty `P()`; shard-varying outputs (`P("dp")`) may carry per-shard
+reductions legitimately.
+""",
+)
+
+_rule(
+    "JL104",
+    "collective inside lax.cond/while_loop controlled by a shard-local "
+    "predicate (divergent-control hazard)",
+    """
+`lax.cond` branches and `lax.while_loop` trip counts controlled by a
+SHARD-LOCAL value can diverge across shards. If the conditionally-run
+code contains a collective, some shards enter it and some do not — the
+program deadlocks on real hardware (each participant waits for peers
+that never arrive). Predicates must be replicated: derive them from a
+`psum`/`pmin`-style reduction so every shard takes the same path.
+
+    bad:
+        def body(x):
+            n = jnp.sum(x > 0)                # per-shard count
+            return lax.cond(n > 0,
+                            lambda v: lax.psum(v, "dp"),
+                            lambda v: v, x)   # divergent psum
+
+    good:
+        def body(x):
+            n = lax.psum(jnp.sum(x > 0), "dp")  # replicated count
+            return lax.cond(n > 0,
+                            lambda v: lax.psum(v, "dp"),
+                            lambda v: v, x)
+
+Only flagged when the cond/while operand functions actually contain a
+collective — shard-local early exits of pure-local loops (the walk
+kernels) are legal SPMD.
+""",
+)
+
+_rule(
+    "JL201",
+    "Pallas BlockSpec working set exceeds the documented VMEM ceiling",
+    """
+Mosaic rejects kernels whose scoped-VMEM working set exceeds the
+compiler limit ("scoped allocation ... exceeded scoped vmem limit") —
+but only at AOT-compile time on hardware this repo usually cannot
+reach (ROADMAP "standing caveat"). This rule statically sums the
+block-resident bytes a `pl.pallas_call`'s literal BlockSpec shapes
+declare and flags working sets beyond the measured feasibility model
+(`VMEM_FEASIBLE_MAX_ELEMS` in ops/vmem_walk.py: an
+[8192, 32] f32 table block — 1 MiB of declared operand — is the
+largest block that compiles at the production particle tile).
+
+    bad:
+        pl.pallas_call(k, in_specs=[pl.BlockSpec((65536, 32),
+                                                 lambda i: (i, 0))], ...)
+
+    good:
+        pl.pallas_call(k, in_specs=[pl.BlockSpec((8192, 32),
+                                                 lambda i: (i, 0))], ...)
+
+Only statically-resolvable block dims (literals, module constants,
+simple arithmetic) are summed; runtime-sized blocks are skipped.
+""",
+)
+
+_rule(
+    "JL202",
+    "Pallas kernel writes an input ref, or reads an output ref "
+    "before writing it",
+    """
+Pallas refs have roles fixed by the `pallas_call` signature: the first
+`len(in_specs)` kernel parameters are INPUT refs (read-only views of
+operand blocks), the rest are OUTPUT refs (uninitialized until the
+kernel writes them). Writing an input ref is undefined (Mosaic may
+alias the operand); reading an output ref before any write reads
+garbage — on the interpret path it often reads zeros, so the bug only
+detonates on hardware.
+
+    bad:
+        def kernel(x_ref, o_ref):
+            x_ref[0] = 0.0                    # input-ref write
+            acc = o_ref[...]                  # read before any write
+
+    good:
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0     # seed output, then reuse
+
+Revisited-block accumulation reads ARE legal once the first grid step
+seeds the block (`pl.when(t == 0)` init) — the rule only flags reads
+that lexically precede every write in the kernel's own statement flow.
+Kernels whose in_specs are not a literal list are skipped.
+""",
+)
+
+_rule(
+    "JL203",
+    "Pallas array dimension not divisible by its BlockSpec block "
+    "dimension",
+    """
+A grid dimension covers its array extent in whole blocks; when the
+array dimension is not a multiple of the block dimension the trailing
+block reads out of bounds (masked on some backends, garbage on
+others) — and Mosaic's rank-1 tiling law additionally requires
+TILE-aligned block lengths (ops/vmem_walk.py TILE_1D). Statically
+checkable pairs are out_shape dims vs out_specs block dims.
+
+    bad:
+        pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((100,), f32),
+                       out_specs=pl.BlockSpec((64,), lambda i: (i,)))
+
+    good:
+        pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct((128,), f32),
+                       out_specs=pl.BlockSpec((64,), lambda i: (i,)))
+
+Only literal/module-constant dims are compared; runtime shapes are
+skipped.
+""",
+)
+
+_rule(
+    "JL204",
+    "host-side call inside a Pallas kernel body",
+    """
+A Pallas kernel body lowers to Mosaic; Python-level host effects —
+`print`, `open`, `time.*`, `os.*`, `logging` — run ONCE at trace time
+(misleading debug output) or fail to lower outright. Device-side
+debugging belongs to `pl.debug_print`; host-side I/O belongs outside
+the `pallas_call`. (Host SYNC calls like `.item()` are already JL001 —
+this rule covers the host-effect calls JL001's sync model does not.)
+
+    bad:
+        def kernel(x_ref, o_ref):
+            print("block", x_ref[0])          # trace-time only
+            o_ref[...] = x_ref[...]
+
+    good:
+        def kernel(x_ref, o_ref):
+            pl.debug_print("block {}", x_ref[0])
+            o_ref[...] = x_ref[...]
+""",
+)
+
+_rule(
+    "JL301",
+    "instance state written from two thread roots without a lock",
+    """
+The service layer is multi-threaded by contract (worker loop, client
+threads, signal-initiated drain — the thread-root registry in
+analysis/concurrency.py names the entry points per class). An instance
+attribute written from TWO different roots where at least one write
+holds no recognized lock is a data race: torn multi-field updates,
+lost wakeups, check-then-act corruption.
+
+    bad:
+        class Svc:
+            def start(self):                  # client root
+                self._jobs = []               # unlocked write
+            def _worker_loop(self):           # worker root
+                with self._lock:
+                    self._jobs.append(1)
+
+    good:
+        class Svc:
+            def start(self):
+                with self._lock:
+                    self._jobs = []
+            def _worker_loop(self):
+                with self._lock:
+                    self._jobs.append(1)
+
+`__init__` writes are exempt (the object is not yet shared). Locks are
+the class's own threading.Lock/RLock/Condition attributes.
+""",
+)
+
+_rule(
+    "JL302",
+    "lock-ordering cycle between recognized locks",
+    """
+Two code paths that acquire the same pair of locks in opposite orders
+deadlock the moment they interleave. The rule builds the
+acquired-while-holding graph from nested `with <lock>:` statements
+(following one level of same-class method calls) and reports any
+cycle.
+
+    bad:
+        def a(self):
+            with self._lock_a:
+                with self._lock_b: ...
+        def b(self):
+            with self._lock_b:
+                with self._lock_a: ...        # reversed order
+
+    good:
+        def b(self):
+            with self._lock_a:                # single global order
+                with self._lock_b: ...
+
+Lock identity is `ClassName.attr` (or the module-level name); the
+graph is per-module.
+""",
+)
+
+_rule(
+    "JL303",
+    "blocking call while holding a lock",
+    """
+`Future.result()`, thread `join()`, socket `recv`/`accept`,
+`queue.get()` and untimed `wait()` block indefinitely; doing so while
+holding a lock extends the critical section by an unbounded wait and
+couples it to another thread's progress — the classic shape of the
+service-layer deadlock (the worker needs the lock to produce the very
+result being waited on). The engine's own contract is the opposite:
+device work and result waits happen OUTSIDE the service lock.
+
+    bad:
+        with self._lock:
+            flux = fut.result()               # unbounded, lock held
+
+    good:
+        with self._lock:
+            fut = self._inflight.pop()
+        flux = fut.result()                   # wait outside the lock
+
+`Condition.wait(timeout)` on the HELD condition is exempt (it releases
+the lock); calls with a timeout argument are exempt (bounded).
+""",
+)
+

@@ -1,0 +1,82 @@
+"""Seeded collective-safety bugs (JL101-JL104). Parsed by jaxlint in
+tests/test_jaxlint.py, never executed. Line pins live in that test —
+keep the two in sync when editing."""
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def undeclared_axis(mesh, x):
+    # JL101: psum over "data", but the specs only declare "dp".
+    def body(x):
+        return lax.psum(x, "data")
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp")
+    )(x)
+
+
+def broken_ring(mesh, x):
+    # JL102: destination 2 appears twice / 3 never — not a permutation.
+    def body(x):
+        return lax.ppermute(
+            x, "dp", perm=[(0, 1), (1, 2), (2, 2), (3, 0)]
+        )
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp")
+    )(x)
+
+
+def unsummed_scalar(mesh, x):
+    # JL103: per-shard reduction returned through a replicated P()
+    # out_spec without a psum — each shard reports a DIFFERENT total.
+    def body(x):
+        shard_total = jnp.sum(x)
+        return x, shard_total
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=(P("dp"), P())
+    )(x)
+
+
+def divergent_branch(mesh, x):
+    # JL104: the cond predicate is shard-local, and the taken branch
+    # contains a collective — shards can disagree about entering the
+    # psum and deadlock.
+    def body(x):
+        local_mean = jnp.mean(x)
+
+        def with_collective(v):
+            return lax.psum(v, "dp")
+
+        return lax.cond(
+            local_mean > 0.0, with_collective, lambda v: v, x
+        )
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp")
+    )(x)
+
+
+def clean_reference(mesh, x):
+    # Negative control: psum'd before the replicated return — no
+    # finding. Keeps the corpus honest about false positives.
+    def body(x):
+        total = lax.psum(jnp.sum(x), "dp")
+        return x, total
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=(P("dp"), P())
+    )(x)
+
+
+__all__ = [
+    "undeclared_axis",
+    "broken_ring",
+    "unsummed_scalar",
+    "divergent_branch",
+    "clean_reference",
+]

@@ -1,0 +1,70 @@
+"""Seeded host-concurrency bugs (JL301-JL303). Parsed by jaxlint in
+tests/test_jaxlint.py, never executed. Line pins live in that test —
+keep the two in sync when editing.
+
+The class below is named ``SocketFrontend`` so that the THREAD_ROOTS
+registry in ``pumiumtally_tpu/analysis/concurrency.py`` recognizes its
+accept-loop / connection / client entry points; JL301 only analyzes
+registered classes.
+"""
+
+import threading
+
+
+class SocketFrontend:
+    # JL301 target: `served` is written by the accept thread AND by
+    # client calls, and the accept-thread write takes no lock.
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = 0
+
+    def _accept_loop(self):
+        while True:
+            self._serve_conn()
+            self.served += 1
+
+    def _serve_conn(self):
+        pass
+
+    def reset_stats(self):
+        with self._lock:
+            self.served = 0
+
+
+class OrderedLocks:
+    # JL302 target: ab() takes _a then _b, ba() takes _b then _a —
+    # a classic ordering cycle that deadlocks under contention.
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.state = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.state += 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                self.state -= 1
+
+
+class BlockingHolder:
+    # JL303 target: an unbounded Future.result() while holding the
+    # lock every producer needs to make progress.
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._pool = pool
+        self.last = None
+
+    def flush(self, job):
+        with self._lock:
+            fut = self._pool.submit(job)
+            self.last = fut.result()
+
+    def flush_bounded(self, job):
+        # Negative control: a timeout bounds the wait — no finding.
+        with self._lock:
+            fut = self._pool.submit(job)
+            self.last = fut.result(timeout=5.0)

@@ -1,0 +1,87 @@
+"""Seeded Pallas-kernel bugs (JL201-JL204). Parsed by jaxlint in
+tests/test_jaxlint.py, never executed. Line pins live in that test —
+keep the two in sync when editing."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 16384  # 4x the vmem_walk feasibility model's element budget
+
+
+def oversized_block(x):
+    # JL201: one f32 input block + one f32 output block of TILE*32
+    # elements each blows past VMEM_BLOCK_BUDGET_BYTES.
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((TILE, 32), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((TILE, 32), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((4 * TILE, 32), jnp.float32),
+    )(x)
+
+
+def input_ref_write(x):
+    # JL202: writes the INPUT ref (silently dropped on TPU) and reads
+    # the output ref before ever writing it (garbage VMEM).
+    def kernel(x_ref, o_ref):
+        x_ref[0] = 0.0
+        acc = o_ref[...]
+        o_ref[...] = acc + x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((128,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((512,), jnp.float32),
+    )(x)
+
+
+def ragged_grid(x):
+    # JL203: out_shape dim 500 is not divisible by the block dim 128.
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((128,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((500,), jnp.float32),
+    )(x)
+
+
+def chatty_kernel(x):
+    # JL204: host call inside the kernel body — traces once at lower
+    # time (misleading) and is unsupported in the compiled kernel.
+    def kernel(x_ref, o_ref):
+        print("block", x_ref.shape)
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((128,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((512,), jnp.float32),
+    )(x)
+
+
+def clean_reference(x):
+    # Negative control: small block, write-before-read on the output
+    # ref, divisible dims, no host calls — no finding.
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+        o_ref[...] = o_ref[...] + 1.0
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((128,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((512,), jnp.float32),
+    )(x)

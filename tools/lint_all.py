@@ -6,16 +6,21 @@ Runs, in order:
 
 1. ruff  — ``ruff check pumiumtally_tpu/ tests/ bench.py`` (the pinned
    generic Python linter; CI pins ``ruff==X`` and pyproject's ``dev``
-   extra carries the same pin — this script warns when the local ruff
-   version drifts from that pin, since a drifted local can pass rules
-   CI fails or vice versa). Skipped with a warning when ruff is not
-   installed (``pip install -e .[dev]`` provides it).
+   extra carries the same pin). A local ruff whose version drifts from
+   that pin is a FAILURE, not a warning: a drifted local can pass
+   rules CI fails (or vice versa), which silently un-predicts CI.
+   Skipped with a warning when ruff is not installed
+   (``pip install -e .[dev]`` provides the pinned version).
 2. jaxlint — ``python -m pumiumtally_tpu.analysis pumiumtally_tpu/
-   bench.py`` (the JAX-aware trace-safety analyzer; rules JL001–JL005,
-   docs/STATIC_ANALYSIS.md). Always available: pure stdlib.
+   bench.py ...`` (the JAX-aware static analyzer; trace safety JL00x,
+   collective safety JL1xx, Pallas kernels JL2xx, host concurrency
+   JL3xx — docs/STATIC_ANALYSIS.md). Always available: pure stdlib.
+3. contract audit — ``python -m pumiumtally_tpu.analysis --contracts``
+   (the five tally facades vs the shared hook surface; a missing hook
+   fails, signature drift is reported but does not).
 
 This is the documented pre-PR check (README). Exit status is non-zero
-if ANY linter that ran found issues; a missing ruff does not mask a
+if ANY stage that ran found issues; a missing ruff does not mask a
 jaxlint failure (and vice versa). clang-tidy (the native layer's
 linter) is CI-only — it needs a system toolchain this script does not
 assume.
@@ -73,12 +78,16 @@ def run_ruff() -> int | None:
         [ruff, "--version"], capture_output=True, text=True
     ).stdout.strip().split()[-1]
     if pin and local != pin:
+        # A drifted ruff makes this script's verdict meaningless as a
+        # CI predictor, so drift FAILS — with the one command that
+        # fixes it.
         print(
-            f"lint_all: WARNING — local ruff {local} != pinned {pin} "
-            "(pyproject [dev] / static-analysis.yml); results may "
-            "differ from CI",
+            f"lint_all: FAIL — local ruff {local} != pinned {pin}; "
+            f"run `pip install ruff=={pin}` to match CI "
+            "(pin lives in pyproject [dev] + static-analysis.yml)",
             file=sys.stderr,
         )
+        return 1
     print(f"lint_all: ruff check {' '.join(RUFF_TARGETS)}")
     return subprocess.run([ruff, "check", *RUFF_TARGETS], cwd=REPO).returncode
 
@@ -94,8 +103,17 @@ def run_jaxlint() -> int:
     ).returncode
 
 
+def run_contracts() -> int:
+    print("lint_all: jaxlint --contracts (facade hook-surface audit)")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "jaxlint.py"),
+         "--contracts"],
+        cwd=REPO,
+    ).returncode
+
+
 def main() -> int:
-    codes = [run_ruff(), run_jaxlint()]
+    codes = [run_ruff(), run_jaxlint(), run_contracts()]
     ran = [c for c in codes if c is not None]
     if any(ran):
         print("lint_all: FAILED", file=sys.stderr)
