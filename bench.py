@@ -621,6 +621,25 @@ def run_distributed_ab() -> dict | None:
     )
 
 
+def run_placement_ab() -> dict | None:
+    """Component row: topology-aware pod placement (r19,
+    tools/exp_placement_ab.py run_ab) — linear vs pod_rcb element
+    ownership on the pinned 2-host virtual layout. The tool asserts
+    the equal-host degeneracy pin (bitwise), the pinned cross-arm
+    equivalence class (positions bitwise, element-id diffs
+    boundary-ties only, total flux conserved) and the STRICT modeled
+    cross-host byte drop BEFORE timing; then fenced per-move ms both
+    arms, interleaved, with the compiles-healthy contract
+    (``compiles.timed == 0``). Reduced shape like the other component
+    rows; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_placement_ab
+
+    return exp_placement_ab.run_ab(n=min(N, 50_000), moves=2)
+
+
 def run_redistribution_ab() -> dict | None:
     """Component row: argsort-vs-counting-rank redistribution cost at
     bench scale (tools/exp_partition_ab.py) — one packed cascade stage
@@ -1062,6 +1081,12 @@ def _measure_and_report() -> None:
             distributed = run_distributed_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# distributed A/B failed: {e}", file=sys.stderr)
+    placement = None
+    if os.environ.get("PUMIUMTALLY_BENCH_PLACEMENT", "1") != "0":
+        try:
+            placement = run_placement_ab()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# placement A/B failed: {e}", file=sys.stderr)
     pallas_walk = None
     if os.environ.get("PUMIUMTALLY_BENCH_PALLAS_WALK", "1") != "0":
         try:
@@ -1236,6 +1261,15 @@ def _measure_and_report() -> None:
         # "available": false without gloo), and the compiles-healthy
         # contract (compiles.timed == 0).
         "distributed": distributed,
+        # Topology-aware pod placement (r19): linear vs pod_rcb on the
+        # pinned 2-host virtual layout (host chips (3,5)). The class
+        # gate runs inside the tool before timing (positions bitwise,
+        # elem-id diffs boundary-ties only, total flux conserved), the
+        # modeled cross-host migration bytes must STRICTLY drop, and
+        # compiles.timed == 0. The CPU rate delta prices every block
+        # boundary equally and is expected against pod_rcb — the
+        # ship/kill call uses the on-chip suite's placement_ab stage.
+        "placement": placement,
         # One-kernel Pallas walk (r17): fused select/refine/scatter
         # with streamed block tables vs the bf16 gather sub-split,
         # interpret-mode bitwise pin + bitwise positions between arms

@@ -93,6 +93,8 @@ class PartitionedPumiTally(PumiTally):
             cap_frontier=self.config.cap_frontier,
             scoring=self.config.scoring,
             migrate_collective=self.config.migrate_collective,
+            placement=self.config.placement,
+            placement_hosts=self.config.placement_hosts,
         )
         self._wire_engine_hooks(self.engine)
         # Scoring runtime AFTER the engine: the DROP sentinel needs the

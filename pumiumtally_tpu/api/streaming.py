@@ -736,13 +736,34 @@ class StreamingPartitionedTally(StreamingTally):
             )
         else:
             vmem_bound = self.config.walk_vmem_max_elems
+        bpc = derive_blocks_per_chip(
+            mesh.nelems, per,
+            block_elems_bound(vmem_bound, self._table_dtype),
+        )
+        # The chunk engines share ONE prebuilt partition, so the
+        # placement knob shapes it HERE (engines refuse to re-derive a
+        # placement for a part= they did not build). Host chip counts
+        # apply per GROUP mesh — every group has ``per`` devices.
+        if self.config.placement == "pod_rcb":
+            if self.config.placement_hosts is not None:
+                host_chips = tuple(
+                    int(h) for h in self.config.placement_hosts
+                )
+            else:
+                from pumiumtally_tpu.parallel.distributed import (
+                    derive_host_counts,
+                )
+
+                host_chips = derive_host_counts(group_meshes[0])
+            hosts = [h * bpc for h in host_chips]
+        else:
+            hosts = None
         part = build_partition(
             mesh,
-            per * derive_blocks_per_chip(
-                mesh.nelems, per,
-                block_elems_bound(vmem_bound, self._table_dtype),
-            ),
+            per * bpc,
             table_dtype=self._table_dtype,
+            placement=self.config.placement,
+            hosts=hosts,
         )
         caches = [dict() for _ in range(ngroups)]
         # Each engine is sized to its chunk's REAL particle count (a
@@ -767,6 +788,8 @@ class StreamingPartitionedTally(StreamingTally):
                 cap_frontier=self.config.cap_frontier,
                 scoring=self.config.scoring,
                 migrate_collective=self.config.migrate_collective,
+                placement=self.config.placement,
+                placement_hosts=self.config.placement_hosts,
             ))
         # Scoring runtime AFTER the engines: the DROP sentinel needs
         # the shared partition's PADDED lane-bank size (every chunk

@@ -79,6 +79,14 @@ RETRACE_BUDGETS: dict = {
     # tests drive three engines back to back but the pallas round
     # program is one phase variant per engine, compiled once — so the
     # budget holds unchanged again.
+    # Re-measured in r19 after placement and the composed
+    # cap_frontier x migrate_collective pair joined the phase key
+    # (PUMIUMTALLY_RETRACE_RECORD over tests/test_placement.py +
+    # tests/test_distributed.py): max 6 — the frontier-collective
+    # parity tests drive on/off engine pairs across four perm modes
+    # and the placement tests linear/pod_rcb pairs, but every
+    # composed program is one phase variant per engine, compiled
+    # once — so the budget holds unchanged again.
     "cascade_phase": 12,
     # Profiled-phase programs (parallel/partition.py component-budget
     # instrumentation): one jitted single-round program per
@@ -305,12 +313,36 @@ class TallyConfig:
     # tests/test_distributed.py): on a multi-process global mesh a
     # particle leaving a host-owned block lands on the owning host in
     # one launch with the traffic explicit per hop, where the GSPMD
-    # scatter lowering is whatever this jaxlib chose. Only the
-    # full-capacity migrate exists collectively, so combining with
-    # cap_frontier refuses at construction. False (default) keeps the
-    # historical scatter — bitwise and allocation-identical to
-    # pre-round-13 builds.
+    # scatter lowering is whatever this jaxlib chose. Composes with
+    # cap_frontier since round 19: frontier rounds ride the ring at
+    # cap_frontier rows (make_collective_frontier_migrate), slab
+    # overflows fall back to the full-capacity collective, and
+    # cap_frontier=0 forces the full-capacity collective every round
+    # bit-for-bit. False (default) keeps the historical scatter —
+    # bitwise and allocation-identical to pre-round-13 builds.
     migrate_collective: bool = False
+    # Partitioned engines only (round 19): element-block placement
+    # strategy. "linear" (default) is the flat coordinate-RCB in block
+    # order — byte-identical to pre-round-19 builds. "pod_rcb" builds
+    # ownership by HOST-hierarchical RCB (parallel/partition.py
+    # pod_rcb_partition): the domain splits across hosts first
+    # (process boundaries on the global mesh, or placement_hosts for
+    # virtual layouts), then across each host's chips — so migration
+    # traffic crosses hosts only where the mesh geometry does.
+    # placement_hosts: per-HOST chip counts in mesh device order
+    # (e.g. (3, 5) carves an 8-device mesh into two virtual hosts);
+    # None derives them from the mesh's process boundaries
+    # (distributed.derive_host_counts). The layout describes the
+    # MACHINE, not the strategy: "linear" ignores it for ownership but
+    # the cross-host diagnostic still evaluates under it (the A/B's
+    # baseline arm). Same scatter-order equivalence
+    # class as cap_frontier: conservation and per-particle observables
+    # unchanged, slot layout differs. The modeled cross-host bytes of
+    # a placement are deterministic diagnostics
+    # (PartitionedEngine.modeled_cross_host_bytes,
+    # tools/exp_placement_ab.py).
+    placement: str = "linear"
+    placement_hosts: Optional[tuple] = None
     # Walk-kernel tuning knobs (ops/walk.py) — exposed so a deployment
     # can adopt the best measured configuration for its chip without
     # code changes. Defaults = the kernel's own defaults (None = leave
@@ -635,12 +667,21 @@ class TallyConfig:
                 f"cap_frontier must be >= 0 (0 = forced full-capacity "
                 f"fallback) or None, got {self.cap_frontier!r}"
             )
-        if self.migrate_collective and self.cap_frontier is not None:
+        if self.placement not in ("linear", "pod_rcb"):
             raise ValueError(
-                "migrate_collective=True lowers only the full-capacity "
-                "migrate to collectives; it cannot combine with the "
-                "cap_frontier slab — unset one of them"
+                f"placement must be 'linear' or 'pod_rcb', "
+                f"got {self.placement!r}"
             )
+        if self.placement_hosts is not None:
+            hosts = tuple(self.placement_hosts)
+            if not hosts or any(
+                not isinstance(h, int) or h < 1 for h in hosts
+            ):
+                raise ValueError(
+                    "placement_hosts must be a non-empty tuple of "
+                    f"positive per-host chip counts, "
+                    f"got {self.placement_hosts!r}"
+                )
 
     def resolved_min_window(self) -> int:
         """min_window with the kernel default applied (consumed, with

@@ -267,6 +267,68 @@ def modeled_migration_collective_bytes(
     return keys + (ndev - 1) * slab
 
 
+def derive_host_counts(device_mesh: Mesh) -> tuple:
+    """Chips per host (jax process), in mesh device order — the host
+    geometry ``placement="pod_rcb"`` aligns element ownership to.
+
+    The pod placement contract rests on hosts owning CONTIGUOUS device
+    ranges (so contiguous part ranges): a mesh whose device order
+    interleaves processes is refused rather than silently mis-modeled.
+    Single-process meshes (tier-1's 8 virtual devices) answer
+    ``(ndev,)`` — one "host" owning everything; virtual multi-host
+    layouts come from ``TallyConfig.placement_hosts`` instead."""
+    procs = [int(d.process_index) for d in device_mesh.devices.flat]
+    counts: list = []
+    order: list = []
+    for p in procs:
+        if order and p == order[-1]:
+            counts[-1] += 1
+            continue
+        if p in order:
+            raise ValueError(
+                f"device mesh interleaves process {p}'s devices — "
+                "pod_rcb placement needs hosts contiguous in mesh "
+                f"device order (process sequence {procs})"
+            )
+        order.append(p)
+        counts.append(1)
+    return tuple(counts)
+
+
+def modeled_cross_host_migration_bytes(
+    remote_faces,
+    blocks_per_chip: int,
+    host_counts,
+    float_cols: int,
+    int_cols: int,
+    float_bytes: int = 8,
+) -> int:
+    """Modeled per-round CROSS-HOST migration bytes of a partition
+    under its host layout — the placement-quality diagnostic
+    ``placement="pod_rcb"`` exists to minimize.
+
+    Each directed cross-part face (``MeshPartition.remote_faces``: part
+    a exposes ``n`` element faces to part b) is one potential migrating
+    row per round; a row bound from a's device to b's device rides the
+    host-level ring, paying one packed-row transfer
+    (``state_pack_columns`` widths + the int32 destination lane, the
+    same row the collective actually ships) per host-boundary hop —
+    ``(host_b - host_a) mod nhosts`` crossings. Faces between parts on
+    one host cost zero DCN; single-host layouts answer 0. Deterministic
+    from the partition + host geometry — compare ``placement`` arms
+    without running anything (tools/exp_placement_ab.py)."""
+    host_counts = [int(h) for h in host_counts]
+    host_of_dev = np.repeat(np.arange(len(host_counts)), host_counts)
+    nhosts = len(host_counts)
+    row_bytes = float_cols * float_bytes + int_cols * 4 + 4
+    total = 0
+    for a, b, n in np.asarray(remote_faces):
+        ha = int(host_of_dev[int(a) // int(blocks_per_chip)])
+        hb = int(host_of_dev[int(b) // int(blocks_per_chip)])
+        total += int(n) * ((hb - ha) % nhosts) * row_bytes
+    return int(total)
+
+
 def _defaults_like(state: dict) -> dict:
     """Dead-slot defaults with the SAME values as
     ``partition._default_state`` (alive False, done True, pending/pid
@@ -419,3 +481,198 @@ def make_collective_migrate(
         )(state)
 
     return collective_migrate
+
+
+def make_collective_frontier_migrate(
+    device_mesh: Mesh,
+    *,
+    part_L: int,
+    nparts: int,
+    cap_per_block: int,
+    cap_frontier: int,
+    partition_method: str = "rank",
+):
+    """Frontier-slab migration as the SAME 5-step collective program —
+    ``fn(state) -> (new_state, overflow, departures, arrivals)``,
+    bitwise equal to ``partition._frontier_migrate_impl`` (round 18's
+    composition of the two migrate optimizations: PR 4's slab, PR 12's
+    ring).
+
+    ``make_collective_migrate``'s ppermute ring hands FULL-CAPACITY
+    packed slabs around the axis every round; here the ring carries
+    ``cap_frontier`` rows — the crossing front — so cross-host traffic
+    scales with the front like the on-chip slab path does. Per shard:
+
+    1. ``all_gather(tiled)`` reassembles the [cap] ``pending``/``alive``
+       lanes (int32/bool bookkeeping — a few bytes per slot, the same
+       O(cap) lane the impl keeps on chip);
+    2. every shard replays the impl's GLOBAL machinery on those
+       identical inputs — stable binary-partition compaction, the
+       stayer-fixed free-slot prefix sums, the slab-sized counting rank
+       — integer math, hence bit-identical src/dest/overflow on every
+       shard;
+    3. each shard clears ITS departing slots to default rows and builds
+       a ``cap_frontier``-row outgoing slab from its local packs
+       (arrival fixups — ``lelem = pending % part_L``, ``pending = -1``
+       — applied to the packed int columns; rows it does not own get
+       the drop sentinel ``cap``);
+    4. the slab rides the ``ndev``-hop ppermute ring; every shard keeps
+       the visiting rows whose destination slot it owns (destinations
+       unique ⇒ arrival order cannot matter);
+    5. overflow (an arrival rank reaching its part's free-slot count)
+       latches with an int psum, committing the pre-migrate shards
+       verbatim — the recovery ladder's contract; departure/arrival
+       counts psum from per-shard partial bincounts over owned slab
+       rows, feeding the incremental occupancy bookkeeping unchanged.
+
+    The caller guarantees ``n_pending <= cap_frontier`` exactly as for
+    the impl (``_inloop_migrate_step``'s slab-overflow cond falls back
+    to the full-capacity collective).
+    """
+    from pumiumtally_tpu.parallel.partition import (
+        _pack_state,
+        _unpack_state,
+    )
+    from pumiumtally_tpu.ops.bucketize import (
+        counting_ranks,
+        partition_perm,
+    )
+
+    ax = axis_name(device_mesh)
+    ndev = int(device_mesh.devices.size)
+    cap = nparts * cap_per_block
+    if cap % ndev:
+        raise ValueError(
+            f"capacity {cap} is not divisible by the {ndev}-device mesh"
+        )
+    n_loc = cap // ndev
+    cf = int(cap_frontier)
+    if not 0 < cf <= cap:
+        raise ValueError(
+            f"cap_frontier {cf} must be in 1..{cap} for the collective "
+            "slab (0 dispatches to the full-capacity collective "
+            "upstream)"
+        )
+    ring = [(i, (i + 1) % ndev) for i in range(ndev)]
+
+    def shard_body(state):
+        # -- steps 1+2: global bookkeeping lanes, replayed bit-
+        # identically on every shard from the gathered inputs.
+        pend_g = lax.all_gather(state["pending"], ax, tiled=True)
+        alive_g = lax.all_gather(state["alive"], ax, tiled=True)
+        moving = pend_g >= 0
+        iota = jnp.cumsum(jnp.ones_like(pend_g)) - 1
+        my_base = lax.axis_index(ax).astype(iota.dtype) * n_loc
+        slot_part = iota // cap_per_block
+        perm, counts, _ = partition_perm(
+            (~moving).astype(jnp.int32), 2, method=partition_method
+        )
+        n_move = counts[0]
+        src = perm[:cf]
+        slab_iota = jnp.cumsum(jnp.ones_like(src)) - 1
+        valid = slab_iota < n_move
+        fint = ((~alive_g) | moving).astype(jnp.int32)
+        excl = jnp.cumsum(fint) - fint
+        part_base = excl.reshape(nparts, cap_per_block)[:, 0]
+        free_rank = excl - part_base[slot_part]
+        n_free = jnp.sum(fint.reshape(nparts, cap_per_block), axis=1)
+        fdest = jnp.where(
+            fint == 1, slot_part * cap_per_block + free_rank, cap
+        )
+        free_list = jnp.full((cap,), cap, iota.dtype).at[fdest].set(
+            iota, mode="drop"
+        )
+        pend_slab = pend_g[src]
+        tgt = jnp.clip(pend_slab // part_L, 0, nparts - 1)
+        key = jnp.where(valid, tgt, nparts)
+        rank = counting_ranks(key, nparts + 1, method=partition_method)
+        ovf_any = jnp.any(valid & (rank >= n_free[tgt]))
+        overflow = lax.psum(ovf_any.astype(jnp.int32), ax) > 0
+        ridx = tgt * cap_per_block + jnp.minimum(rank, cap_per_block - 1)
+        dest = jnp.where(valid, free_list[ridx], cap).astype(iota.dtype)
+
+        # -- step 3: local clear + owned outgoing slab.
+        fpack, ipack, fdef, idef, layout = _pack_state(
+            state, _defaults_like(state)
+        )
+        lelem_off = pend_off = None
+        for k, _kind, start, _ncols, _dtype, _tail in layout:
+            if k == "lelem":
+                lelem_off = start
+            elif k == "pending":
+                pend_off = start
+        own = valid & (src >= my_base) & (src < my_base + n_loc)
+        gidx = jnp.clip(src - my_base, 0, n_loc - 1)
+        slab_f = fpack[gidx]
+        slab_i = ipack[gidx]
+        lelem_rows = jnp.where(
+            valid, pend_slab % part_L, jnp.zeros_like(pend_slab)
+        )
+        slab_i = slab_i.at[:, lelem_off].set(
+            lelem_rows.astype(slab_i.dtype)
+        )
+        slab_i = slab_i.at[:, pend_off].set(
+            jnp.where(
+                valid,
+                jnp.asarray(-1, slab_i.dtype),
+                slab_i[:, pend_off],
+            )
+        )
+        slab_d = jnp.where(own, dest, cap).astype(iota.dtype)
+        clear_idx = jnp.where(own, src - my_base, n_loc)
+        def_f = jnp.broadcast_to(fdef[:1], (cf,) + fdef.shape[1:])
+        def_i = jnp.broadcast_to(idef[:1], (cf,) + idef.shape[1:])
+        acc_f = fpack.at[clear_idx].set(def_f, mode="drop")
+        acc_i = ipack.at[clear_idx].set(def_i, mode="drop")
+
+        # -- step 4: the slab-sized ring scatter (clear-before-place:
+        # an arrival's destination may be a vacated slot).
+        def hop(_s, carry):
+            acc_f, acc_i, vis_f, vis_i, vis_d = carry
+            mine = (vis_d >= my_base) & (vis_d < my_base + n_loc)
+            idx = jnp.where(mine, vis_d - my_base, n_loc)
+            acc_f = acc_f.at[idx].set(vis_f, mode="drop")
+            acc_i = acc_i.at[idx].set(vis_i, mode="drop")
+            return (
+                acc_f,
+                acc_i,
+                lax.ppermute(vis_f, ax, ring),
+                lax.ppermute(vis_i, ax, ring),
+                lax.ppermute(vis_d, ax, ring),
+            )
+
+        acc_f, acc_i, _vf, _vi, _vd = lax.fori_loop(
+            0, ndev, hop, (acc_f, acc_i, slab_f, slab_i, slab_d)
+        )
+        new_state = _unpack_state(acc_f, acc_i, layout)
+
+        # -- step 5: occupancy deltas + the overflow-safe commit.
+        dep = lax.psum(
+            jnp.bincount(
+                jnp.where(own, src // cap_per_block, nparts),
+                length=nparts + 1,
+            )[:nparts],
+            ax,
+        ).astype(jnp.int32)
+        arr = lax.psum(
+            jnp.bincount(
+                jnp.where(own, key, nparts), length=nparts + 1
+            )[:nparts],
+            ax,
+        ).astype(jnp.int32)
+        new_state = {
+            k: jnp.where(overflow, state[k], v)
+            for k, v in new_state.items()
+        }
+        return new_state, overflow, dep, arr
+
+    def collective_frontier_migrate(state):
+        return shard_map(
+            shard_body,
+            mesh=device_mesh,
+            in_specs=(P(ax),),
+            out_specs=({k: P(ax) for k in state}, P(), P(), P()),
+            **shard_map_check_kwargs(),
+        )(state)
+
+    return collective_frontier_migrate

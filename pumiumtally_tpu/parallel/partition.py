@@ -129,6 +129,74 @@ def rcb_partition(centroids: np.ndarray, nparts: int) -> np.ndarray:
     return owner
 
 
+PLACEMENTS = ("linear", "pod_rcb")
+
+
+def pod_rcb_partition(
+    centroids: np.ndarray, nparts: int, host_parts
+) -> np.ndarray:
+    """owner[E] via HIERARCHICAL recursive coordinate bisection: hosts
+    first, then parts within each host (round 19, docs/DESIGN.md
+    "Topology-aware placement").
+
+    ``host_parts`` lists how many of the ``nparts`` parts each host
+    owns, in mesh device order (hosts own contiguous part ranges —
+    ``derive_host_counts`` enforces the device-order contiguity this
+    rests on). The element set is first bisected recursively across the
+    HOST list, each cut sized proportional to the part counts on either
+    side, then flat-RCB'd within each host's region — so spatially
+    adjacent parts land on the same host except across the few
+    host-region boundaries, and cross-host particle migration is
+    confined to where the mesh geometry actually crosses hosts.
+
+    Split arithmetic (axis choice, stable argsort, proportional
+    rounding) is IDENTICAL to ``rcb_partition``; when every host
+    boundary aligns with the flat binary recursion tree (e.g. two equal
+    hosts — the top flat split IS the host boundary) the two functions
+    are bitwise-equal, which is the degeneracy pin in
+    tests/test_placement.py. They differ exactly when a host boundary
+    is misaligned (unequal hosts), where the flat tree would cut
+    through a host's region.
+    """
+    host_parts = [int(h) for h in host_parts]
+    if any(h < 1 for h in host_parts) or sum(host_parts) != nparts:
+        raise ValueError(
+            f"host_parts {host_parts} must be positive and sum to the "
+            f"{nparts}-part partition"
+        )
+    ne = centroids.shape[0]
+    owner = np.zeros(ne, dtype=np.int32)
+
+    def split(idx: np.ndarray, nl: int, nr: int):
+        c = centroids[idx]
+        axis = int(np.argmax(c.max(axis=0) - c.min(axis=0)))
+        order = np.argsort(c[:, axis], kind="stable")
+        at = int(round(len(idx) * nl / (nl + nr)))
+        return idx[order[:at]], idx[order[at:]]
+
+    def rec_parts(idx: np.ndarray, first_part: int, np_h: int) -> None:
+        if np_h == 1:
+            owner[idx] = first_part
+            return
+        nl = np_h // 2
+        li, ri = split(idx, nl, np_h - nl)
+        rec_parts(li, first_part, nl)
+        rec_parts(ri, first_part + nl, np_h - nl)
+
+    def rec_hosts(idx: np.ndarray, hosts, first_part: int) -> None:
+        if len(hosts) == 1:
+            rec_parts(idx, first_part, hosts[0])
+            return
+        nh = len(hosts) // 2
+        left, right = hosts[:nh], hosts[nh:]
+        li, ri = split(idx, sum(left), sum(right))
+        rec_hosts(li, left, first_part)
+        rec_hosts(ri, right, first_part + sum(left))
+
+    rec_hosts(np.arange(ne), host_parts, 0)
+    return owner
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshPartition:
     """Per-chip mesh shards + id mappings (host-built, device-resident)."""
@@ -156,6 +224,12 @@ class MeshPartition:
     # row glid*4 + f, gathered ONCE per crossing for the winning face
     # only.
     table_hi: Any = None  # [ndev*L*4, WALK_PLANE_WIDTH]
+    # Directed cross-part face census [(src_part, dst_part, nfaces)],
+    # host numpy (round 19): the static input of the modeled cross-host
+    # migration-bytes diagnostic (distributed.py
+    # modeled_cross_host_migration_bytes). Host-side only — no device
+    # allocation rides on it.
+    remote_faces: Any = None  # [K, 3] int64
 
     def flux_to_original(self, flux_padded: jnp.ndarray) -> jnp.ndarray:
         """Reorder an owned [ndev*L] flux into original element order."""
@@ -239,6 +313,8 @@ def build_partition(
     dtype: Optional[Any] = None,
     force_split_adj: bool = False,
     table_dtype: str = "float32",
+    placement: str = "linear",
+    hosts=None,
 ) -> MeshPartition:
     """Partition ``mesh`` into ``ndev`` contiguous padded element blocks.
 
@@ -252,7 +328,17 @@ def build_partition(
     therefore fit the float dtype exactly, the SAME ceiling as the
     packed in-row encoding; past it the two-tier build refuses (use
     the f32 layout, whose int32 sidecar has no ceiling).
+
+    ``placement`` (round 19): ``"linear"`` (default) keeps the flat
+    ``rcb_partition`` ownership — bitwise-identical to every earlier
+    build; ``"pod_rcb"`` bisects across ``hosts`` first (per-PART
+    counts, device order) so cross-host adjacency is confined to where
+    the host geometry cuts the mesh (``pod_rcb_partition``).
     """
+    if placement not in PLACEMENTS:
+        raise ValueError(
+            f"placement must be one of {PLACEMENTS}, got {placement!r}"
+        )
     if dtype is None:
         dtype = mesh.coords.dtype
     coords = np.asarray(mesh.coords, dtype=np.float64)
@@ -263,7 +349,15 @@ def build_partition(
     ne = tet2vert.shape[0]
     centroids = coords[tet2vert].mean(axis=1)
 
-    owner = rcb_partition(centroids, ndev)
+    if placement == "pod_rcb":
+        if hosts is None:
+            raise ValueError(
+                "placement='pod_rcb' needs hosts= (per-host part "
+                "counts in device order)"
+            )
+        owner = pod_rcb_partition(centroids, ndev, hosts)
+    else:
+        owner = rcb_partition(centroids, ndev)
     counts = np.bincount(owner, minlength=ndev)
     L = int(counts.max())
     # Remote faces encode -(glid+2) with glid < ndev*L, so THAT is the
@@ -302,6 +396,14 @@ def build_partition(
     nb = face_adj  # [E,4] original ids, -1 boundary
     nb_owner = np.where(nb >= 0, owner[np.clip(nb, 0, ne - 1)], -1)
     nb_glid = np.where(nb >= 0, glid_of_orig[np.clip(nb, 0, ne - 1)], -1)
+    # Directed cross-part face census — how many element faces part a
+    # exposes to part b. Placement-dependent (the whole point of
+    # pod_rcb) and the static migration-volume proxy behind
+    # distributed.modeled_cross_host_migration_bytes. Host numpy only.
+    cross = (nb >= 0) & (nb_owner != owner[:, None])
+    pair_key = owner[:, None].astype(np.int64) * ndev + nb_owner
+    pair, nfaces = np.unique(pair_key[cross], return_counts=True)
+    remote_faces = np.stack([pair // ndev, pair % ndev, nfaces], axis=1)
     same = nb_owner == owner[:, None]
     local_adj = np.where(
         nb < 0,
@@ -353,6 +455,7 @@ def build_partition(
         table=table,
         adj_int=adj_int,
         table_hi=table_hi,
+        remote_faces=remote_faces,
     )
 
 
@@ -936,7 +1039,8 @@ def _frontier_migrate_impl(part_L: int, nparts: int, cap_per_chip: int,
 
 def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
                    cap_frontier, pmethod: str, state: dict,
-                   n_pending: jnp.ndarray, collective_fn=None):
+                   n_pending: jnp.ndarray, collective_fn=None,
+                   frontier_collective_fn=None):
     """One in-loop migration round: the frontier slab when the crossing
     front fits ``cap_frontier``, else the full-capacity
     ``_migrate_impl`` (today's semantics, bitwise — it also re-compacts
@@ -954,9 +1058,15 @@ def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
     full-capacity global scatter with the explicit
     all_gather + ppermute-ring collective — same
     ``(state) -> (state, overflow)`` contract, bitwise-equal result.
-    Only the full-capacity form exists collectively (config forbids
-    combining the knob with the frontier slab), so the default-``None``
-    trace is byte-identical to pre-round-13 builds."""
+    ``frontier_collective_fn`` (round 18) completes the composition:
+    a ``distributed.make_collective_frontier_migrate`` closure with
+    ``_frontier_migrate_impl``'s ``(state) -> (state, overflow, dep,
+    arr)`` contract, bitwise-equal, whose ppermute ring carries
+    ``cap_frontier`` rows instead of full capacity; the slab-overflow
+    cond below then falls back to the FULL-capacity collective, so a
+    collective build never mixes collective and on-chip rounds. Both
+    default ``None``, keeping the default trace byte-identical to
+    pre-round-13 builds."""
     z = jnp.zeros((nparts,), jnp.int32)
     if cap_frontier is None or cap_frontier == 0:
         if collective_fn is not None:
@@ -967,11 +1077,16 @@ def _migrate_round(part_L: int, nparts: int, cap_per_chip: int,
         return st, ovf, z, z, jnp.asarray(True)
 
     def full(st):
-        st2, ovf = _migrate_impl(part_L, nparts, cap_per_chip, st,
-                                 pmethod)
+        if collective_fn is not None:
+            st2, ovf = collective_fn(st)
+        else:
+            st2, ovf = _migrate_impl(part_L, nparts, cap_per_chip, st,
+                                     pmethod)
         return st2, ovf, z, z
 
     def frontier(st):
+        if frontier_collective_fn is not None:
+            return frontier_collective_fn(st)
         return _frontier_migrate_impl(part_L, nparts, cap_per_chip,
                                       cap_frontier, st, pmethod)
 
@@ -1000,14 +1115,14 @@ def _update_occupancy(nparts: int, cap_frontier, state: dict,
 def _inloop_migrate_step(part_L: int, nparts: int, cap_per_chip: int,
                          cap_frontier, pmethod: str, state: dict,
                          n_act: jnp.ndarray, n_pending: jnp.ndarray,
-                         collective_fn=None):
+                         collective_fn=None, frontier_collective_fn=None):
     """Migration + occupancy bookkeeping for one phase-loop round —
     the composition the fused phase program inlines; the profiled
     driver dispatches the same two pieces separately so each section
     can be fenced and timed."""
     st, ovf, dep, arr, fellback = _migrate_round(
         part_L, nparts, cap_per_chip, cap_frontier, pmethod, state,
-        n_pending, collective_fn,
+        n_pending, collective_fn, frontier_collective_fn,
     )
     n_act2 = _update_occupancy(nparts, cap_frontier, st, n_act, dep,
                                arr, fellback)
@@ -1177,6 +1292,8 @@ class PartitionedEngine:
         cap_frontier: Optional[int] = None,
         scoring=None,
         migrate_collective: bool = False,
+        placement: str = "linear",
+        placement_hosts=None,
     ):
         """``part`` reuses a prebuilt partition (chunked engines over
         the same mesh share one); ``shared_jit_cache`` shares the
@@ -1216,7 +1333,19 @@ class PartitionedEngine:
         its round programs. The VMEM one-hot block kernel has no
         scoring lowering; a scoring-armed engine routes blocked walks
         through the gather kernel (same reroute as the bf16 tier) and
-        never uses the vmem walk."""
+        never uses the vmem walk.
+
+        ``placement``/``placement_hosts`` (round 19,
+        TallyConfig.placement): ``"pod_rcb"`` builds element-block
+        ownership by host-hierarchical RCB (``pod_rcb_partition``) so
+        the migration ring crosses hosts only where the mesh geometry
+        does. ``placement_hosts`` gives per-HOST chip counts in mesh
+        device order (virtual multi-host layouts on one process);
+        ``None`` derives them from the mesh's process boundaries
+        (``distributed.derive_host_counts``). ``"linear"`` (default)
+        keeps the flat RCB byte-identically. A prebuilt ``part=``
+        carries its own placement (streaming threads the knob into its
+        own ``build_partition`` call)."""
         self.check_found_all = check_found_all
         self.device_mesh = device_mesh
         self.axis = _axis_name(device_mesh)
@@ -1278,6 +1407,27 @@ class PartitionedEngine:
             vmem_walk_max_elems = effective_vmem_bound(
                 vmem_walk_max_elems, "bfloat16"
             )
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement must be one of {PLACEMENTS}, "
+                f"got {placement!r}"
+            )
+        self.placement = placement
+        if placement_hosts is not None:
+            self.host_chips = tuple(int(h) for h in placement_hosts)
+            if (any(h < 1 for h in self.host_chips)
+                    or sum(self.host_chips) != self.ndev):
+                raise ValueError(
+                    f"placement_hosts {self.host_chips} must be "
+                    f"positive chip counts summing to the "
+                    f"{self.ndev}-device mesh"
+                )
+        else:
+            from pumiumtally_tpu.parallel.distributed import (
+                derive_host_counts,
+            )
+
+            self.host_chips = derive_host_counts(device_mesh)
         if part is not None:
             self.part = part
             nparts = self.part.ndev  # build_partition's part count
@@ -1286,8 +1436,14 @@ class PartitionedEngine:
                 mesh.nelems, self.ndev,
                 block_elems_bound(vmem_walk_max_elems, table_dtype),
             )
+            bpc = nparts // self.ndev
             self.part = build_partition(
-                mesh, nparts, table_dtype=table_dtype
+                mesh, nparts, table_dtype=table_dtype,
+                placement=placement,
+                hosts=(
+                    None if placement == "linear"
+                    else [h * bpc for h in self.host_chips]
+                ),
             )
         if nparts % self.ndev:
             raise ValueError(
@@ -1318,35 +1474,19 @@ class PartitionedEngine:
             None if cap_frontier is None
             else max(0, min(int(cap_frontier), self.cap))
         )
-        # Round 13: lower in-loop migration to explicit named
+        # Round 13 + 18: lower in-loop migration to explicit named
         # collectives (all_gather + ppermute ring inside a shard_map
         # over the engine mesh) instead of the GSPMD-partitioned global
         # scatter — bitwise-equal by construction (unique stable
         # destination ranks), built once here so every phase-family
-        # program shares one closure. Only the full-capacity migrate
-        # exists collectively, so the frontier slab is incompatible
-        # (TallyConfig validates the same pair earlier with the
-        # config-level message).
+        # program shares one closure. Round 18 adds the frontier form
+        # (the ring at cap_frontier rows), so the two migrate
+        # optimizations compose; a truthy cap_frontier arms it, and
+        # cap_frontier=0 (the forced-fallback hook) dispatches every
+        # round to the full-capacity collective exactly like the
+        # on-chip path falls back to _migrate_impl.
         self.migrate_collective = bool(migrate_collective)
-        if self.migrate_collective and self.cap_frontier is not None:
-            raise ValueError(
-                "migrate_collective=True replaces the full-capacity "
-                "migrate only; it cannot combine with cap_frontier"
-            )
-        if self.migrate_collective:
-            from pumiumtally_tpu.parallel.distributed import (
-                make_collective_migrate,
-            )
-
-            self._collective_migrate = make_collective_migrate(
-                device_mesh,
-                part_L=self.part.L,
-                nparts=nparts,
-                cap_per_block=cap_b,
-                partition_method=partition_method,
-            )
-        else:
-            self._collective_migrate = None
+        self._build_collective_fns()
         self.tol = tol
         self.max_iters = max_iters
         self.max_rounds = max_rounds
@@ -1459,6 +1599,66 @@ class PartitionedEngine:
             self.state["sfac"] = jnp.zeros(
                 (self.cap, scoring.n_scores), dtype
             )
+
+    # -- collective migrate closures ------------------------------------
+    def _build_collective_fns(self) -> None:
+        """(Re)build the collective migrate closures from the CURRENT
+        capacity geometry. Called at construction and again by
+        ``_escalate_capacity``: the closures bake ``cap_per_block`` and
+        ``cap_frontier``, so an escalated engine reusing the old ones
+        would ring-scatter against stale slot ranges."""
+        if not self.migrate_collective:
+            self._collective_migrate = None
+            self._collective_frontier = None
+            return
+        from pumiumtally_tpu.parallel.distributed import (
+            make_collective_frontier_migrate,
+            make_collective_migrate,
+        )
+
+        self._collective_migrate = make_collective_migrate(
+            self.device_mesh,
+            part_L=self.part.L,
+            nparts=self.nparts,
+            cap_per_block=self.cap_per_block,
+            partition_method=self.partition_method,
+        )
+        # cap_frontier=0 (forced fallback) and None both migrate at
+        # full capacity every round — no slab closure to build.
+        self._collective_frontier = (
+            None if not self.cap_frontier
+            else make_collective_frontier_migrate(
+                self.device_mesh,
+                part_L=self.part.L,
+                nparts=self.nparts,
+                cap_per_block=self.cap_per_block,
+                cap_frontier=self.cap_frontier,
+                partition_method=self.partition_method,
+            )
+        )
+
+    def modeled_cross_host_bytes(self) -> int:
+        """Modeled per-migration-round CROSS-HOST bytes of this
+        engine's placement under its host layout (round 19's placement
+        diagnostic — deterministic, nothing runs). See
+        ``distributed.modeled_cross_host_migration_bytes`` for the
+        host-ring model; 0 on single-host layouts and on prebuilt
+        partitions without a face census."""
+        if self.part.remote_faces is None or len(self.host_chips) < 2:
+            return 0
+        from pumiumtally_tpu.parallel.distributed import (
+            modeled_cross_host_migration_bytes,
+            state_pack_columns,
+        )
+
+        fcols, icols = state_pack_columns(self.state)
+        return modeled_cross_host_migration_bytes(
+            self.part.remote_faces,
+            self.blocks_per_chip,
+            self.host_chips,
+            fcols,
+            icols,
+        )
 
     # -- staged input routing -------------------------------------------
     def _by_pid(self, arr_n: jnp.ndarray, fill) -> jnp.ndarray:
@@ -2017,7 +2217,7 @@ class PartitionedEngine:
                 self.min_window, self.use_vmem_walk, self.use_pallas_walk,
                 self.blocks_per_chip,
                 self.partition_method, self.cap_frontier,
-                self.migrate_collective, id(self.part),
+                self.migrate_collective, self.placement, id(self.part),
                 None if self.scoring is None else self.scoring.static_key(),
                 variant)
 
@@ -2059,6 +2259,9 @@ class PartitionedEngine:
             None if force_full_migrate else self.cap_frontier
         )
         collective_fn = self._collective_migrate
+        frontier_collective_fn = (
+            None if force_full_migrate else self._collective_frontier
+        )
         round_sm = self._make_round_sm(
             tally, max_iters=self.max_iters * int(iters_mult)
         )
@@ -2121,7 +2324,7 @@ class PartitionedEngine:
                  nfb) = c
                 st2, ovf2, n_act2, fellback = _inloop_migrate_step(
                     part_L, nparts, cap_b, cap_frontier, pmethod, st,
-                    n_act, n_p, collective_fn,
+                    n_act, n_p, collective_fn, frontier_collective_fn,
                 )
                 # An overflowing migrate scatters colliding slots: do
                 # NOT walk (and tally) from that corrupted state — the
@@ -2229,12 +2432,13 @@ class PartitionedEngine:
         pmethod = self.partition_method
         cap_frontier = self.cap_frontier
         collective_fn = self._collective_migrate
+        frontier_collective_fn = self._collective_frontier
 
         @jax.jit
         def mig(state, n_pending):
             return _migrate_round(part_L, nparts, cap_b, cap_frontier,
                                   pmethod, state, n_pending,
-                                  collective_fn)
+                                  collective_fn, frontier_collective_fn)
 
         mig = register_entry_point("partition_migrate", mig)
         self._jit_cache[key] = mig
@@ -2535,6 +2739,9 @@ class PartitionedEngine:
         self.cap = self.nparts * new_cb
         if self.cap_frontier is not None:
             self.cap_frontier = min(self.cap_frontier, self.cap)
+        # The collective closures bake the OLD cap_per_block (ring slot
+        # ranges, slab geometry) — rebuild them for the grown engine.
+        self._build_collective_fns()
 
     def retry_stragglers(self, iters_factor: int = 2) -> bool:
         """Straggler rung for the partitioned engine: resume the

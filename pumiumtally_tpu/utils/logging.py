@@ -29,10 +29,35 @@ class _PrefixFormatter(logging.Formatter):
         return f"{prefix} {record.getMessage()}"
 
 
+class _CurrentStderrHandler(logging.StreamHandler):
+    """StreamHandler resolving ``sys.stderr`` at EMIT time.
+
+    The module logger installs its handler once per process; a handler
+    holding the stream OBJECT captured at that moment writes past any
+    later ``sys.stderr`` replacement — pytest's capsys among them, which
+    made test outcomes depend on whether an earlier test had already
+    touched the logger (e.g. the bf16 block-kernel reroute logs during
+    engine construction)."""
+
+    def __init__(self) -> None:
+        super().__init__(sys.stderr)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value) -> None:
+        # The base __init__ (and setStream) assign the captured object;
+        # discard it — the property above always answers with the
+        # CURRENT sys.stderr.
+        pass
+
+
 def get_logger() -> logging.Logger:
     logger = logging.getLogger(_LOGGER_NAME)
     if not logger.handlers:
-        handler = logging.StreamHandler(sys.stderr)
+        handler = _CurrentStderrHandler()
         handler.setFormatter(_PrefixFormatter())
         logger.addHandler(handler)
         logger.propagate = False

@@ -29,6 +29,17 @@ Two halves:
 ``build_tally``/``run_campaign``/``collect`` are imported by the
 parity tests to run the IDENTICAL campaign single-process at the same
 global shapes — one code path for both sides of the bitwise contract.
+
+Skip accounting (round 19): ``probe_collectives`` runs ONE tiny
+two-process worker pair (``--arm probe``: init + collective probe,
+no campaign) the first time any cross-process test launches, and the
+verdict is cached for the whole session — so a gloo-less CPU jaxlib
+pays one fast probe instead of N full campaign timeouts. Every
+``launch_or_skip`` outcome is tallied in ``RAN``/``SKIPPED`` and
+``tests/conftest.py`` prints one skipped-vs-run summary line at the
+end of the session. The skip reason is EXACTLY the
+``DISTRIBUTED-UNAVAILABLE`` marker (asserted by
+tests/test_distributed.py) so skip triage greps one token.
 """
 
 from __future__ import annotations
@@ -49,6 +60,16 @@ if REPO not in sys.path:
 N = 256
 MESH_ARGS = (1, 1, 1, 3, 3, 3)
 ARMS = ("sharded", "partitioned", "partitioned_scoring")
+# "probe" is a worker mode, not a parity arm: init + collective probe,
+# then exit — the session-start gloo capability check.
+_WORKER_MODES = ARMS + ("probe",)
+
+# Session accounting for the one-line skipped-vs-run summary printed
+# by tests/conftest.py::pytest_terminal_summary. Appended by
+# launch_or_skip only (the pytest entry point), never by raw
+# launch_distributed calls from tools.
+RAN: list = []
+SKIPPED: list = []
 _INIT_FAILED_MARKER = "DISTRIBUTED-INIT-FAILED"
 _PORT_RETRY_PATTERNS = ("address already in use", "failed to bind",
                         "address in use", "errno 98")
@@ -134,7 +155,7 @@ def _looks_unavailable(exc: BaseException) -> bool:
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--arm", choices=ARMS, required=True)
+    ap.add_argument("--arm", choices=_WORKER_MODES, required=True)
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--coord-port", type=int, required=True)
@@ -185,6 +206,14 @@ def main(argv=None) -> None:
 
     try:
         assert_collectives_available(mesh_dev)
+        if args.arm == "probe":
+            # Capability probe only — no campaign. SystemExit is a
+            # BaseException, so it sails past the handlers below.
+            print(f"proc {args.process_id}: PROBE-OK", flush=True)
+            import jax
+
+            jax.distributed.shutdown()
+            raise SystemExit(0)
         t = build_tally(args.arm, mesh_dev)
         t0 = time.perf_counter()
         run_campaign(t, args.arm)
@@ -346,16 +375,47 @@ def launch_distributed(arm: str, out_path=None, *, num_processes: int = 2,
     )
 
 
+_PROBE = None  # session-cached gloo probe verdict (LaunchResult)
+
+
+def probe_collectives(*, num_processes: int = 2) -> LaunchResult:
+    """Session-cached collectives-capability probe: ONE tiny worker
+    pair (1 virtual device each) that inits jax.distributed and runs
+    ``assert_collectives_available``, nothing else. A gloo-less CPU
+    jaxlib fails this in seconds, so every subsequent cross-process
+    test skips instantly instead of timing out its own campaign."""
+    global _PROBE
+    if _PROBE is None:
+        _PROBE = launch_distributed(
+            "probe", num_processes=num_processes, devices_per_proc=1,
+        )
+    return _PROBE
+
+
 def launch_or_skip(arm: str, out_path=None, **kw) -> LaunchResult:
     """Launch the worker set; SKIP the calling test when the backend
-    cannot run cross-process collectives, assert success otherwise."""
+    cannot run cross-process collectives, assert success otherwise.
+
+    The skip reason is EXACTLY ``UNAVAILABLE_MARKER`` — details stay
+    in the worker logs (``res.reason`` / outputs), the reason string
+    stays a single greppable token. Outcomes land in RAN/SKIPPED for
+    the session summary line."""
     import pytest
 
+    from pumiumtally_tpu.parallel.distributed import UNAVAILABLE_MARKER
+
+    probe = probe_collectives(
+        num_processes=kw.get("num_processes", 2))
+    if probe.skipped:
+        SKIPPED.append(arm)
+        pytest.skip(UNAVAILABLE_MARKER)
     res = launch_distributed(arm, out_path, **kw)
     if res.skipped:
-        pytest.skip(res.reason)
+        SKIPPED.append(arm)
+        pytest.skip(UNAVAILABLE_MARKER)
     for pid, (rc, out) in enumerate(zip(res.returncodes, res.outputs)):
         assert rc == 0, f"proc {pid} rc={rc}:\n{out[-2000:]}"
+    RAN.append(arm)
     return res
 
 

@@ -134,3 +134,18 @@ def _retrace_tripwire(request):
             "config.RETRACE_BUDGETS and docs/STATIC_ANALYSIS.md.",
             pytrace=False,
         )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """One skipped-vs-run line for the cross-process (2-OS-process)
+    tests, fed by tests/_distributed_driver.py's RAN/SKIPPED counters.
+    Silent when no cross-process test was collected this session —
+    tier-1 (`-m 'not slow'`) never launches worker pairs."""
+    drv = (sys.modules.get("tests._distributed_driver")
+           or sys.modules.get("_distributed_driver"))
+    if drv is None or not (drv.RAN or drv.SKIPPED):
+        return
+    terminalreporter.write_line(
+        f"cross-process distributed tests: {len(drv.RAN)} ran, "
+        f"{len(drv.SKIPPED)} skipped (DISTRIBUTED-UNAVAILABLE)"
+    )

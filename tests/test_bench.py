@@ -372,6 +372,34 @@ def test_distributed_row(bench):
     assert res["compiles"]["timed"] == 0
 
 
+def test_placement_row(bench):
+    """The topology-aware placement component row (r19): schema keys
+    present, the tool's gates ran (equal-host degeneracy bitwise, the
+    cross-arm class — positions bitwise, elem-id diffs boundary-ties
+    only, total flux conserved — it raises otherwise), the modeled
+    cross-host byte drop STRICT in both sub-rows, positive fenced
+    per-move costs both arms, and the compiles-healthy contract —
+    ``compiles.timed == 0``: both placements drive the same phase
+    programs, compiled in warmup."""
+    res = bench.run_placement_ab()
+    assert set(res) == {"placement_owner", "engine_placement"}
+    owner = res["placement_owner"]
+    assert owner["equal_host_degeneracy_bitwise"] is True
+    assert 0 < owner["bytes_pod_rcb"] < owner["bytes_linear"]
+    assert owner["hosts"] == [3, 5]
+    eng = res["engine_placement"]
+    for key in ("bytes_linear", "bytes_pod_rcb", "drop_frac",
+                "positions_bitwise", "boundary_ties",
+                "total_flux_rel_err", "linear_move_ms",
+                "pod_rcb_move_ms", "speedup", "linear_walk_rounds",
+                "pod_rcb_walk_rounds", "compiles"):
+        assert key in eng, key
+    assert eng["positions_bitwise"] is True
+    assert 0 < eng["bytes_pod_rcb"] < eng["bytes_linear"]
+    assert eng["linear_move_ms"] > 0 and eng["pod_rcb_move_ms"] > 0
+    assert eng["compiles"]["timed"] == 0
+
+
 def test_pallas_walk_row(bench):
     """The one-kernel Pallas walk component row (r17): schema keys
     present, the tool's gates ran (interpret-mode bitwise pin vs

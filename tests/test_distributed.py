@@ -9,6 +9,16 @@ multi-process partitioning, only device placement changes:
   counting-rank keys + ppermute ring) is BITWISE equal to the global
   scatter ``partition._migrate_impl`` for both partition methods,
   overflow and non-overflow arms;
+- the collective FRONTIER migration (round 19,
+  ``make_collective_frontier_migrate``: the same ring at
+  ``cap_frontier`` rows) is bitwise equal to
+  ``partition._frontier_migrate_impl`` — state dict, overflow latch,
+  departure/arrival counts — on sparse and all-to-one-overflow arms,
+  and the engine composition ``migrate_collective x cap_frontier``
+  (construction used to refuse the pair) matches the on-chip frontier
+  engine bit for bit across all 4 walk perm modes, including the
+  slab-overflow fallback to the full-capacity collective and the
+  ``cap_frontier=0`` forced-full arm;
 - the partitioned engine with ``migrate_collective=True`` lands flux,
   positions, element ids, and score banks bitwise equal to the
   default global-scatter engine (the determinism contract that makes
@@ -53,14 +63,19 @@ from pumiumtally_tpu import (  # noqa: E402
 )
 from pumiumtally_tpu.parallel import make_device_mesh  # noqa: E402
 from pumiumtally_tpu.parallel.distributed import (  # noqa: E402
+    UNAVAILABLE_MARKER,
     fetch_global,
     global_device_mesh,
     init_distributed,
+    make_collective_frontier_migrate,
     make_collective_migrate,
     modeled_migration_collective_bytes,
     state_pack_columns,
 )
-from pumiumtally_tpu.parallel.partition import _migrate_impl  # noqa: E402
+from pumiumtally_tpu.parallel.partition import (  # noqa: E402
+    _frontier_migrate_impl,
+    _migrate_impl,
+)
 
 
 # -- collective migration vs global scatter ---------------------------------
@@ -126,6 +141,57 @@ def test_collective_migrate_bitwise_vs_global_scatter(method):
         )
 
 
+@pytest.mark.parametrize("method", ["rank", "argsort"])
+def test_collective_frontier_migrate_bitwise(method):
+    """The cap_frontier-row ppermute ring == the on-chip frontier slab
+    scatter, bit for bit: state dict (every lane, dtype included), the
+    psum'd overflow latch, and the departure/arrival census."""
+    mesh = global_device_mesh()
+    ndev = int(mesh.devices.size)
+    bpc, cap_b, part_L, cf = 2, 5, 7, 16
+    nparts = ndev * bpc
+    cap = nparts * cap_b
+    coll = make_collective_frontier_migrate(
+        mesh, part_L=part_L, nparts=nparts, cap_per_block=cap_b,
+        cap_frontier=cf, partition_method=method,
+    )
+    ref_fn = jax.jit(
+        lambda s: _frontier_migrate_impl(part_L, nparts, cap_b, cf, s,
+                                         method)
+    )
+    rng = np.random.default_rng(0)
+
+    def check(st, want_overflow):
+        ref, ovf_r, dep_r, arr_r = ref_fn(st)
+        got, ovf_g, dep_g, arr_g = jax.jit(coll)(st)
+        assert bool(ovf_r) == bool(ovf_g) is want_overflow
+        assert np.asarray(dep_g).dtype == np.asarray(dep_r).dtype
+        np.testing.assert_array_equal(np.asarray(dep_r),
+                                      np.asarray(dep_g))
+        np.testing.assert_array_equal(np.asarray(arr_r),
+                                      np.asarray(arr_g))
+        for k in sorted(ref):
+            a, b = np.asarray(ref[k]), np.asarray(got[k])
+            assert a.dtype == b.dtype, (k, a.dtype, b.dtype)
+            np.testing.assert_array_equal(a, b, err_msg=k)
+
+    # Sparse front: fits the slab, commits.
+    pend = np.full(cap, -1)
+    pend[rng.choice(cap, 8, replace=False)] = rng.integers(
+        0, nparts * part_L, 8
+    )
+    check(_mkstate(rng, cap, part_L, pend), want_overflow=False)
+
+    # All-to-one overflow: the front fits the slab but the target
+    # partition has no free rows — the latch trips on every shard and
+    # the pre-state survives unchanged.
+    pend = np.full(cap, -1)
+    pend[rng.choice(cap, cf, replace=False)] = 3
+    st = _mkstate(rng, cap, part_L, pend)
+    st["alive"] = jnp.asarray(np.ones(cap, bool))
+    check(st, want_overflow=True)
+
+
 # -- engine-level on/off parity ---------------------------------------------
 
 def _campaign_arrays(N=3000, seed=3):
@@ -186,9 +252,164 @@ def test_partitioned_engine_collective_parity_scoring():
     assert (np.asarray(off.score_bank) == np.asarray(on.score_bank)).all()
 
 
-def test_migrate_collective_rejects_cap_frontier():
-    with pytest.raises(ValueError, match="cap_frontier"):
-        TallyConfig(migrate_collective=True, cap_frontier=64)
+# -- cap_frontier x migrate_collective composition (round 19) ---------------
+
+def _frontier_campaign_arrays(N=1500, seed=3):
+    """x-heavy seeded moves on the 2x1x1 box: the linear block order
+    splits along x, so these crossings ride the migrate ring every
+    round and the frontier slab actually fills."""
+    rng = np.random.default_rng(seed)
+    src = rng.uniform(0.05, 0.95, (N, 3)) * np.array([2.0, 1.0, 1.0])
+    d1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)), 0.01, 0.99)
+    d1[:, 0] = np.clip(src[:, 0] + rng.normal(scale=0.6, size=N),
+                       0.02, 1.98)
+    d2 = d1.copy()
+    d2[:, 0] = np.clip(d1[:, 0] + rng.normal(scale=0.6, size=N),
+                       0.02, 1.98)
+    fly = (rng.uniform(size=N) > 0.1).astype(np.int8)
+    w = rng.uniform(0.5, 2.0, N)
+    return src, d1, d2, fly, w
+
+
+def _run_frontier_campaign(mesh, N, cfg, arrays, energy=None):
+    src, d1, d2, fly, w = arrays
+    kw = {} if energy is None else {"energy": energy}
+    t = PartitionedPumiTally(mesh, N, cfg)
+    t.CopyInitialPosition(src.reshape(-1).copy())
+    t.MoveToNextLocation(None, d1.reshape(-1).copy(), fly.copy(), w, **kw)
+    t.MoveToNextLocation(None, d2.reshape(-1).copy(),
+                         np.ones(N, np.int8), w, **kw)
+    return t
+
+
+def test_migrate_collective_composes_with_cap_frontier():
+    """Round 19 lifted the construction refusal: the pair is accepted,
+    and the phase cache key carries (cap_frontier, migrate_collective)
+    AND placement — engines differing in any of the three never share
+    a compiled program."""
+    cfg = TallyConfig(migrate_collective=True, cap_frontier=64)
+    assert cfg.migrate_collective and cfg.cap_frontier == 64
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    dm = make_device_mesh(8)
+
+    def key_of(**kw):
+        t = PartitionedPumiTally(mesh, 128,
+                                 TallyConfig(device_mesh=dm, **kw))
+        return t.engine._phase_key("phase", True)
+
+    keys = [
+        key_of(migrate_collective=True, cap_frontier=64),
+        key_of(migrate_collective=False, cap_frontier=64),
+        key_of(migrate_collective=True, cap_frontier=0),
+        key_of(placement="pod_rcb", placement_hosts=(3, 5)),
+        key_of(),
+    ]
+    assert len(set(keys)) == len(keys), keys
+    composed = keys[0]
+    assert 64 in composed and True in composed and "linear" in composed
+
+
+@pytest.mark.parametrize("perm_mode",
+                         ["arrays", "packed", "indirect", "sorted"])
+def test_partitioned_engine_frontier_collective_parity(perm_mode):
+    """cap_frontier + migrate_collective == cap_frontier on-chip,
+    bitwise (element ids, positions, flux), across all 4 perm modes."""
+    N = 1500
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    dm = make_device_mesh(8)
+    arrays = _frontier_campaign_arrays(N)
+    base = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=1024, walk_perm_mode=perm_mode,
+    ), arrays)
+    comp = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=1024, walk_perm_mode=perm_mode,
+        migrate_collective=True,
+    ), arrays)
+    np.testing.assert_array_equal(base.elem_ids, comp.elem_ids)
+    assert (np.asarray(base.positions) == np.asarray(comp.positions)).all()
+    assert (np.asarray(base.flux) == np.asarray(comp.flux)).all()
+
+
+def test_frontier_collective_slab_overflow_fallback():
+    """A slab far smaller than the crossing front overflows every
+    round; both engines take the lax.cond fallback to their
+    full-capacity path (collective ring vs global scatter) and stay
+    bitwise equal — the fallback is pinned end to end."""
+    N = 1500
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    dm = make_device_mesh(8)
+    arrays = _frontier_campaign_arrays(N)
+    base = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=8), arrays)
+    comp = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=8, migrate_collective=True), arrays)
+    np.testing.assert_array_equal(base.elem_ids, comp.elem_ids)
+    assert (np.asarray(base.positions) == np.asarray(comp.positions)).all()
+    assert (np.asarray(base.flux) == np.asarray(comp.flux)).all()
+
+
+def test_cap_frontier_zero_forces_full_capacity_collective():
+    """cap_frontier=0 + migrate_collective rides the FULL-capacity
+    collective every round: bit for bit the cap_frontier=0 scatter
+    engine AND the plain migrate_collective engine."""
+    N = 1500
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    dm = make_device_mesh(8)
+    arrays = _frontier_campaign_arrays(N)
+    z_on = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=0, migrate_collective=True), arrays)
+    z_off = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=0), arrays)
+    full = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, migrate_collective=True), arrays)
+    for other in (z_off, full):
+        np.testing.assert_array_equal(z_on.elem_ids, other.elem_ids)
+        assert (np.asarray(z_on.positions)
+                == np.asarray(other.positions)).all()
+        assert (np.asarray(z_on.flux) == np.asarray(other.flux)).all()
+
+
+def test_frontier_collective_parity_scoring():
+    """The scoring lanes (sbin / factors) ride the cap_frontier ring in
+    the same packed slab: score banks bitwise between the
+    frontier-collective and on-chip frontier engines."""
+    N = 1500
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    dm = make_device_mesh(8)
+    arrays = _frontier_campaign_arrays(N)
+    spec = ScoringSpec(filters=[EnergyFilter([0.0, 1.0, 2.0])],
+                       scores=["flux", "events"])
+    en = np.where(np.arange(N) % 2 == 0, 0.5, 1.5)
+    base = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=1024, scoring=spec,
+    ), arrays, energy=en)
+    comp = _run_frontier_campaign(mesh, N, TallyConfig(
+        device_mesh=dm, cap_frontier=1024, scoring=spec,
+        migrate_collective=True,
+    ), arrays, energy=en)
+    assert (np.asarray(base.flux) == np.asarray(comp.flux)).all()
+    assert (np.asarray(base.score_bank)
+            == np.asarray(comp.score_bank)).all()
+
+
+def test_launch_or_skip_reason_is_exact_marker(monkeypatch):
+    """A gloo-less backend skips with a reason that is EXACTLY the
+    DISTRIBUTED-UNAVAILABLE marker (one greppable token, details stay
+    in the worker logs) and lands in the session skip census."""
+    from tests import _distributed_driver as drv
+
+    unavailable = drv.LaunchResult(
+        True, f"{UNAVAILABLE_MARKER}: no gloo in this jaxlib", [77, 0],
+        ["", ""],
+    )
+    monkeypatch.setattr(drv, "_PROBE", unavailable)
+    before = len(drv.SKIPPED)
+    with pytest.raises(pytest.skip.Exception) as exc:
+        drv.launch_or_skip("partitioned")
+    assert str(exc.value) == UNAVAILABLE_MARKER
+    assert drv.SKIPPED[before:] == ["partitioned"]
+    del drv.SKIPPED[before:]  # this was not a real cross-process skip
 
 
 # -- front-door helpers -----------------------------------------------------

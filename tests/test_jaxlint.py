@@ -713,6 +713,31 @@ def test_pallas_walk_kernel_registered_and_pragma_free():
         assert "tools/exp_pallas_walk_ab.py" in fh.read()
 
 
+def test_placement_modules_lint_clean_and_pragma_free():
+    """The round-19 placement surface — the hierarchical-RCB /
+    collective-frontier host+trace code in parallel/ (already in the
+    distributed sweep above) plus its bench-consumed A/B tool — holds
+    the strongest clean contract: zero violations, zero pragmas. The
+    tool is also pinned into tools/lint_all.py's jaxlint targets so a
+    slip cannot silently drop its CI coverage."""
+    from pumiumtally_tpu.analysis import lint_paths
+
+    files = [
+        os.path.join(REPO, "pumiumtally_tpu", "parallel", "partition.py"),
+        os.path.join(REPO, "pumiumtally_tpu", "parallel",
+                     "distributed.py"),
+        os.path.join(REPO, "tools", "exp_placement_ab.py"),
+    ]
+    assert lint_paths(files) == []
+    for f in files:
+        with open(f) as fh:
+            assert "jaxlint: disable" not in fh.read(), (
+                f"{f}: the placement modules ship pragma-free"
+            )
+    with open(os.path.join(REPO, "tools", "lint_all.py")) as fh:
+        assert "tools/exp_placement_ab.py" in fh.read()
+
+
 # ---------------------------------------------------------------------------
 # JL101-JL104 — collective safety
 # ---------------------------------------------------------------------------

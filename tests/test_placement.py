@@ -1,0 +1,252 @@
+"""Topology-aware pod placement (round 19, docs/DESIGN.md
+"Topology-aware placement").
+
+``placement="pod_rcb"`` builds element-block ownership by hierarchical
+RCB — hosts first (weighted by chips per host), then chips within each
+host — so the migrate ring crosses host boundaries only where the mesh
+geometry does. The contract pinned here:
+
+- DEGENERACY: equal chips per host aligned with the flat power-of-two
+  RCB tree reproduce the linear owner BITWISE (same splits in the same
+  order), and default knobs never take the pod path at all — the
+  default engine is byte-identical to HEAD.
+- The modeled cross-host migration bytes (ring hops weighted by
+  ``state_pack_columns`` row bytes) STRICTLY DROP on the pinned 2-host
+  layout, for both the 1-block-per-chip and sub-split partitions.
+- The cross-arm physics class: positions bitwise equal, every element
+  id mismatch is a boundary TIE (bitwise-equal position, adjacent
+  elements — crossing pause points land exactly on partition faces,
+  the same attribution degeneracy the linear arm shows against the
+  monolithic facade on these meshes), and total flux is conserved.
+  Per-element flux on tied boundary tracks is attribution, not
+  physics, and is deliberately NOT pinned across placements.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pumiumtally_tpu import (  # noqa: E402
+    PartitionedPumiTally,
+    TallyConfig,
+    build_box,
+)
+from pumiumtally_tpu.parallel import make_device_mesh  # noqa: E402
+from pumiumtally_tpu.parallel.distributed import (  # noqa: E402
+    derive_host_counts,
+    modeled_cross_host_migration_bytes,
+)
+from pumiumtally_tpu.parallel.partition import (  # noqa: E402
+    PLACEMENTS,
+    build_partition,
+)
+
+FCOLS, ICOLS = 10, 9  # the 13-lane engine state (test_distributed.py)
+
+
+# -- owner construction -----------------------------------------------------
+
+def test_pod_rcb_equal_hosts_degenerates_to_linear_bitwise():
+    """hosts=(4,4) on 8 blocks IS the flat RCB tree cut at depth 1 —
+    the hierarchical build must reproduce the linear owner bitwise."""
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    p_lin = build_partition(mesh, 8)
+    p_pod = build_partition(mesh, 8, placement="pod_rcb", hosts=[4, 4])
+    np.testing.assert_array_equal(p_lin.owner, p_pod.owner)
+
+
+def test_pod_rcb_unequal_hosts_changes_owner():
+    mesh = build_box(1, 1, 1, 6, 6, 6)
+    p_lin = build_partition(mesh, 8)
+    p_pod = build_partition(mesh, 8, placement="pod_rcb", hosts=[3, 5])
+    assert not np.array_equal(p_lin.owner, p_pod.owner)
+
+
+def test_linear_placement_is_default_path():
+    """placement="linear" + hosts is the DEFAULT owner bitwise (hosts
+    describe the machine, not the strategy), and the default build
+    records no remote-face census to pay for."""
+    mesh = build_box(1, 1, 1, 5, 5, 5)
+    p_default = build_partition(mesh, 8)
+    p_lin = build_partition(mesh, 8, placement="linear", hosts=None)
+    np.testing.assert_array_equal(p_default.owner, p_lin.owner)
+
+
+def test_build_partition_rejects_unknown_placement():
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    with pytest.raises(ValueError, match="placement"):
+        build_partition(mesh, 8, placement="hilbert")
+    assert PLACEMENTS == ("linear", "pod_rcb")
+
+
+# -- modeled cross-host bytes -----------------------------------------------
+
+def test_pod_rcb_strictly_reduces_modeled_cross_host_bytes():
+    """The pinned 2-host layout: 8 blocks over host chips (3,5) on the
+    2x1x1 stretched box — pod RCB puts the host cut on one clean mesh
+    layer while the linear order crosses hosts mid-geometry."""
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    hosts = (3, 5)
+    p_lin = build_partition(mesh, 8)
+    p_pod = build_partition(mesh, 8, placement="pod_rcb",
+                            hosts=list(hosts))
+    b_lin = modeled_cross_host_migration_bytes(
+        p_lin.remote_faces, 1, hosts, FCOLS, ICOLS)
+    b_pod = modeled_cross_host_migration_bytes(
+        p_pod.remote_faces, 1, hosts, FCOLS, ICOLS)
+    assert b_pod < b_lin, (b_lin, b_pod)
+
+
+def test_pod_rcb_reduces_bytes_with_sub_split_blocks():
+    """Sub-split partitions (blocks_per_chip=2): host boundaries fall
+    between chip groups, and the drop still holds."""
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    hosts = (3, 5)
+    bpc = 2
+    p_lin = build_partition(mesh, 16)
+    p_pod = build_partition(mesh, 16, placement="pod_rcb",
+                            hosts=[h * bpc for h in hosts])
+    b_lin = modeled_cross_host_migration_bytes(
+        p_lin.remote_faces, bpc, hosts, FCOLS, ICOLS)
+    b_pod = modeled_cross_host_migration_bytes(
+        p_pod.remote_faces, bpc, hosts, FCOLS, ICOLS)
+    assert b_pod < b_lin, (b_lin, b_pod)
+
+
+def test_modeled_bytes_zero_on_single_host():
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    p = build_partition(mesh, 8)
+    assert modeled_cross_host_migration_bytes(
+        p.remote_faces, 1, (8,), FCOLS, ICOLS) == 0
+
+
+# -- host-count derivation --------------------------------------------------
+
+class _FakeDev:
+    def __init__(self, pi):
+        self.process_index = pi
+
+
+def _fake_mesh(process_indices):
+    import types
+
+    devs = np.empty(len(process_indices), dtype=object)
+    for i, pi in enumerate(process_indices):
+        devs[i] = _FakeDev(pi)
+    return types.SimpleNamespace(devices=devs)
+
+
+def test_derive_host_counts_single_process():
+    dm = make_device_mesh(8)
+    assert derive_host_counts(dm) == (8,)
+
+
+def test_derive_host_counts_contiguous_runs():
+    assert derive_host_counts(_fake_mesh([0, 0, 0, 1, 1])) == (3, 2)
+
+
+def test_derive_host_counts_rejects_interleaved():
+    with pytest.raises(ValueError, match="interleaves"):
+        derive_host_counts(_fake_mesh([0, 1, 0, 1]))
+
+
+# -- config / engine validation ---------------------------------------------
+
+def test_config_validates_placement_knobs():
+    assert TallyConfig().placement == "linear"
+    assert TallyConfig().placement_hosts is None
+    with pytest.raises(ValueError, match="placement"):
+        TallyConfig(placement="hilbert")
+    with pytest.raises(ValueError, match="placement_hosts"):
+        TallyConfig(placement_hosts=(3, 0))
+    with pytest.raises(ValueError, match="placement_hosts"):
+        TallyConfig(placement_hosts=())
+
+
+def test_engine_rejects_hosts_not_summing_to_devices():
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    dm = make_device_mesh(8)
+    with pytest.raises(ValueError, match="placement_hosts"):
+        PartitionedPumiTally(
+            mesh, 64,
+            TallyConfig(device_mesh=dm, placement="pod_rcb",
+                        placement_hosts=(3, 4)),
+        )
+
+
+# -- engine-level A/B: the pinned equivalence class -------------------------
+
+def _campaign(N=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    dims = np.array([2.0, 1.0, 1.0])
+    src = rng.uniform(0.05, 0.95, (N, 3)) * dims
+    d1 = np.clip(src + rng.normal(scale=0.3, size=(N, 3)) * dims,
+                 0.01 * dims, 0.99 * dims)
+    d2 = np.clip(d1 + rng.normal(scale=0.3, size=(N, 3)) * dims,
+                 0.01 * dims, 0.99 * dims)
+    fly = (rng.uniform(size=N) > 0.1).astype(np.int8)
+    w = rng.uniform(0.5, 2.0, N)
+    return src, d1, d2, fly, w
+
+
+def test_engine_pod_rcb_parity_class_and_byte_drop():
+    """Linear vs pod_rcb on the pinned 2-host layout, end to end:
+
+    - modeled cross-host bytes strictly drop (the tentpole win);
+    - positions are BITWISE equal;
+    - every element-id mismatch is a boundary tie — bitwise-equal
+      position, adjacent elements;
+    - total flux is conserved across the placement change.
+    """
+    N = 2000
+    mesh = build_box(2, 1, 1, 8, 4, 4)
+    dm = make_device_mesh(8)
+    src, d1, d2, fly, w = _campaign(N)
+
+    def run(cfg):
+        t = PartitionedPumiTally(mesh, N, cfg)
+        t.CopyInitialPosition(src.reshape(-1).copy())
+        t.MoveToNextLocation(None, d1.reshape(-1).copy(), fly.copy(), w)
+        t.MoveToNextLocation(None, d2.reshape(-1).copy(),
+                             np.ones(N, np.int8), w)
+        return t
+
+    lin = run(TallyConfig(device_mesh=dm, placement_hosts=(3, 5)))
+    pod = run(TallyConfig(device_mesh=dm, placement="pod_rcb",
+                          placement_hosts=(3, 5)))
+
+    b_lin = lin.engine.modeled_cross_host_bytes()
+    b_pod = pod.engine.modeled_cross_host_bytes()
+    assert 0 < b_pod < b_lin, (b_lin, b_pod)
+
+    pl = np.asarray(lin.positions).reshape(N, 3)
+    pp = np.asarray(pod.positions).reshape(N, 3)
+    np.testing.assert_array_equal(pl, pp)
+
+    el, ep = np.asarray(lin.elem_ids), np.asarray(pod.elem_ids)
+    adj = np.asarray(mesh.face_adj)
+    for i in np.nonzero(el != ep)[0]:
+        assert el[i] in adj[ep[i]] or ep[i] in adj[el[i]], (
+            f"pid {i}: elements {el[i]} vs {ep[i]} differ but are not "
+            "face-adjacent — not a boundary tie"
+        )
+    np.testing.assert_allclose(
+        float(np.asarray(lin.flux).sum()),
+        float(np.asarray(pod.flux).sum()), rtol=1e-12,
+    )
+
+
+def test_engine_default_knobs_single_host_diagnostic():
+    """Default knobs: single-host derivation, zero modeled cross-host
+    bytes, and the engine owner bitwise the default build (the
+    byte-identical-to-HEAD guarantee)."""
+    N = 500
+    mesh = build_box(1, 1, 1, 4, 4, 4)
+    dm = make_device_mesh(8)
+    t = PartitionedPumiTally(mesh, N, TallyConfig(device_mesh=dm))
+    assert t.engine.placement == "linear"
+    assert tuple(t.engine.host_chips) == (8,)
+    assert t.engine.modeled_cross_host_bytes() == 0
+    np.testing.assert_array_equal(
+        t.engine.part.owner, build_partition(mesh, 8).owner)

@@ -1,0 +1,234 @@
+"""A/B: linear vs pod-aware RCB placement on a 2-host virtual layout.
+
+PR 12's collective migration made cross-host migration cheap per byte;
+round 19's ``TallyConfig.placement="pod_rcb"`` makes it cheap per
+PARTICLE by cutting the element tree across hosts FIRST (weighted by
+chips per host), then across chips within each host — so the ppermute
+ring crosses a host boundary only where the mesh geometry does. This
+tool measures both arms on the pinned 2-host layout (host chips (3, 5)
+over the 8-device mesh, the 2x1x1 stretched box whose x extent
+dominates the RCB axis choice):
+
+1. ``placement_owner`` — construction-level: the equal-host degeneracy
+   pin (hosts (4,4) == the linear owner BITWISE) and the modeled
+   cross-host migration bytes (ring hops x ``state_pack_columns`` row
+   bytes over the remote-face census) for linear vs pod_rcb — the drop
+   must be STRICT.
+2. ``engine_placement`` — end-to-end: the partitioned engine on the
+   bench box workload, linear vs pod_rcb, BOTH arms under the same
+   ``placement_hosts`` (hosts describe the machine, not the strategy —
+   the linear arm is the topology-blind baseline on the same machine).
+   The pinned equivalence class is asserted BEFORE timing: positions
+   bitwise equal, every element-id mismatch a boundary tie (adjacent
+   elements at the bitwise-identical position — crossing pause points
+   land exactly on partition faces; the linear arm shows the same
+   attribution class against the monolithic facade), total flux
+   conserved, modeled cross-host bytes strictly down. Then fenced
+   per-move ms, arms interleaved, and the compiles-healthy contract
+   (``compiles.timed == 0``).
+
+CPU rates are the receipt, not the proof: the modeled byte drop IS the
+armed bet (host hops are ~10x a chip hop on a real pod), and the CPU —
+which prices every block boundary equally — is expected to show a
+LOSS: the hierarchical cut trades more intra-host boundaries (host 0's
+sub-box is shorter along x, so its internal RCB splits move to y/z and
+paths cross more of them — ``walk_rounds`` per arm is in the row) for
+strictly fewer host crossings. Record the loss as a loss; the ship
+call belongs to the on-chip suite where host hops carry their real
+price. Each row prints one JSON line.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/exp_placement_ab.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# The pinned layout needs 8 devices. On the CPU backend, force the
+# 8-device virtual mesh BEFORE jax initializes (same idiom as
+# tests/conftest.py); a real backend must bring 8 chips of its own.
+if os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+N = int(os.environ.get("PUMIUMTALLY_AB_N", 100_000))
+MOVES = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 4))
+HOSTS = (3, 5)  # the pinned unequal 2-host layout over 8 devices
+BOX = (2.0, 1.0, 1.0)
+DIV = (16, 8, 8)  # 3/8 of the x layers is a clean cut (6 of 16)
+
+
+def bench_owner() -> dict:
+    """Construction-level row: degeneracy pin + modeled byte drop."""
+    from pumiumtally_tpu import build_box
+    from pumiumtally_tpu.parallel.distributed import (
+        modeled_cross_host_migration_bytes,
+    )
+    from pumiumtally_tpu.parallel.partition import build_partition
+
+    fcols, icols = 10, 9  # the 13-lane engine state layout
+    mesh = build_box(*BOX, *DIV)
+    p_lin = build_partition(mesh, 8)
+    p_eq = build_partition(mesh, 8, placement="pod_rcb", hosts=[4, 4])
+    assert np.array_equal(p_lin.owner, p_eq.owner), (
+        "equal-host pod_rcb must reproduce the linear owner bitwise"
+    )
+    p_pod = build_partition(mesh, 8, placement="pod_rcb",
+                            hosts=list(HOSTS))
+    b_lin = modeled_cross_host_migration_bytes(
+        p_lin.remote_faces, 1, HOSTS, fcols, icols)
+    b_pod = modeled_cross_host_migration_bytes(
+        p_pod.remote_faces, 1, HOSTS, fcols, icols)
+    assert b_pod < b_lin, (b_lin, b_pod)
+    return {
+        "row": "placement_owner", "mesh_tets": mesh.nelems,
+        "hosts": list(HOSTS), "equal_host_degeneracy_bitwise": True,
+        "bytes_linear": b_lin, "bytes_pod_rcb": b_pod,
+        "drop_frac": (b_lin - b_pod) / b_lin,
+    }
+
+
+def _fenced_move_ms(t, pts, first: int, last: int) -> list:
+    """Per-move wall ms, each move fenced by a scalar flux fetch (the
+    only real sync on the lazy backends — PERF_NOTES r1 §5)."""
+    import jax.numpy as jnp
+
+    out = []
+    for m in range(first, last + 1):
+        t0 = time.perf_counter()
+        t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+        float(jnp.sum(t.flux))
+        out.append((time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def bench_engine(n: int = N, moves: int = MOVES) -> dict:
+    """End-to-end row: the pinned equivalence class, then fenced
+    per-move ms for both arms, interleaved."""
+    import jax.numpy as jnp
+
+    from pumiumtally_tpu import PartitionedPumiTally, TallyConfig, build_box
+    from pumiumtally_tpu.parallel import make_device_mesh
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    import bench  # the canonical workload generator — one convention
+
+    mesh = build_box(*BOX, *DIV)
+    rng = np.random.default_rng(0)
+    pts = bench.make_trajectory(rng, n, 2 * moves + 2, box=list(BOX))
+    dm = make_device_mesh(8)
+
+    def build(placement):
+        t = PartitionedPumiTally(
+            mesh, n,
+            TallyConfig(device_mesh=dm, placement=placement,
+                        placement_hosts=HOSTS, check_found_all=False,
+                        fenced_timing=False),
+        )
+        t.CopyInitialPosition(pts[0].reshape(-1).copy())
+        # TWO warmup moves: move 1 compiles the staged-source phase,
+        # move 2 the continue-protocol phase the timed window drives —
+        # both programs land before timing (compiles.timed == 0).
+        for m in (1, 2):
+            t.MoveToNextLocation(None, pts[m].reshape(-1).copy())
+            float(jnp.sum(t.flux))
+        return t
+
+    with retrace_guard(raise_on_exceed=False) as guard:
+        t_lin = build("linear")
+        t_pod = build("pod_rcb")
+        # The class gate runs BEFORE timing: a placement that changes
+        # physics must never get a rate reported.
+        b_lin = t_lin.engine.modeled_cross_host_bytes()
+        b_pod = t_pod.engine.modeled_cross_host_bytes()
+        assert 0 < b_pod < b_lin, (b_lin, b_pod)
+        np.testing.assert_array_equal(t_lin.positions, t_pod.positions)
+        el = np.asarray(t_lin.elem_ids)
+        ep = np.asarray(t_pod.elem_ids)
+        adj = np.asarray(mesh.face_adj)
+        ties = np.nonzero(el != ep)[0]
+        for i in ties:
+            assert el[i] in adj[ep[i]] or ep[i] in adj[el[i]], (
+                f"particle {i}: element {el[i]} vs {ep[i]} is not a "
+                "boundary tie"
+            )
+        f_lin = np.asarray(t_lin.flux, np.float64)
+        f_pod = np.asarray(t_pod.flux, np.float64)
+        rtol = (1e-12 if np.asarray(t_lin.flux).dtype == np.float64
+                else 1e-6)
+        np.testing.assert_allclose(f_lin.sum(), f_pod.sum(), rtol=rtol)
+        with retrace_guard(raise_on_exceed=False) as timed_guard:
+            # Interleaved fenced windows: arm A then arm B on the same
+            # trajectory slice, twice (the exp_partition_ab ramp
+            # lesson — ambient drift hits both arms equally).
+            ms = {"linear": [], "pod_rcb": []}
+            for half in range(2):
+                lo = 3 + half * moves
+                hi = lo + moves - 1
+                ms["linear"] += _fenced_move_ms(t_lin, pts, lo, hi)
+                ms["pod_rcb"] += _fenced_move_ms(t_pod, pts, lo, hi)
+    ms_lin = float(np.median(ms["linear"]))
+    ms_pod = float(np.median(ms["pod_rcb"]))
+    return {
+        "row": "engine_placement", "n": n, "mesh_tets": mesh.nelems,
+        "hosts": list(HOSTS),
+        "bytes_linear": b_lin, "bytes_pod_rcb": b_pod,
+        "drop_frac": (b_lin - b_pod) / b_lin,
+        "positions_bitwise": True, "boundary_ties": int(len(ties)),
+        "total_flux_rel_err": float(
+            abs(f_lin.sum() - f_pod.sum()) / f_lin.sum()
+        ),
+        "linear_move_ms": ms_lin, "pod_rcb_move_ms": ms_pod,
+        "linear_moves_per_sec": n / (ms_lin / 1e3),
+        "pod_rcb_moves_per_sec": n / (ms_pod / 1e3),
+        "speedup": ms_lin / ms_pod,
+        # More intra-host boundaries is the price of fewer host
+        # crossings: the per-arm round count makes it visible.
+        "linear_walk_rounds": t_lin.engine.last_walk_rounds,
+        "pod_rcb_walk_rounds": t_pod.engine.last_walk_rounds,
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_guard.total_compiles,
+            **guard.compiles,
+        },
+    }
+
+
+def run_ab(n: int = N, moves: int = MOVES) -> dict:
+    """The bench.py component row: both rows keyed by name."""
+    return {
+        r.pop("row"): r for r in (bench_owner(), bench_engine(n, moves))
+    }
+
+
+def main() -> None:
+    import jax
+
+    from pumiumtally_tpu.utils.chiplock import chip_lock
+
+    quick = "--quick" in sys.argv
+    n = 20_000 if quick else N
+    on_cpu = jax.default_backend() == "cpu"
+    with chip_lock(timeout_s=None, blocking=not on_cpu) as held:
+        if not on_cpu and not held:
+            print("# chip lock busy; measuring anyway", file=sys.stderr)
+        print(f"# backend: {jax.default_backend()}", file=sys.stderr)
+        print(json.dumps(bench_owner()))
+        print(json.dumps(bench_engine(n, MOVES)))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,7 @@ JAXLINT_TARGETS = [
     "tools/exp_resilience_ab.py", "tools/exp_sentinel_ab.py",
     "tools/exp_scoring_ab.py", "tools/exp_service_ab.py",
     "tools/exp_fusion_ab.py", "tools/exp_distributed_ab.py",
-    "tools/exp_pallas_walk_ab.py",
+    "tools/exp_pallas_walk_ab.py", "tools/exp_placement_ab.py",
 ]
 
 

@@ -1,0 +1,95 @@
+#!/bin/bash
+# r14 on-chip suite (PR 18 — the topology-aware placement + collective
+# frontier round; suites number by PR-line like r8-r13 before it).
+# Fired by a probe loop (tools/r5_probe_loop.sh pattern) the moment
+# the TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK headline
+# bench first (a short window must still yield a fresh cached
+# measurement), then the full bench (whose row set now includes the
+# PLACEMENT component row), then THIS round's measurement —
+#   placement_ab: linear vs pod_rcb element ownership on the pinned
+#     2-host layout (host chips (3,5), tools/exp_placement_ab.py).
+#     The tool's gates (equal-host degeneracy bitwise, positions
+#     bitwise between arms, boundary-tie-only elem-id diffs, total
+#     flux conserved, STRICT modeled cross-host byte drop,
+#     compiles.timed == 0) all apply on-chip unchanged. Ship/kill
+#     rule (docs/PERF_NOTES.md "Topology-aware placement"): SHIP
+#     placement='pod_rcb' as the multi-host default if the pod arm
+#     >= 1.15x the linear arm's move rate on a REAL 2-host pod slice
+#     (the modeled 33% cross-host byte drop must convert — host hops
+#     price ~10x a chip hop there); KILL (keep the knob opt-in) below
+#     1.0x, and record the single-host wash honestly — on one host
+#     the extra intra-host boundaries are pure cost, so pod_rcb must
+#     NEVER become a single-host default.
+#   frontier_collective: the composed cap_frontier x
+#     migrate_collective engine (the round-19 5-step ring at slab
+#     rows) vs the on-chip frontier scatter — bitwise-gated by the
+#     tier-1 suite; on-chip the fenced per-move delta decides whether
+#     the composed mode becomes the pod-campaign default alongside
+#     migrate_collective.
+# then the inherited subsystem A/Bs and engine experiments; chipless
+# AOT compiles go last (the remote compile helper remains the prime
+# wedge suspect).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r14_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r14 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|SKIP|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_SCORING=0 PUMIUMTALLY_BENCH_RESILIENCE=0 PUMIUMTALLY_BENCH_SENTINEL=0 PUMIUMTALLY_BENCH_SERVICE=0 PUMIUMTALLY_BENCH_SERVICE_FUSION=0 PUMIUMTALLY_BENCH_DISTRIBUTED=0 PUMIUMTALLY_BENCH_PALLAS_WALK=0 PUMIUMTALLY_BENCH_PLACEMENT=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-19 measurement: linear vs pod_rcb on the pinned 2-host
+# layout at campaign shape. Decides the ship/kill rule in the header.
+run placement_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 python tools/exp_placement_ab.py
+# The round-13..17 re-measures, unchanged shapes so rounds compare
+# like-for-like.
+run pallas_walk_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_TRIALS=3 PUMIUMTALLY_AB_BLOCK_ELEMS=8192 python tools/exp_pallas_walk_ab.py
+run distributed_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_distributed_ab.py
+run fusion_ab 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=1,4,8,16 PUMIUMTALLY_AB_TRIALS=3 python tools/exp_fusion_ab.py
+run service_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_service_ab.py
+# Inherited subsystem A/Bs (r7-r10 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run scoring_ab  1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=6 python tools/exp_scoring_ab.py
+run sentinel_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_sentinel_ab.py
+run resilience_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_resilience_ab.py
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects). The pallas
+# harness self-limits with SIGALRM deadlines — SKIP, never a wedge.
+run aot_pallas  1200 python tools/aot_pallas_walk_compile.py
+run aot_pallas_blocked 1200 python tools/aot_pallas_walk_compile.py 4096 1024 2048 6 2
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
