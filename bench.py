@@ -572,15 +572,18 @@ def run_service_ab() -> dict | None:
 def run_service_fusion_ab() -> dict | None:
     """Component row: cross-session batch fusion (r12,
     tools/exp_fusion_ab.py run_ab) — fused vs unfused serving
-    throughput at 1/4/8 concurrent sessions on identical per-session
-    campaigns, with the per-session BITWISE flux parity gate (both
-    arms vs bare-facade solo runs) enforced inside the tool, the
-    telemetry-derived device dispatches per move (a K-way fused group
-    is ONE dispatch where the unfused arm pays K), and the
-    compiles-healthy contract — ``compiles.timed == 0``: the fused
-    program compiles once per group composition in the warmup pass,
-    never in a measured pass. Reduced per-session shape (pow2 so
-    equal sessions pack with zero padding rows); best-effort."""
+    throughput at 1/4/8/32 concurrent sessions on identical
+    per-session campaigns, with the per-session BITWISE flux parity
+    gate (both arms vs bare-facade solo runs) enforced inside the
+    tool, the telemetry-derived device dispatches per move (a K-way
+    fused group is ONE dispatch where the unfused arm pays K), and
+    the compiles-healthy contract — ``compiles.timed == 0``: the
+    fused program compiles once per group composition in the warmup
+    pass, never in a measured pass. The ``"streaming"`` sub-row (r20)
+    repeats the A/B on StreamingTally facades whose moves coalesce
+    CHUNK-WISE (one walk_fused launch per chunk index), at 4/8
+    sessions. Reduced per-session shape (pow2 so equal sessions pack
+    with zero padding rows); best-effort."""
     sys.path.insert(
         0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
     )
@@ -589,9 +592,36 @@ def run_service_fusion_ab() -> dict | None:
     # Pow2 FLOOR of the (bounded) per-session batch: equal-sized
     # sessions then pack with zero dead rows (fusion.padded_total).
     n = min(N, 8192)
-    return exp_fusion_ab.run_ab(
-        n=1 << (n.bit_length() - 1),
-        div=min(MESH_DIV, 12), moves=2, batches=8,
+    n = 1 << (n.bit_length() - 1)
+    res = exp_fusion_ab.run_ab(
+        n=n, div=min(MESH_DIV, 12), moves=2, batches=8,
+    )
+    res["streaming"] = exp_fusion_ab.run_ab(
+        n=n, div=min(MESH_DIV, 12), moves=2, batches=8,
+        facade="stream", chunk_size=max(1, n // 2),
+        session_counts=(4, 8),
+    )
+    return res
+
+
+def run_service_load() -> dict | None:
+    """Headline serving row (r20, tools/exp_service_load.py run_load_row)
+    — >= 100 scripted OpenMC-style clients with a DETERMINISTIC seeded
+    Poisson arrival schedule (tools/loadgen.py) driven through a
+    2-worker SessionRouter: served moves/s, client-observed p50/p99
+    submit->resolve latency, per-lane Jain fairness, and refusal
+    counts, with the bitwise spot-check parity gate (sampled clients'
+    flux vs solo replays of their seeded campaigns) and the
+    compiles-healthy contract — ``compiles.timed == 0``: every fused
+    group composition the measured run can dispatch is pre-compiled
+    by the warmup ladder. Reduced shape; best-effort."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    )
+    import exp_service_load
+
+    return exp_service_load.run_load_row(
+        n=min(N, 512), div=min(MESH_DIV, 6), clients=120,
     )
 
 
@@ -1075,6 +1105,12 @@ def _measure_and_report() -> None:
             service_fusion = run_service_fusion_ab()
         except Exception as e:  # noqa: BLE001 — extra row, best-effort
             print(f"# service fusion A/B failed: {e}", file=sys.stderr)
+    service_load = None
+    if os.environ.get("PUMIUMTALLY_BENCH_SERVICE_LOAD", "1") != "0":
+        try:
+            service_load = run_service_load()
+        except Exception as e:  # noqa: BLE001 — extra row, best-effort
+            print(f"# service load run failed: {e}", file=sys.stderr)
     distributed = None
     if os.environ.get("PUMIUMTALLY_BENCH_DISTRIBUTED", "1") != "0":
         try:
@@ -1252,8 +1288,17 @@ def _measure_and_report() -> None:
         # bitwise inside the tool, both arms), device dispatches per
         # move (~1/K under fusion), and the compiles-healthy contract
         # (compiles.timed == 0: walk_fused compiles once per group
-        # composition, in warmup only).
+        # composition, in warmup only). The "streaming" sub-row (r20)
+        # is the same A/B on chunk-wise fused StreamingTally facades.
         "service_fusion": service_fusion,
+        # Served throughput under load (r20): >= 100 scripted clients
+        # with a deterministic seeded arrival schedule through a
+        # 2-worker router (tools/exp_service_load.py) — served
+        # moves/s, client-observed p50/p99 latency, per-lane Jain
+        # fairness, refusal counts, the bitwise spot-check parity
+        # gate, and the compiles-healthy contract (compiles.timed ==
+        # 0: the warmup ladder pre-compiles every fused composition).
+        "service_load": service_load,
         # Pod-scale distributed campaigns (r13): collective vs
         # global-scatter migration (flux parity bitwise inside the
         # tool), fenced per-move ms, modeled migration-collective

@@ -26,7 +26,8 @@ Design points:
 from __future__ import annotations
 
 import time
-from typing import Optional, Union
+from dataclasses import dataclass
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,27 @@ from pumiumtally_tpu.api.tally import (
     zero_flying_side_effect,
 )
 from pumiumtally_tpu.mesh.tetmesh import TetMesh
+
+
+@dataclass
+class FusedStreamStage:
+    """One streaming session's share of a fused CHUNK-WISE launch
+    (round 20): the host half of a move, chunk-major — produced by
+    ``StreamingTally._fused_move_stage`` and consumed by
+    ``service/fusion.py``'s per-chunk pack loop. Every list holds one
+    entry per chunk, padded to ``chunk_size`` with the solo staging
+    rules (positions repeat the last row; pad slots never fly; unit
+    weights include pad rows, staged weights pad 0.0), so each packed
+    slab segment carries byte-identical rows to the solo chunk
+    staging. The scoring operands are the per-chunk device arrays a
+    solo streaming move would resolve (``None`` with scoring off)."""
+
+    dests: List[np.ndarray]  # per-chunk [chunk,3] working dtype, host
+    origins: Optional[List[np.ndarray]]  # None = continue mode
+    fly: List[np.ndarray]  # per-chunk [chunk] int8 host, pads grounded
+    w: List[np.ndarray]  # per-chunk [chunk] working dtype, host
+    sbin: Optional[List[jnp.ndarray]]  # per-chunk device (scoring only)
+    sfac: Optional[List[jnp.ndarray]]  # per-chunk device (scoring only)
 
 
 class StreamingTally(PumiTally):
@@ -596,6 +618,175 @@ class StreamingTally(PumiTally):
         if self._sentinel is not None:
             self._move_s[k] = s_b
         return ok
+
+    # -- cross-session chunk-wise fusion (round 20, service/fusion.py) ---
+    def _fusion_key(self):
+        """Streaming arm of the co-fusability identity (see
+        ``PumiTally._fusion_key``): compatible streaming sessions fuse
+        CHUNK-WISE — chunk j of every session packs one slab, one
+        shared launch per chunk index. The key leads with the facade
+        kind, so a group can never mix monolithic and streaming heads
+        (their launch geometry differs — the scheduler's ``group_key``
+        comparison refuses the mix by construction), and pins
+        ``num_particles`` + ``chunk_size``: an equal chunk grid makes
+        every fused launch one static (spans, pad) composition, one
+        trace key per group size like the monolithic path. Subclasses
+        (partitioned streaming — engine-owned state), sharded facades,
+        and xpoint recorders never fuse."""
+        if type(self) is not StreamingTally:
+            return None
+        if self.device_mesh is not None or self.config.record_xpoints:
+            return None
+        spec = self.config.scoring
+        return (
+            "stream",
+            id(self.mesh),
+            str(np.dtype(self.dtype)),
+            self._tol,
+            self._max_iters,
+            self._walk_kw,
+            self._table_dtype,
+            None if spec is None else spec.static_key(),
+            self.num_particles,
+            self.chunk_size,
+        )
+
+    def _fused_chunk_positions(self, host: np.ndarray,
+                               k: int) -> np.ndarray:
+        """Host-side twin of ``_stage_chunk_positions`` for the fused
+        pack: byte-identical values (working-dtype cast, last-row
+        repeat padding), left on the HOST so the pack step pays one
+        upload per operand per chunk however many sessions share it.
+        No re-validation — the op prevalidated at submit, like the
+        monolithic stage."""
+        lo, hi = self._chunk_bounds(k)
+        a = np.asarray(
+            host[3 * lo : 3 * hi].reshape(hi - lo, 3),
+            dtype=np.dtype(self.dtype),
+        )
+        if hi - lo < self.chunk_size:
+            a = np.concatenate(
+                [a, np.repeat(a[-1:], self.chunk_size - (hi - lo), axis=0)]
+            )
+        return a
+
+    def _fused_chunk_vec(self, host, k: int, dtype, fill) -> np.ndarray:
+        """Host-side twin of ``_stage_chunk_vec`` (same values, no
+        upload — the pack's slab concatenation owns the bytes)."""
+        lo, hi = self._chunk_bounds(k)
+        a = np.asarray(host[lo:hi], dtype=dtype)
+        if hi - lo < self.chunk_size:
+            a = np.concatenate(
+                [a, np.full(self.chunk_size - (hi - lo), fill, dtype=dtype)]
+            )
+        return a
+
+    def _fused_move_stage(self, op) -> FusedStreamStage:
+        """The host half of one streaming move for a fused group (same
+        contract as ``PumiTally._fused_move_stage``: the protocol-order
+        checks re-run with the same errors, NO facade state mutates —
+        a later pack/launch failure falls back to the solo path with
+        the campaign untouched). Chunk-major: every operand stages per
+        chunk under the solo path's padding rules, and the scoring
+        operands resolve per chunk exactly as a solo streaming move
+        would."""
+        self._check_poisoned()
+        if not self.is_initialized:
+            raise RuntimeError(
+                "CopyInitialPosition must be called before "
+                "MoveToNextLocation (reference invariant, "
+                "PumiTallyImpl.cpp:437-438)"
+            )
+        self._score_args_check(op.energy, op.time)
+        wd = np.dtype(self.dtype)
+        dests: List[np.ndarray] = []
+        origins = None if op.origins is None else []
+        fly: List[np.ndarray] = []
+        w: List[np.ndarray] = []
+        scoring = self._scoring is not None
+        sbin = [] if scoring else None
+        sfac = [] if scoring else None
+        for k in range(self.nchunks):
+            lo, hi = self._chunk_bounds(k)
+            dests.append(self._fused_chunk_positions(op.dests, k))
+            if origins is not None:
+                origins.append(self._fused_chunk_positions(op.origins, k))
+            if op.flying is None:
+                f = np.ones(self.chunk_size, np.int8)
+                f[hi - lo :] = 0  # pad slots never fly
+            else:
+                # Staged fill is already 0, matching the solo path's
+                # pad mask.
+                f = self._fused_chunk_vec(op.flying, k, np.int8, 0)
+            fly.append(f)
+            w.append(
+                np.ones(self.chunk_size, wd) if op.weights is None
+                else self._fused_chunk_vec(op.weights, k, wd, 0.0)
+            )
+            if scoring:
+                e_c = (
+                    None if op.energy is None
+                    else self._stage_chunk_vec(op.energy, k, wd, 0.0)
+                )
+                t_c = (
+                    None if op.time is None
+                    else self._stage_chunk_vec(op.time, k, wd, 0.0)
+                )
+                sb, sf = self._scoring.resolve(e_c, t_c, self.chunk_size)
+                sbin.append(sb)
+                sfac.append(sf)
+        return FusedStreamStage(dests=dests, origins=origins, fly=fly,
+                                w=w, sbin=sbin, sfac=sfac)
+
+    def _fused_move_commit(self, res, stage: FusedStreamStage, t0: float,
+                           sentinel_ops=None) -> None:
+        """The state half of one fused streaming move: adopt every
+        chunk's slice of the shared per-chunk launches, then run the
+        solo streaming move's post-dispatch sequence in the solo order
+        (per-chunk adopt + ray stash, counters, deferred-check hook,
+        verdict correction, the sentinel audit/ladder at the batch
+        sync point, found-all check, fence, timing, resilience move
+        hook). ``res`` is a list over chunks of this session's
+        ``(x, elem, flux, done, s, bank-or-None)`` slices;
+        ``sentinel_ops`` — one ``(origins, dests, fly, w)`` device
+        slice tuple per chunk (``origins`` None in continue mode) — is
+        required iff a sentinel is armed. The auto-continue echo
+        snapshots are left as they were, exactly like the monolithic
+        commit (a stale snapshot is value-correct by construction)."""
+        stash = [] if self._sentinel is not None else None
+        self._move_s = {}
+        oks = []
+        for k, (x2, elem2, flux2, done, s_b, bank2) in enumerate(res):
+            if stash is not None:
+                org, dest, fly_k, w_k = sentinel_ops[k]
+                # Phase-B start BEFORE the adopt below — the committed
+                # pre-move chunk state, as _chunk_phase_b_start reads.
+                x0 = self._x[k] if org is None else org
+                stash.append((
+                    k, x0, dest, fly_k, w_k,
+                    None if stage.sbin is None else stage.sbin[k],
+                    None if stage.sfac is None else stage.sfac[k],
+                ))
+            self._x[k], self._elem[k], self._flux[k] = x2, elem2, flux2
+            if self._scoring is not None:
+                self._score[k] = bank2
+            if self._sentinel is not None:
+                self._move_s[k] = s_b
+            oks.append(done)
+        self.iter_count += 1
+        self._stats_note_move()
+        self._after_chunk_dispatch()
+        oks = self._correct_verdicts(oks)
+        if stash is not None:
+            oks = self._sentinel_chunks_post_move(stash, oks)
+        if self.config.check_found_all and not all(
+            bool(jnp.all(o)) for o in oks
+        ):
+            print("ERROR: Not all particles are found. May need more loops in search")
+        if self.config.fenced_timing:
+            jax.block_until_ready(self._flux)
+        self.tally_times.total_time_to_tally += _perf_counter() - t0
+        self._resilience_note_move()  # drain/timer-cadence safe point
 
     # -- state views ------------------------------------------------------
     @property

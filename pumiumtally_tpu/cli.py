@@ -191,7 +191,8 @@ def cmd_serve(args) -> None:
         coords, tets = _load(args.mesh)
         default_mesh = TetMesh.from_arrays(coords, tets)
     service = TallyService(handle_signals=True,
-                           fuse_sessions=not args.no_fuse)
+                           fuse_sessions=not args.no_fuse,
+                           admission_budget=args.admission_budget)
     frontend = SocketFrontend(
         service, host=args.host, port=args.port,
         default_mesh=default_mesh, default_particles=args.particles,
@@ -259,6 +260,56 @@ def cmd_route(args) -> None:
         _signal.signal(_signal.SIGTERM, prev)
         router.stop()
     raise SystemExit(0)
+
+
+def cmd_loadgen(args) -> None:
+    """Drive scripted OpenMC-style clients at a running ``serve``
+    worker or ``route`` router and print the heavy-traffic report
+    (tools/loadgen.py, round 20): served moves/s, p50/p99
+    submit→resolve latency, per-lane Jain fairness, refusal counts.
+    Pure client side — needs only the repository's tools/ directory
+    and numpy, no jax, no device."""
+    import importlib.util as _ilu
+    import json as _json
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    lg_path = os.path.join(tools, "loadgen.py")
+    if not os.path.isfile(lg_path):
+        raise SystemExit(
+            "loadgen needs the repository's tools/ directory "
+            f"(expected {lg_path}); run from a source checkout"
+        )
+    spec = _ilu.spec_from_file_location("pumiumtally_loadgen", lg_path)
+    loadgen = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    host, sep, port = args.connect.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(f"--connect {args.connect!r} is not host:port")
+    try:
+        mix = tuple(float(x) for x in args.priority_mix.split(","))
+        if len(mix) != 3:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"--priority-mix {args.priority_mix!r} is not "
+            "three comma-separated weights (high,normal,low)"
+        ) from None
+    report = loadgen.run_load(
+        host, int(port), clients=args.clients, rate=args.rate,
+        particles=args.particles, batches=args.batches,
+        moves=args.moves, facade=args.facade,
+        chunk_size=args.chunk_size,
+        mesh_box=tuple(args.mesh_box), priority_mix=mix,
+        seed=args.seed, timeout=args.timeout,
+    )
+    if args.json:
+        print(_json.dumps(report, default=float))
+    else:
+        print(loadgen.format_report(report))
+    if report["clients_failed"] or report["clients_timed_out"]:
+        raise SystemExit(1)
 
 
 def _subproc_timeout() -> float:
@@ -440,6 +491,13 @@ def main(argv=None) -> None:
                    help="disable cross-session batch fusion (serve "
                         "every session's ops one launch at a time — "
                         "the pre-round-12 dispatch path)")
+    c.add_argument("--admission-budget", type=int, default=None,
+                   metavar="COST",
+                   help="global cap on queued + in-flight transport "
+                        "cost units (particles); beyond it, opens and "
+                        "submits refuse with a structured overloaded "
+                        "error instead of growing the staging heap "
+                        "(default: unbounded)")
     c.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(
@@ -454,6 +512,40 @@ def main(argv=None) -> None:
                    help="0 = ephemeral (the bound port is printed as "
                         "one JSON line)")
     c.set_defaults(fn=cmd_route)
+
+    c = sub.add_parser(
+        "loadgen",
+        help="drive scripted clients at a serve/route address and "
+             "report served throughput, latency, fairness, refusals",
+    )
+    c.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="a running serve worker or route router")
+    c.add_argument("--clients", type=int, default=100)
+    c.add_argument("--rate", type=float, default=200.0,
+                   help="Poisson arrival rate, clients/second")
+    c.add_argument("--particles", type=int, default=64)
+    c.add_argument("--batches", type=int, default=1)
+    c.add_argument("--moves", type=int, default=2,
+                   help="moves per batch")
+    c.add_argument("--facade", choices=("mono", "stream"),
+                   default="mono")
+    c.add_argument("--chunk-size", type=int, default=None,
+                   help="streaming chunk size (facade=stream)")
+    c.add_argument("--mesh-box", type=float, nargs=6,
+                   default=(1.0, 1.0, 1.0, 3.0, 3.0, 3.0),
+                   metavar=("LX", "LY", "LZ", "NX", "NY", "NZ"),
+                   help="box mesh every client opens against")
+    c.add_argument("--priority-mix", default="0.2,0.6,0.2",
+                   metavar="H,N,L",
+                   help="lane probabilities high,normal,low")
+    c.add_argument("--seed", type=int, default=0,
+                   help="schedule seed (arrivals, priorities, "
+                        "positions — the work is deterministic)")
+    c.add_argument("--timeout", type=float, default=600.0,
+                   help="per-client join bound, seconds")
+    c.add_argument("--json", action="store_true",
+                   help="print the full report as one JSON line")
+    c.set_defaults(fn=cmd_loadgen)
 
     c = sub.add_parser(
         "aot-check",

@@ -172,7 +172,16 @@ RETRACE_BUDGETS: dict = {
     # fusion A/B schema row and the bitwise suites drive two group
     # compositions in one test — e.g. continue-mode AND
     # origin-passing 3-session slabs) + 1 headroom.
-    "walk_fused": 3,
+    # Re-measured in r20 after streaming chunk-wise fusion joined the
+    # entry point (one spans=(chunk,)*K key per group size K): the
+    # service_load bench row's warmup ladder deliberately compiles
+    # every composition K=2..max_fuse=8 in one test (7 keys,
+    # PUMIUMTALLY_RETRACE_RECORD over tests/test_bench.py +
+    # tests/test_traffic.py + tests/test_fusion.py), and the
+    # service_fusion row's 32-session point adds a DRR-desync
+    # straggler composition on top of its 4/8-way mono + stream keys
+    # (measured 5). Max 7 + 1 headroom.
+    "walk_fused": 8,
 }
 
 

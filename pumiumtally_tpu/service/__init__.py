@@ -33,6 +33,14 @@ many concurrent client sessions:
   (``pumiumtally route``) pins every session to a home worker at open
   and forwards its NDJSON ops there, so the multi-session machinery
   scales horizontally with the same per-session bitwise contract.
+- traffic engineering (round 20) — streaming sessions fuse
+  chunk-wise (one shared launch per chunk index, same ``walk_fused``
+  program); ``Priority`` lanes over the DRR ring (strict priority
+  between lanes, DRR within); a global admission budget that refuses
+  with a structured ``ServiceOverloadedError`` before buffers are
+  touched; ``TallyService.stats()`` + the ping ``"load"`` reply feed
+  the load generator (tools/loadgen.py, ``pumiumtally loadgen``) and
+  the router's least-loaded placement.
 
 Core contract — determinism under concurrency: each session's output
 is BITWISE the solo run of the same campaign, regardless of how the
@@ -43,10 +51,14 @@ queues, numpy buffers) — the fused entry point is the service's one
 addition to config.RETRACE_BUDGETS.
 """
 
-from pumiumtally_tpu.service.scheduler import DeficitRoundRobinScheduler
+from pumiumtally_tpu.service.scheduler import (
+    DeficitRoundRobinScheduler,
+    Priority,
+)
 from pumiumtally_tpu.service.session import (
     DEFAULT_QUEUE_DEPTH,
     ServiceBusyError,
+    ServiceOverloadedError,
     SessionClosedError,
     SessionState,
     TallySession,
@@ -62,8 +74,10 @@ from pumiumtally_tpu.service.server import (
 __all__ = [
     "DEFAULT_QUEUE_DEPTH",
     "DeficitRoundRobinScheduler",
+    "Priority",
     "ServiceBusyError",
     "ServiceDrainingError",
+    "ServiceOverloadedError",
     "SessionClosedError",
     "SessionHandle",
     "SessionRouter",

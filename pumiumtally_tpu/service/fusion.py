@@ -31,6 +31,14 @@ This module is that dispatch-amortization layer:
   solo move's post-walk sequence (sentinel audit, counters, fence,
   timing, resilience hook) runs per-session, after the shared launch.
 
+Round 20 extends the window past the monolithic facade: compatible
+``StreamingTally`` sessions (same chunk grid, pinned by their
+``"stream"``-kinded fusion key) fuse CHUNK-WISE — one shared launch
+per chunk index through the SAME ``walk_fused`` program
+(``_pack_and_launch_stream``), preserving the solo pipeline's
+staging/walk overlap K-sessions wide. Monolithic and streaming heads
+never mix: their keys differ in kind.
+
 Determinism (the service's core contract, extended): a session's
 fused campaign output is BITWISE the solo run. Per-particle outputs
 are independent arithmetic; for the accumulated banks, a session's
@@ -61,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pumiumtally_tpu.api.streaming import FusedStreamStage
 from pumiumtally_tpu.api.tally import move_step, move_step_continue
 from pumiumtally_tpu.service import staging
 from pumiumtally_tpu.utils.profiling import register_entry_point
@@ -224,8 +233,12 @@ def run_group(items: List[Tuple]) -> Tuple[bool, int, int]:
         return False, 0, 0
     if len(live) == 1:
         return _run_solo(live), 0, 1
+    chunked = isinstance(live[0][2], FusedStreamStage)
     try:
-        outs, devs = _pack_and_launch(live)
+        if chunked:
+            outs, devs = _pack_and_launch_stream(live)
+        else:
+            outs, devs = _pack_and_launch(live)
     except BaseException as e:  # noqa: BLE001 — availability first: a
         # failing shared launch must not take K sessions down when
         # each op can still run solo (and a per-session cause then
@@ -236,14 +249,28 @@ def run_group(items: List[Tuple]) -> Tuple[bool, int, int]:
             "re-executing the group unfused"
         )
         return _run_solo(live), 0, len(live)
-    dests_dev, fly_dev, w_dev, org_dev = devs
     drain = False
     a = 0
     for k, (sess, op, st) in enumerate(live):
-        n_k = sess.tally.num_particles
         try:
             s_ops = None
-            if sess.tally._sentinel is not None:
+            if chunked:
+                # One sentinel-operand slice tuple per chunk: session
+                # k's rows sit at the same offset in every chunk slab.
+                if sess.tally._sentinel is not None:
+                    C = sess.tally.chunk_size
+                    lo = k * C
+                    s_ops = [
+                        (
+                            None if st.origins is None
+                            else org[lo:lo + C],
+                            d[lo:lo + C], f[lo:lo + C], wv[lo:lo + C],
+                        )
+                        for (d, f, wv, org) in devs
+                    ]
+            elif sess.tally._sentinel is not None:
+                n_k = sess.tally.num_particles
+                dests_dev, fly_dev, w_dev, org_dev = devs
                 x_start = (
                     st.x_prev if st.origins is None
                     else org_dev[a:a + n_k]
@@ -260,7 +287,8 @@ def run_group(items: List[Tuple]) -> Tuple[bool, int, int]:
             op.future.set_exception(e)
         else:
             op.future.set_result(None)
-        a += n_k
+        if not chunked:
+            a += sess.tally.num_particles
     return drain, len(live), 0
 
 
@@ -323,3 +351,69 @@ def _pack_and_launch(live):
         stride=rep._scoring.stride if scoring else 0,
     )
     return outs, (dests_dev, fly_dev, w_dev, org_dev)
+
+
+def _pack_and_launch_stream(live):
+    """The streaming (chunk-wise) pack: one fused launch PER CHUNK
+    INDEX, through the SAME ``walk_fused`` program as the monolithic
+    path — the fusion key pinned every session to one chunk grid, so
+    chunk j of each session contributes exactly ``chunk_size`` rows
+    and all launches share one static ``(spans, pad, use_committed)``
+    composition (one trace key per group size, however many chunks).
+    Each chunk's launch dispatches before the next chunk's host pack,
+    so the solo streaming pipeline's staging/walk overlap is kept —
+    just K-sessions wide. Returns per-SESSION output lists
+    (``outs[k][j]`` = session k's chunk-j slices) and the per-chunk
+    uploaded slab tuples (the sentinel commits slice them)."""
+    rep = live[0][0].tally  # representative: the key pinned the statics
+    wd = np.dtype(rep.dtype)
+    K = len(live)
+    C = rep.chunk_size
+    spans = (C,) * K
+    pad = padded_total(K * C) - K * C
+    zeros3 = np.zeros((pad, 3), wd)
+    stages = [st for _sess, _op, st in live]
+    tallies = [sess.tally for sess, _op, _st in live]
+    use_committed = tuple(st.origins is None for st in stages)
+    scoring = rep._scoring is not None
+    outs = [[] for _ in range(K)]
+    devs = []
+    for j in range(rep.nchunks):
+        dests_dev = jnp.asarray(np.concatenate(
+            [st.dests[j] for st in stages] + [zeros3]
+        ))
+        fly_dev = jnp.asarray(np.concatenate(
+            [st.fly[j] for st in stages] + [np.zeros(pad, np.int8)]
+        ))
+        w_dev = jnp.asarray(np.concatenate(
+            [st.w[j] for st in stages] + [np.zeros(pad, wd)]
+        ))
+        org_dev = None
+        if not all(use_committed):
+            org_dev = jnp.asarray(np.concatenate(
+                [
+                    st.origins[j] if st.origins is not None
+                    else np.zeros((C, 3), wd)
+                    for st in stages
+                ]
+                + [zeros3]
+            ))
+        chunk_outs = _fused_move(
+            rep.mesh,
+            tuple(t._x[j] for t in tallies),
+            tuple(t._elem[j] for t in tallies),
+            tuple(t._flux[j] for t in tallies),
+            tuple(t._score[j] for t in tallies) if scoring else None,
+            tuple(st.sbin[j] for st in stages) if scoring else None,
+            tuple(st.sfac[j] for st in stages) if scoring else None,
+            dests_dev, fly_dev, w_dev, org_dev,
+            spans=spans, pad=pad, use_committed=use_committed,
+            tol=rep._tol, max_iters=rep._max_iters,
+            walk_kw=rep._walk_kw,
+            score_kinds=rep._scoring.spec.kinds if scoring else (),
+            stride=rep._scoring.stride if scoring else 0,
+        )
+        for k in range(K):
+            outs[k].append(chunk_outs[k])
+        devs.append((dests_dev, fly_dev, w_dev, org_dev))
+    return outs, devs

@@ -47,6 +47,7 @@ import json
 import os
 import socket
 import threading
+import time
 import warnings
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
@@ -54,9 +55,13 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from pumiumtally_tpu.service import staging
-from pumiumtally_tpu.service.scheduler import DeficitRoundRobinScheduler
+from pumiumtally_tpu.service.scheduler import (
+    DeficitRoundRobinScheduler,
+    Priority,
+)
 from pumiumtally_tpu.service.session import (
     ServiceBusyError,
+    ServiceOverloadedError,
     SessionClosedError,
     SessionState,
     TallySession,
@@ -91,13 +96,28 @@ class TallyService:
       max_fuse: the fusion window — at most this many compatible
         session heads share one launch (bounds slab size and trace
         keys).
+      admission_budget: global cap on transport (source/move) cost
+        units queued or in flight across ALL sessions (round 20).
+        None (default) = unbounded, the pre-round-20 behaviour. With a
+        budget, a submit that would exceed it — or an ``open_session``
+        arriving while the budget is already full — refuses with a
+        structured ``ServiceOverloadedError`` BEFORE any state
+        changes, so a thousand eager clients backlog at the protocol
+        layer instead of OOMing the staging heap. Reads and the close
+        sentinel never count against (or get refused by) the budget:
+        telemetry and teardown must stay live under overload.
     """
 
     def __init__(self, *, handle_signals: bool = False,
                  quantum: Optional[int] = None, autostart: bool = True,
-                 fuse_sessions: bool = True, max_fuse: int = 8):
+                 fuse_sessions: bool = True, max_fuse: int = 8,
+                 admission_budget: Optional[int] = None):
         if int(max_fuse) < 1:
             raise ValueError(f"max_fuse must be >= 1, got {max_fuse!r}")
+        if admission_budget is not None and int(admission_budget) < 1:
+            raise ValueError(
+                f"admission_budget must be >= 1, got {admission_budget!r}"
+            )
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._sessions: Dict[str, TallySession] = {}
@@ -110,6 +130,17 @@ class TallyService:
         self._handle_signals = bool(handle_signals)
         self._fuse = bool(fuse_sessions)
         self._max_fuse = int(max_fuse)
+        self._admission_budget = (
+            None if admission_budget is None else int(admission_budget)
+        )
+        # Transport cost units admitted and not yet completed
+        # (queued + in flight) — the admission ledger. Credited in
+        # _submit under the lock, debited when the worker resolves the
+        # op, so the budget bounds live staging-heap footprint.
+        self._admitted_cost = 0
+        self.admission_stats: Dict[str, int] = {
+            "refused_ops": 0, "refused_sessions": 0,
+        }
         # Serving telemetry (read by the fusion A/B): how many device
         # dispatch opportunities coalesced. "fused_groups" counts
         # shared launches, "fused_moves" the moves they carried,
@@ -223,14 +254,32 @@ class TallyService:
 
     # -- sessions --------------------------------------------------------
     def open_session(self, tally, *, session_id: Optional[str] = None,
-                     max_queue: Optional[int] = None) -> "SessionHandle":
+                     max_queue: Optional[int] = None,
+                     priority: Priority = Priority.NORMAL
+                     ) -> "SessionHandle":
         """Admit one client: wrap its facade (any of the five kinds,
         built by the caller so the client picks engine/config) in a
-        session and register it with the scheduler."""
+        session and register it with the scheduler, in the lane named
+        by ``priority`` (fixed for the session's lifetime). With an
+        admission budget armed, an open arriving while the budget is
+        already full refuses with ``ServiceOverloadedError`` — a new
+        client's first submit could never be admitted anyway, and
+        refusing at open lets a router place it elsewhere."""
         with self._lock:
             if self._drain or self._stop:
                 raise ServiceDrainingError(
                     "service is draining: no new sessions"
+                )
+            if (self._admission_budget is not None
+                    and self._admitted_cost >= self._admission_budget):
+                self.admission_stats["refused_sessions"] += 1
+                raise ServiceOverloadedError(
+                    f"admission budget full ({self._admitted_cost}/"
+                    f"{self._admission_budget} cost units queued or in "
+                    "flight): no new sessions — retry after outstanding "
+                    "work resolves, or route elsewhere",
+                    budget=self._admission_budget,
+                    admitted=self._admitted_cost,
                 )
             sid = session_id
             if sid is None:
@@ -244,9 +293,10 @@ class TallyService:
             if sid in self._sessions:
                 raise ValueError(f"session id {sid!r} already open")
             kw = {} if max_queue is None else {"max_queue": max_queue}
-            sess = TallySession(sid, tally, **kw)
+            sess = TallySession(sid, tally, priority=Priority(priority),
+                                **kw)
             self._sessions[sid] = sess
-            self._sched.register(sid)
+            self._sched.register(sid, priority=sess.priority)
         if self._handle_signals and (
             threading.current_thread() is threading.main_thread()
         ):
@@ -266,6 +316,55 @@ class TallyService:
         with self._lock:
             return tuple(self._sessions)
 
+    # -- telemetry --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """One structured, JSON-serializable snapshot of serving
+        telemetry (round 20) — what the load generator and the router
+        read instead of scraping logs. Schema (pinned by
+        tests/test_traffic.py):
+
+        - ``"sessions"``: ``{sid: {state, priority, pending,
+          queued_cost, ops_completed, moves_completed, latency_p50_ms,
+          latency_p99_ms}}`` — the latency quantiles are
+          submit→resolve wall time over the session's last
+          ``session.LATENCY_WINDOW`` completions (None before the
+          first);
+        - ``"fusion"``: a copy of ``fusion_stats``;
+        - ``"admission"``: ``{budget, admitted_cost, queued_cost,
+          inflight_cost, refused_ops, refused_sessions}`` — admitted =
+          queued + inflight; budget None when unbounded.
+        """
+        with self._lock:
+            sessions: Dict[str, Any] = {}
+            queued = 0
+            for sid, sess in self._sessions.items():
+                q = sess.latency_quantiles()
+                qc = sess.queued_cost()
+                queued += qc
+                sessions[sid] = {
+                    "state": sess.state.value,
+                    "priority": sess.priority.name.lower(),
+                    "pending": sess.pending(),
+                    "queued_cost": qc,
+                    "ops_completed": sess.ops_completed,
+                    "moves_completed": sess.moves_completed,
+                    "latency_p50_ms": None if q is None else q[0] * 1e3,
+                    "latency_p99_ms": None if q is None else q[1] * 1e3,
+                }
+            return {
+                "sessions": sessions,
+                "fusion": dict(self.fusion_stats),
+                "admission": {
+                    "budget": self._admission_budget,
+                    "admitted_cost": self._admitted_cost,
+                    "queued_cost": queued,
+                    "inflight_cost": self._admitted_cost - queued,
+                    "refused_ops": self.admission_stats["refused_ops"],
+                    "refused_sessions":
+                        self.admission_stats["refused_sessions"],
+                },
+            }
+
     # -- submission (called by SessionHandle) -----------------------------
     def _submit(self, sess: TallySession, op: staging.StagedOp) -> Future:
         with self._cv:
@@ -273,7 +372,28 @@ class TallyService:
                 raise ServiceDrainingError(
                     "service is draining: no new work accepted"
                 )
-            sess.submit(op)
+            transport = op.kind != "call"
+            if (transport and self._admission_budget is not None
+                    and self._admitted_cost + op.cost
+                    > self._admission_budget):
+                # Refused BEFORE sess.submit: nothing queued, no
+                # accounting moved, caller buffers untouched
+                # (accept-then-zero — SessionHandle.move only zeroes
+                # flying after this returns).
+                self.admission_stats["refused_ops"] += 1
+                raise ServiceOverloadedError(
+                    f"admission budget exhausted: {self._admitted_cost}"
+                    f"/{self._admission_budget} cost units queued or in "
+                    f"flight, op costs {op.cost} — retry after "
+                    "outstanding futures resolve",
+                    budget=self._admission_budget,
+                    admitted=self._admitted_cost,
+                    cost=op.cost,
+                )
+            sess.submit(op)  # may still refuse busy/closed: not admitted
+            op.t_submit = time.perf_counter()
+            if transport:
+                self._admitted_cost += op.cost
             self._cv.notify_all()
         if self._autostart:
             self.start()
@@ -316,6 +436,7 @@ class TallyService:
                 )
             sess.begin_drain()
             sess.submit_final(op)
+            op.t_submit = time.perf_counter()
             sess.close_future = op.future
             self._cv.notify_all()
         if self._autostart:
@@ -408,6 +529,8 @@ class TallyService:
                     self.fusion_stats[key] += solo_ran
                 for sess, op in items:
                     self._inflight -= 1
+                    if op.kind != "call":
+                        self._admitted_cost -= op.cost
                     sess.note_completed(op)
                 self._cv.notify_all()
 
@@ -554,7 +677,8 @@ class SocketFrontend:
     - ``{"op": "open", "facade": "mono"|"stream"|"part",
          "num_particles": n, "mesh": {"box": [lx,ly,lz,nx,ny,nz]}?,
          "chunk_size": c?, "batch_stats": bool?, "sentinel": bool?,
-         "checkpoint_dir": path?}`` → ``{"ok": true, "session": id}``.
+         "checkpoint_dir": path?, "priority": "high"|"normal"|"low"?}``
+      → ``{"ok": true, "session": id}``.
       Omitted mesh = the server's default; ``{"path": ...}`` meshes
       need ``allow_mesh_paths=True`` (the CLI's --allow-mesh-paths).
       ``checkpoint_dir`` must be unique per open session (one
@@ -575,11 +699,19 @@ class SocketFrontend:
     - ``{"op": "flux"|"normalized_flux"|"health"|"lost", "session": id}``
     - ``{"op": "close_batch"|"finalize"|"write"|"close", "session": id}``
       ("write" takes "filename"; refused unless ``allow_write``).
-    - ``{"op": "ping"}`` → ``{"ok": true, "draining": bool}``.
+    - ``{"op": "ping"}`` → ``{"ok": true, "draining": bool,
+         "load": {sessions, queued_cost, inflight_cost, admitted_cost,
+         budget}, "fusion": {...fusion_stats}}`` — the aggregate the
+      router's placement and the load generator poll.
+    - ``{"op": "stats"}`` → ``{"ok": true, "stats":
+         TallyService.stats()}`` (per-session p50/p99 latency).
 
     Failures answer ``{"ok": false, "error": <class>, "message": ...}``
-    with ``"busy": true`` for backpressure refusals — the remote
-    client's retry signal.
+    with ``"busy": true`` for per-session backpressure refusals (retry
+    after a future resolves) and ``"overloaded": true`` for
+    service-wide admission-budget refusals (back off or route to
+    another worker) — in both cases the refused op was never admitted
+    and the client's buffers are untouched.
     """
 
     def __init__(self, service: TallyService, host: str = "127.0.0.1",
@@ -609,6 +741,7 @@ class SocketFrontend:
         self._ckpt_lock = threading.Lock()
         self._ckpt_reserved: set = set()  # realpaths in use
         self._ckpt_by_sid: Dict[str, str] = {}
+        self._box_meshes: Dict[tuple, Any] = {}  # see _resolve_mesh
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -698,6 +831,9 @@ class SocketFrontend:
                             "error": type(e).__name__,
                             "message": str(e),
                             "busy": isinstance(e, ServiceBusyError),
+                            "overloaded": isinstance(
+                                e, ServiceOverloadedError
+                            ),
                         }
                     f.write(json.dumps(reply, default=float)
                             .encode("utf-8") + b"\n")
@@ -734,13 +870,44 @@ class SocketFrontend:
                   dropped: Dict[str, int]) -> dict:
         op = req.get("op")
         if op == "ping":
-            return {"ok": True, "draining": self.service.drain_requested}
+            # Schema-pinned (tests/test_traffic.py): "load" is what the
+            # router's least-loaded placement and the load generator
+            # read — live queue depth + in-flight particle cost, not
+            # open-session count.
+            st = self.service.stats()
+            adm = st["admission"]
+            return {
+                "ok": True,
+                "draining": self.service.drain_requested,
+                "load": {
+                    "sessions": len(st["sessions"]),
+                    "queued_cost": adm["queued_cost"],
+                    "inflight_cost": adm["inflight_cost"],
+                    "admitted_cost": adm["admitted_cost"],
+                    "budget": adm["budget"],
+                },
+                "fusion": st["fusion"],
+            }
+        if op == "stats":
+            # The full per-session snapshot (p50/p99 latency included);
+            # ping stays the cheap aggregate.
+            return {"ok": True, "stats": self.service.stats()}
         if op == "open":
+            pr = req.get("priority")
+            try:
+                priority = (Priority.NORMAL if pr is None
+                            else Priority[str(pr).upper()])
+            except KeyError:
+                raise ValueError(
+                    f"unknown priority {pr!r}: expected one of "
+                    f"{[p.name.lower() for p in Priority]}"
+                ) from None
             ckreal = self._reserve_ckpt_dir(req.get("checkpoint_dir"))
             try:
                 h = self.service.open_session(
                     self._build_tally(req),
                     max_queue=req.get("max_queue"),
+                    priority=priority,
                 )
             except BaseException:
                 if ckreal is not None:
@@ -946,8 +1113,20 @@ class SocketFrontend:
             from pumiumtally_tpu import build_box
 
             lx, ly, lz, nx, ny, nz = spec["box"]
-            return build_box(float(lx), float(ly), float(lz),
-                             int(nx), int(ny), int(nz))
+            key = (float(lx), float(ly), float(lz),
+                   int(nx), int(ny), int(nz))
+            # One mesh OBJECT per box spec, not per open: fusion keys
+            # include the mesh's identity, so sessions opened with the
+            # same box must share one mesh to ever co-fuse (they also
+            # then share the walk table's device buffers). Meshes are
+            # immutable; the cache only ever grows by distinct specs.
+            with self._ckpt_lock:
+                mesh = self._box_meshes.get(key)
+            if mesh is None:
+                built = build_box(*key)
+                with self._ckpt_lock:
+                    mesh = self._box_meshes.setdefault(key, built)
+            return mesh
         if "path" in spec:
             if not self.allow_mesh_paths:
                 raise ValueError(
@@ -971,17 +1150,21 @@ class SessionRouter:
     Session-homing rule: a session's facade arrays live on the chips of
     exactly one worker, so every op for a session must land on the
     worker that opened it. The router pins each session to a home
-    worker at ``open`` — the least-open-sessions worker, or the
-    request's ``"home": <index>`` hint — and forwards every subsequent
-    op for that id there verbatim. Router session ids are
-    ``"<home>:<worker-sid>"`` (rewritten in both directions), so a
-    client can read its session's home from the id and the reply's
-    ``"home"`` field.
+    worker at ``open`` — the least-LOADED worker by live queue depth
+    plus in-flight particle cost read over the ping channel (round 20;
+    open-session count and worker index break ties, and a worker whose
+    ping fails or predates the load schema falls back to the router's
+    own session count) — or the request's ``"home": <index>`` hint —
+    and forwards every subsequent op for that id there verbatim.
+    Router session ids are ``"<home>:<worker-sid>"`` (rewritten in
+    both directions), so a client can read its session's home from the
+    id and the reply's ``"home"`` field.
 
     The protocol is byte-identical to ``SocketFrontend``'s per line —
     the router adds no ops and removes none; ``ping`` is answered with
-    the aggregate (``draining`` true when ANY worker drains, plus the
-    worker count). One worker connection per client connection, opened
+    the aggregate (``draining`` true when ANY worker drains, the
+    worker count, and the summed worker loads plus per-backend
+    breakdown). One worker connection per client connection, opened
     lazily: the workers' per-connection session cleanup then makes a
     vanished client drop its sessions on every worker it touched, with
     no router-side bookkeeping.
@@ -1068,6 +1251,9 @@ class SessionRouter:
                             "error": type(e).__name__,
                             "message": str(e),
                             "busy": isinstance(e, ServiceBusyError),
+                            "overloaded": isinstance(
+                                e, ServiceOverloadedError
+                            ),
                         }
                     f.write(json.dumps(reply, default=float)
                             .encode("utf-8") + b"\n")
@@ -1110,6 +1296,36 @@ class SessionRouter:
             )
         return json.loads(line.decode("utf-8"))
 
+    def _least_loaded(self, files, socks) -> int:
+        """Open-time placement by LIVE load (round 20): score each
+        worker ``(queued + in-flight transport cost, open sessions,
+        index)`` read over the ping channel, pick the minimum — a
+        backlogged worker stops winning opens even when its session
+        COUNT is lowest (sessions are cheap; queued particles are
+        not). A worker whose ping fails, or an older worker whose ping
+        reply has no ``"load"`` yet, falls back to the router's own
+        open-session count at zero cost, so a mixed or half-down fleet
+        still places (the open itself will surface a dead worker)."""
+        best = None
+        for i in range(len(self.backends)):
+            try:
+                ld = self._forward(
+                    i, {"op": "ping"}, files, socks
+                ).get("load") or {}
+            except (OSError, RuntimeError, ValueError):
+                ld = {}
+            with self._count_lock:
+                fallback_sessions = self._open_sessions[i]
+            score = (
+                int(ld.get("queued_cost", 0))
+                + int(ld.get("inflight_cost", 0)),
+                int(ld.get("sessions", fallback_sessions)),
+                i,
+            )
+            if best is None or score < best:
+                best = score
+        return best[2]
+
     def _home_of(self, sid: str) -> tuple:
         b, sep, rest = str(sid).partition(":")
         if not sep or not b.isdigit() or int(b) >= len(self.backends):
@@ -1126,20 +1342,27 @@ class SessionRouter:
         if op == "ping":
             # Aggregate health: draining when ANY worker drains (a
             # drain anywhere means new opens may land on a draining
-            # host — clients should stop submitting).
+            # host — clients should stop submitting). Worker loads are
+            # summed and returned per backend too, so a load generator
+            # pointed at the router reads fleet-wide telemetry from
+            # one socket.
             draining = False
+            per_backend = []
+            load = {"sessions": 0, "queued_cost": 0, "inflight_cost": 0}
             for i in range(len(self.backends)):
                 r = self._forward(i, {"op": "ping"}, files, socks)
                 draining = draining or bool(r.get("draining"))
+                ld = r.get("load") or {}
+                per_backend.append(ld)
+                for k in load:
+                    load[k] += int(ld.get(k, 0))
             return {"ok": True, "draining": draining,
-                    "backends": len(self.backends)}
+                    "backends": len(self.backends),
+                    "load": load, "per_backend": per_backend}
         if op == "open":
             home = req.pop("home", None)
             if home is None:
-                with self._count_lock:
-                    home = self._open_sessions.index(
-                        min(self._open_sessions)
-                    )
+                home = self._least_loaded(files, socks)
             home = int(home)
             if not 0 <= home < len(self.backends):
                 raise ValueError(

@@ -30,13 +30,21 @@ pipeline slack at the price of staler backpressure.
 from __future__ import annotations
 
 import enum
+import time
 import warnings
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
+from pumiumtally_tpu.service.scheduler import Priority
 from pumiumtally_tpu.service.staging import StagedOp
 
 DEFAULT_QUEUE_DEPTH = 2
+
+# Completed-op latency samples retained per session for the p50/p99
+# quantiles in TallyService.stats() / the ping reply. A bounded window,
+# not a full history: load telemetry should describe CURRENT service
+# behaviour, and an unbounded list would grow with campaign length.
+LATENCY_WINDOW = 512
 
 
 class SessionState(enum.Enum):
@@ -55,6 +63,26 @@ class SessionClosedError(RuntimeError):
     """The session is draining or closed and accepts no new work."""
 
 
+class ServiceOverloadedError(RuntimeError):
+    """The SERVICE-wide admission budget (total queued + in-flight
+    particle cost across every session) is exhausted: the op or
+    session open was NOT admitted and no state changed — like
+    ``ServiceBusyError``, the refusal leaves caller buffers untouched
+    (accept-then-zero contract). Unlike busy, which is one session's
+    backpressure, overload is global: retry after outstanding futures
+    resolve anywhere, or route to another worker. Carries the numbers
+    a load balancer needs: ``budget``, ``admitted`` (cost units
+    currently queued or in flight), ``cost`` (the refused op's)."""
+
+    def __init__(self, message: str, *, budget: Optional[int] = None,
+                 admitted: Optional[int] = None,
+                 cost: Optional[int] = None):
+        super().__init__(message)
+        self.budget = budget
+        self.admitted = admitted
+        self.cost = cost
+
+
 class TallySession:
     """One client's campaign inside the service (built by
     ``server.TallyService.open_session``; all methods are called under
@@ -62,17 +90,24 @@ class TallySession:
     object)."""
 
     def __init__(self, session_id: str, tally,
-                 max_queue: int = DEFAULT_QUEUE_DEPTH):
+                 max_queue: int = DEFAULT_QUEUE_DEPTH,
+                 priority: Priority = Priority.NORMAL):
         if int(max_queue) < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue!r}")
         self.id = str(session_id)
         self.tally = tally
         self.max_queue = int(max_queue)
+        self.priority = Priority(priority)
         self.state = SessionState.OPEN
         self._queue: deque = deque()
         self.ops_submitted = 0
         self.ops_completed = 0
         self.moves_completed = 0
+        # Transport (source/move) cost units sitting in THIS queue —
+        # the queued half of the service's admission ledger, kept as a
+        # running counter so head_cost/stats stay O(1).
+        self._queued_cost = 0
+        self._latencies: deque = deque(maxlen=LATENCY_WINDOW)
         # The close sentinel's future, once a close is issued: a
         # second close() returns it instead of queueing a sentinel the
         # scheduler could never pick after the first one unregisters
@@ -106,6 +141,8 @@ class TallySession:
             )
         self._queue.append(op)
         self.ops_submitted += 1
+        if op.kind != "call":
+            self._queued_cost += op.cost
         return op
 
     def submit_final(self, op: StagedOp) -> StagedOp:
@@ -116,6 +153,8 @@ class TallySession:
             raise SessionClosedError(f"session {self.id!r} is closed")
         self._queue.append(op)
         self.ops_submitted += 1
+        if op.kind != "call":
+            self._queued_cost += op.cost
         return op
 
     def head_cost(self) -> Optional[int]:
@@ -128,15 +167,41 @@ class TallySession:
         return self._queue[0] if self._queue else None
 
     def pop(self) -> StagedOp:
-        return self._queue.popleft()
+        op = self._queue.popleft()
+        if op.kind != "call":
+            self._queued_cost -= op.cost
+        return op
 
     def pending(self) -> int:
         return len(self._queue)
+
+    def queued_cost(self) -> int:
+        """Transport cost units currently queued (reads excluded —
+        they carry no particle buffers and cost 1 only for DRR turn
+        accounting)."""
+        return self._queued_cost
 
     def note_completed(self, op: StagedOp) -> None:
         self.ops_completed += 1
         if op.kind == "move":
             self.moves_completed += 1
+        if op.t_submit is not None:
+            self._latencies.append(time.perf_counter() - op.t_submit)
+
+    def latency_quantiles(self) -> Optional[Tuple[float, float]]:
+        """(p50, p99) submit→resolve wall latency in seconds over the
+        last ``LATENCY_WINDOW`` completed ops, or None before the
+        first completion (nearest-rank on the sorted window — exact,
+        no interpolation, cheap at 512 samples)."""
+        if not self._latencies:
+            return None
+        a = sorted(self._latencies)
+        hi = len(a) - 1
+
+        def q(p: float) -> float:
+            return a[min(hi, int(p * hi + 0.5))]
+
+        return q(0.50), q(0.99)
 
     # -- lifecycle -------------------------------------------------------
     def begin_drain(self) -> None:

@@ -91,6 +91,9 @@ class StagedOp:
     energy: Optional[np.ndarray] = None
     time: Optional[np.ndarray] = None
     fn: Optional[Callable[[Any], Any]] = None  # "call" payload
+    # Stamped by TallyService._submit at admission; session
+    # note_completed turns it into a p50/p99 latency sample.
+    t_submit: Optional[float] = None
 
 
 def _owned_f64(a: np.ndarray) -> np.ndarray:

@@ -51,7 +51,13 @@ SESSIONS = ("mono", "stream")  # session ids double as facade kinds
 # moves actually coalesce into shared launches before the SIGTERM
 # lands (ids still prefix-encode the facade kind).
 MONO_PAIR_SESSIONS = ("monoA", "monoB")
-SEEDS = {"mono": 101, "stream": 202, "monoA": 303, "monoB": 404}
+# --stream-pair: two co-fusable STREAMING sessions — the round-20
+# chunk-wise fusion drain arm; with --priorities/--admission-budget
+# the drain lands while priority lanes and the admission gate are
+# live (drain under load).
+STREAM_PAIR_SESSIONS = ("streamA", "streamB")
+SEEDS = {"mono": 101, "stream": 202, "monoA": 303, "monoB": 404,
+         "streamA": 505, "streamB": 606}
 QUEUE_DEPTH = MOVES + 1  # one batch fits the queue: source + M moves
 
 _MESH = None  # one mesh per process: co-fusion keys on mesh identity
@@ -103,18 +109,60 @@ def main() -> None:
                    help="two co-fusable monolithic sessions instead of "
                         "the mono+stream mix (the round-12 fusion drain "
                         "arm)")
+    p.add_argument("--stream-pair", action="store_true",
+                   help="two co-fusable STREAMING sessions (the "
+                        "round-20 chunk-wise fusion drain arm)")
+    p.add_argument("--priorities", default=None,
+                   help="comma-separated lane per session, e.g. "
+                        "'high,low' (default: all normal)")
+    p.add_argument("--admission-budget", type=int, default=None,
+                   help="service admission budget in cost units; the "
+                        "driver retries overload refusals, so the "
+                        "drain lands while the gate is live")
     args = p.parse_args()
-    sessions = MONO_PAIR_SESSIONS if args.mono_pair else SESSIONS
+    if args.mono_pair and args.stream_pair:
+        raise SystemExit("--mono-pair and --stream-pair are exclusive")
+    sessions = (MONO_PAIR_SESSIONS if args.mono_pair
+                else STREAM_PAIR_SESSIONS if args.stream_pair
+                else SESSIONS)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JAX_ENABLE_X64", "true")
 
+    import time
+
     import numpy as np
 
     from pumiumtally_tpu import TallyService, resume_latest
-    from pumiumtally_tpu.service import ServiceDrainingError
+    from pumiumtally_tpu.service import (
+        Priority,
+        ServiceDrainingError,
+        ServiceOverloadedError,
+    )
 
-    svc = TallyService(handle_signals=True)
+    lanes = {}
+    if args.priorities is not None:
+        names = args.priorities.split(",")
+        if len(names) != len(sessions):
+            raise SystemExit(
+                f"--priorities needs {len(sessions)} lanes, got "
+                f"{args.priorities!r}"
+            )
+        lanes = {k: Priority[n.strip().upper()]
+                 for k, n in zip(sessions, names)}
+
+    def submit_admitted(fn, *a, **kw):
+        """Retry overload refusals: the admission gate refuses without
+        touching state, so blind resubmission is correct — exactly
+        what a well-behaved client does under a full budget."""
+        while True:
+            try:
+                return fn(*a, **kw)
+            except ServiceOverloadedError:
+                time.sleep(0.005)
+
+    svc = TallyService(handle_signals=True,
+                       admission_budget=args.admission_budget)
     handles = {}
     start_batch = {}
     done_moves = {}
@@ -130,8 +178,10 @@ def main() -> None:
                     f"{info.generation} at batch {sb} "
                     f"(iter_count {t.iter_count})"
                 )
-        handles[kind] = svc.open_session(t, session_id=kind,
-                                         max_queue=QUEUE_DEPTH)
+        handles[kind] = submit_admitted(
+            svc.open_session, t, session_id=kind, max_queue=QUEUE_DEPTH,
+            priority=lanes.get(kind, Priority.NORMAL),
+        )
         start_batch[kind], done_moves[kind] = sb, dm
 
     first = min(start_batch.values())
@@ -150,12 +200,13 @@ def main() -> None:
                     # A mid-batch restore already localized this
                     # batch's sources (same rule as the resilience
                     # driver).
-                    futs.append(h.copy_initial_position(
-                        src[b].reshape(-1).copy()
+                    futs.append(submit_admitted(
+                        h.copy_initial_position,
+                        src[b].reshape(-1).copy(),
                     ))
                 for m in range(skip, MOVES):
-                    futs.append(h.move(
-                        None, dst[b, m].reshape(-1).copy()
+                    futs.append(submit_admitted(
+                        h.move, None, dst[b, m].reshape(-1).copy()
                     ))
         except ServiceDrainingError:
             pass  # an external SIGTERM landed mid-batch: drain below

@@ -304,37 +304,85 @@ def test_service_row(bench):
 
 
 def test_service_fusion_row(bench):
-    """The cross-session-fusion component row (r12): schema keys
+    """The cross-session-fusion component row (r12 + r20): schema keys
     present per session count, bitwise per-session flux parity in
     BOTH arms asserted (the tool raises otherwise), the dispatch
     amortization visible in the telemetry (1 dispatch per move
-    unfused, ~1/K fused), and the compiles-healthy contract —
-    ``compiles.timed == 0``: walk_fused compiles once per group
-    composition in the warmup pass, and every measured pass runs
-    against a hot cache. Tiny shape: the schema test pins machinery,
-    not throughput (the >= 1.15x serving gate is the full-shape A/B's
-    job)."""
+    unfused, ~1/K fused up to the max_fuse=8 group cap at the 32
+    point), the r20 "streaming" sub-row (chunk-wise fused
+    StreamingTally facades at 4/8 sessions) under the same gates, and
+    the compiles-healthy contract — ``compiles.timed == 0``:
+    walk_fused compiles once per group composition in the warmup
+    pass, and every measured pass runs against a hot cache. Tiny
+    shape: the schema test pins machinery, not throughput (the
+    >= 1.15x serving gate is the full-shape A/B's job)."""
     res = bench.run_service_fusion_ab()
-    assert res["flux_parity_bitwise"] is True
+
+    def check_arm(arm):
+        assert arm["flux_parity_bitwise"] is True
+        assert arm["compiles"]["timed"] == 0
+        for s_count, row in arm["per_sessions"].items():
+            for key in ("unfused_moves_per_sec", "fused_moves_per_sec",
+                        "fused_speedup", "unfused_dispatches_per_move",
+                        "fused_dispatches_per_move",
+                        "fused_move_fraction"):
+                assert key in row, (s_count, key)
+            assert row["unfused_moves_per_sec"] > 0
+            assert row["fused_moves_per_sec"] > 0
+            assert row["unfused_dispatches_per_move"] == 1.0
+            if int(s_count) > 8:
+                # Above the max_fuse=8 cap waves split into several
+                # groups (and DRR desync strands a few solo moves):
+                # the amortization bound is the CAP, not K.
+                assert row["fused_dispatches_per_move"] < 0.25
+                assert row["fused_move_fraction"] >= 0.9
+            elif int(s_count) > 1:
+                # Every move wave coalesced: K moves -> 1 dispatch.
+                assert row["fused_dispatches_per_move"] == pytest.approx(
+                    1.0 / int(s_count)
+                )
+                assert row["fused_move_fraction"] == 1.0
+            else:
+                assert row["fused_dispatches_per_move"] == 1.0
+                assert row["fused_move_fraction"] == 0.0
+        assert "walk_fused" in arm["compiles"]
+
+    check_arm(res)
+    assert set(res["per_sessions"]) == {"1", "4", "8", "32"}
+    assert res["facade"] == "mono"
+    # The r20 streaming sub-row: chunk-wise fusion, same gates.
+    stream = res["streaming"]
+    assert stream["facade"] == "stream"
+    assert stream["workload"]["chunk_size"] >= 1
+    assert set(stream["per_sessions"]) == {"4", "8"}
+    check_arm(stream)
+
+
+def test_service_load_row(bench):
+    """The served-throughput-under-load row (r20): >= 100 scripted
+    clients with a deterministic seeded schedule through a 2-worker
+    router, all served (the tool raises on any failed/timed-out
+    client), schema keys present, per-lane fairness and refusal
+    telemetry populated, the bitwise spot-check parity gate asserted
+    inside the tool, and ``compiles.timed == 0`` (the warmup ladder
+    pre-compiles every fused composition the run can dispatch)."""
+    res = bench.run_service_load()
+    for key in ("clients", "moves_per_s", "particle_moves_per_s",
+                "latency_ms", "lanes", "refusals", "parity_bitwise",
+                "compiles", "workload"):
+        assert key in res, key
+    assert res["clients"] >= 100
+    assert res["parity_bitwise"] is True
+    assert res["parity_clients"] >= 1
+    assert res["moves_per_s"] > 0
+    assert res["latency_ms"]["p99"] >= res["latency_ms"]["p50"] > 0
+    assert set(res["lanes"]) == {"high", "normal", "low"}
+    for lane in res["lanes"].values():
+        assert lane["clients"] > 0  # the 0.2/0.6/0.2 mix fills every lane
+        assert 0.0 < lane["jain"] <= 1.0
+    assert set(res["refusals"]) == {"busy_retries", "overload_refusals"}
     assert res["compiles"]["timed"] == 0
-    for s_count, row in res["per_sessions"].items():
-        for key in ("unfused_moves_per_sec", "fused_moves_per_sec",
-                    "fused_speedup", "unfused_dispatches_per_move",
-                    "fused_dispatches_per_move", "fused_move_fraction"):
-            assert key in row, (s_count, key)
-        assert row["unfused_moves_per_sec"] > 0
-        assert row["fused_moves_per_sec"] > 0
-        assert row["unfused_dispatches_per_move"] == 1.0
-        if int(s_count) > 1:
-            # Every move wave coalesced: K moves -> 1 dispatch.
-            assert row["fused_dispatches_per_move"] == pytest.approx(
-                1.0 / int(s_count)
-            )
-            assert row["fused_move_fraction"] == 1.0
-        else:
-            assert row["fused_dispatches_per_move"] == 1.0
-            assert row["fused_move_fraction"] == 0.0
-    assert "walk_fused" in res["compiles"]
+    assert res["workload"]["workers"] == 2
 
 
 def test_distributed_row(bench):

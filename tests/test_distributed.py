@@ -515,6 +515,77 @@ def test_session_router_bitwise_and_homing():
             s.shutdown()
 
 
+def test_router_backlogged_worker_stops_winning_open():
+    """Least-loaded placement reads LIVE load over the ping channel
+    (round 20): a worker pinned behind queued particle cost loses the
+    next open to a worker with MORE sessions but an empty queue — the
+    session-count tiebreak only applies at equal cost. The router's
+    ping aggregates the same telemetry fleet-wide."""
+    from pumiumtally_tpu import PumiTally, TallyService
+    from pumiumtally_tpu.service import SessionRouter, SocketFrontend
+
+    import threading
+
+    mesh = build_box(1, 1, 1, 3, 3, 3)
+    N = 200
+    # Worker 0: ONE session whose worker thread is parked on a
+    # blocking call op, with transport cost queued behind it. Worker
+    # 1: TWO idle sessions. Count-based placement would pick worker 0;
+    # cost-based must not.
+    svc0 = TallyService()
+    svc1 = TallyService()
+    rng = np.random.default_rng(9)
+    unstall = threading.Event()
+    h = svc0.open_session(PumiTally(mesh, N,
+                                    TallyConfig(check_found_all=False)),
+                          session_id="busy", max_queue=8)
+    h._call("stall", lambda t: unstall.wait(timeout=300))
+    h.copy_initial_position(rng.uniform(0.1, 0.9, N * 3))
+    for _ in range(2):
+        h.move(None, rng.uniform(0.1, 0.9, N * 3))
+    for sid in ("idle_a", "idle_b"):
+        svc1.open_session(PumiTally(mesh, N,
+                                    TallyConfig(check_found_all=False)),
+                          session_id=sid, max_queue=8)
+    fes = [SocketFrontend(s, default_mesh=mesh, default_particles=N)
+           for s in (svc0, svc1)]
+    for fe in fes:
+        fe.start()
+    router = SessionRouter([(fe.host, fe.port) for fe in fes])
+    router.start()
+    conn = f = None
+    try:
+        conn = socket.create_connection((router.host, router.port))
+        f = conn.makefile("rwb")
+
+        def rpc(**req):
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            return json.loads(f.readline().decode())
+
+        r = rpc(op="ping")
+        assert r["ok"] and r["backends"] == 2, r
+        assert r["load"]["sessions"] == 3, r
+        assert r["load"]["queued_cost"] == 3 * N, r
+        assert r["per_backend"][0]["queued_cost"] == 3 * N, r
+        assert r["per_backend"][1]["queued_cost"] == 0, r
+
+        r = rpc(op="open", facade="mono", num_particles=N)
+        assert r["ok"], r
+        assert r["home"] == 1, r  # the backlogged worker lost the open
+    finally:
+        unstall.set()
+        if f is not None:
+            f.close()
+        if conn is not None:
+            conn.close()
+        router.stop()
+        for fe in fes:
+            fe.stop()
+        svc1.shutdown()
+        svc0.shutdown(drain=False)
+
+
 def test_cli_route_forwards_and_sigterm_exit(tmp_path):
     """``pumiumtally route`` fronts a ``serve`` worker: a session opened
     through the router serves flux, and BOTH processes exit 0 on

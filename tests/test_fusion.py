@@ -11,9 +11,13 @@ Contracts pinned here (docs/DESIGN.md "Cross-session fusion"):
   for bit, and a 1-session service stays bitwise- AND
   allocation-identical to the bare facade whether fusion is on or
   off (a group of one always runs the unfused path);
+- streaming sessions fuse CHUNK-WISE (round 20): one shared launch
+  per chunk index, bitwise vs solo streaming runs — including ragged
+  last chunks, scoring banks, sentinel health, origin-passing phase
+  A, and every cascade permutation mode;
 - sessions with DIFFERENT fusion keys (other facade kinds, other
-  meshes, other scoring statics) never co-fuse — and still land
-  bitwise;
+  meshes, other scoring statics, other chunk sizes) never co-fuse —
+  and still land bitwise;
 - a mid-group failure (move before source) lands on exactly the
   failing session's future while the other sessions' results commit;
 - ``pick_group`` charges co-fused heads by their own cost (fairness
@@ -352,17 +356,96 @@ def test_fused_scoring_and_sentinel_sessions_bitwise_vs_solo():
     _fused_vs_solo(mesh, build, with_energy=True, expect_fused=True)
 
 
-def test_streaming_sessions_do_not_fuse_and_stay_bitwise():
-    """Chunked facades declare no fusion key (their chunk-major
-    scatter order cannot survive coalescing): with fusion ON their
-    moves run one at a time — and still bitwise."""
+def test_fused_streaming_sessions_chunkwise_bitwise_vs_solo():
+    """Round 20: streaming sessions fuse CHUNK-WISE — one shared
+    launch per chunk index, all of a group's k-th chunks in one slab —
+    and each session's flux/positions/elements land BITWISE on its
+    solo streaming run. Covered with a ragged last chunk (192 over
+    chunk 80 → 80/80/32: pad rows are grounded and dropped at the
+    segmented scatter exactly like solo staging pads) and with
+    origin-passing phase A through the fused program."""
     mesh = _mesh()
 
-    def build(_i):
+    def build_ragged(_i):
+        return StreamingTally(mesh, N, chunk_size=80,
+                              config=TallyConfig(check_found_all=False))
+
+    def build_even(_i):
         return StreamingTally(mesh, N, chunk_size=64,
                               config=TallyConfig(check_found_all=False))
 
-    _fused_vs_solo(mesh, build, expect_fused=False, seeds=(81, 82))
+    _fused_vs_solo(mesh, build_ragged, expect_fused=True,
+                   seeds=(81, 82, 83))
+    _fused_vs_solo(mesh, build_even, with_origins=True,
+                   expect_fused=True, seeds=(84, 85))
+
+
+def test_fused_streaming_scoring_and_sentinel_bitwise_vs_solo():
+    """Streaming chunk fusion with scoring lanes (per-chunk resolved
+    bins ride the fused launch through the same pre-shifted offsets)
+    and one sentinel-armed session in the group (its phase-B audit
+    runs per chunk after each shared launch): banks and health
+    records bitwise vs solo streaming."""
+    mesh = _mesh()
+
+    def build(i):
+        spec = ScoringSpec(
+            filters=[EnergyFilter(np.array([0.0, 1.0, 2.0]))],
+            scores=["flux", "events"],
+        )
+        kw = {"check_found_all": False, "scoring": spec}
+        if i == 1:
+            kw["sentinel"] = SentinelPolicy()
+        return StreamingTally(mesh, N, chunk_size=80,
+                              config=TallyConfig(**kw))
+
+    _fused_vs_solo(mesh, build, with_energy=True, expect_fused=True)
+
+
+@pytest.mark.parametrize("mode", ["packed", "arrays", "indirect",
+                                  "sorted"])
+def test_fused_streaming_bitwise_across_perm_modes(mode):
+    """The chunk-wise determinism proof holds in every cascade
+    permutation mode (the stable-stage subsequence argument is
+    mode-independent; "sorted" holds because a stable sort induces
+    the stable sort of every subsequence): service-level bitwise pin
+    per mode. One mode per test so each walk_fused composition stays
+    inside the per-test retrace budget."""
+    mesh = _mesh()
+
+    def build(_i):
+        return StreamingTally(
+            mesh, N, chunk_size=64,
+            config=TallyConfig(check_found_all=False,
+                               walk_perm_mode=mode),
+        )
+
+    _fused_vs_solo(mesh, build, expect_fused=True, seeds=(86, 87))
+
+
+def test_streaming_mixed_keys_never_cofuse():
+    """Chunk-wise fusion keys lead with the facade KIND and pin
+    (num_particles, chunk_size): a monolithic head never groups with
+    a streaming head, and two streaming sessions with different chunk
+    sizes never group either — the zoo runs entirely unfused and
+    still bitwise."""
+    mesh = _mesh()
+
+    def build(i):
+        if i == 0:
+            return PumiTally(mesh, N, TallyConfig(check_found_all=False))
+        if i == 1:
+            return StreamingTally(
+                mesh, N, chunk_size=64,
+                config=TallyConfig(check_found_all=False),
+            )
+        return StreamingTally(
+            mesh, N, chunk_size=96,
+            config=TallyConfig(check_found_all=False),
+        )
+
+    stats = _fused_vs_solo(mesh, build, expect_fused=False)
+    assert stats["fused_groups"] == 0
 
 
 def test_mixed_key_sessions_never_cofuse():

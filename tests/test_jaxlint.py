@@ -625,14 +625,18 @@ def test_scoring_subsystem_registered_and_pragma_free():
 
 def test_service_subsystem_registered_and_pragma_free():
     """The multi-session-service modules (r11, plus the r12 fusion
-    module) must be IN the self-check's file set and hold the
-    strongest form of the clean contract: zero violations with zero
-    pragmas — the service layer is host-side threading and prepacked
-    numpy buffers, and its ONE trace root (fusion.py's walk_fused) is
-    a plain jitted pack/walk/split program with no host syncs
-    reachable from the trace, so there is no excuse for even a
-    justified suppression. The bench-consumed A/B tools are covered
-    the same way (they are in tools/lint_all.py's jaxlint targets)."""
+    module and the r20 traffic-engineering additions) must be IN the
+    self-check's file set and hold the strongest form of the clean
+    contract: zero violations with zero pragmas — the service layer
+    (priority lanes, admission ledger, latency telemetry included) is
+    host-side threading and prepacked numpy buffers, and its ONE
+    trace root (fusion.py's walk_fused) is a plain jitted
+    pack/walk/split program with no host syncs reachable from the
+    trace, so there is no excuse for even a justified suppression.
+    The bench-consumed A/B tools and the r20 load generator (pure
+    stdlib+numpy — it must stay importable without jax) are covered
+    the same way (they are in tools/lint_all.py's jaxlint
+    targets)."""
     import glob
 
     svc_dir = os.path.join(REPO, "pumiumtally_tpu", "service")
@@ -643,7 +647,9 @@ def test_service_subsystem_registered_and_pragma_free():
     from pumiumtally_tpu.analysis import lint_paths
 
     abs_ = [os.path.join(REPO, "tools", "exp_service_ab.py"),
-            os.path.join(REPO, "tools", "exp_fusion_ab.py")]
+            os.path.join(REPO, "tools", "exp_fusion_ab.py"),
+            os.path.join(REPO, "tools", "exp_service_load.py"),
+            os.path.join(REPO, "tools", "loadgen.py")]
     assert lint_paths(files + abs_) == []
     for f in files + abs_:
         with open(f) as fh:
@@ -656,6 +662,12 @@ def test_service_subsystem_registered_and_pragma_free():
         targets = fh.read()
     assert "tools/exp_service_ab.py" in targets
     assert "tools/exp_fusion_ab.py" in targets
+    assert "tools/exp_service_load.py" in targets
+    assert "tools/loadgen.py" in targets
+    # loadgen must not import jax — scripted clients run anywhere.
+    with open(os.path.join(REPO, "tools", "loadgen.py")) as fh:
+        src = fh.read()
+    assert "import jax" not in src
 
 
 def test_distributed_subsystem_registered_and_pragma_free():

@@ -539,8 +539,15 @@ def test_socket_frontend_roundtrip():
     try:
         with socketlib.create_connection((fe.host, fe.port)) as conn:
             f = conn.makefile("rwb")
-            assert _rpc(f, {"op": "ping"}) == {
-                "ok": True, "draining": False,
+            r = _rpc(f, {"op": "ping"})
+            assert r["ok"] is True and r["draining"] is False
+            # Round-20 telemetry rides the ping (schema pinned in
+            # tests/test_traffic.py): load aggregate + fusion stats.
+            assert r["load"]["sessions"] == 0
+            assert r["load"]["queued_cost"] == 0
+            assert set(r["fusion"]) == {
+                "fused_groups", "fused_moves", "solo_moves",
+                "solo_other",
             }
             r = _rpc(f, {"op": "open", "facade": "mono",
                          "num_particles": N, "max_queue": 8})

@@ -1,0 +1,611 @@
+"""Traffic engineering for the multi-session service (round 20).
+
+Contracts pinned here (docs/DESIGN.md "Chunk-wise fusion & traffic
+engineering"):
+
+- strict priority between lanes: the highest lane with queued work
+  serves; lower lanes wait at op granularity (never mid-op);
+- DRR within a lane is the flat round-11 algorithm unchanged — and a
+  skipped idle lane forfeits banked CREDIT exactly like an emptied
+  ring visit, while co-fusion DEBT follows the session;
+- the low-lane starvation bound: a LOW session whose head is
+  fusion-compatible with a HIGH lead rides the shared launch
+  (pre-paying its own cost); incompatible low work waits for the high
+  lane to drain — both at the scheduler and through a live service,
+  where the mixed-priority fused campaign stays bitwise per session;
+- admission control: with a budget armed, a transport op that would
+  exceed it refuses with ``ServiceOverloadedError`` (budget/admitted/
+  cost attributes) BEFORE any state changes — the caller's flying
+  buffer is untouched, reads and the close sentinel are never
+  refused, and the budget frees as the worker completes ops;
+- telemetry: ``stats()`` exposes per-session priority/queued_cost and
+  p50/p99 submit->resolve latency; the NDJSON ``ping`` reply carries
+  the aggregate load the router's least-loaded placement reads, and
+  overload refusals answer ``"overloaded": true`` on the wire;
+- SIGTERM drain UNDER LOAD: a stream-pair campaign running with
+  priority lanes and a near-full admission budget drains to one
+  batch-aligned generation per session and resumes bitwise
+  (subprocess, tests/_service_driver.py --stream-pair).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pumiumtally_tpu import (
+    PumiTally,
+    StreamingTally,
+    TallyConfig,
+    TallyService,
+    build_box,
+)
+from pumiumtally_tpu.service import (
+    DeficitRoundRobinScheduler,
+    Priority,
+    ServiceOverloadedError,
+    SocketFrontend,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_service_driver.py")
+
+N = 64
+
+
+def _mesh():
+    return build_box(1.0, 1.0, 1.0, 3, 3, 3)
+
+
+def _cfg(**kw):
+    return TallyConfig(check_found_all=False, **kw)
+
+
+def _campaign(seed, batches=1, moves=2, n=N):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.uniform(0.1, 0.9, n * 3),
+         [rng.uniform(0.1, 0.9, n * 3) for _ in range(moves)])
+        for _ in range(batches)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler lanes (pure data structure)
+# ---------------------------------------------------------------------------
+
+class _Q:
+    """A scripted head-cost oracle: pop-on-pick queues per key."""
+
+    def __init__(self, costs):
+        self.q = {k: list(v) for k, v in costs.items()}
+
+    def head(self, k):
+        return self.q[k][0] if self.q[k] else None
+
+    def pop(self, k):
+        return self.q[k].pop(0)
+
+
+def test_strict_priority_between_lanes():
+    """The highest lane with queued work serves; lower lanes advance
+    only once every lane above them is empty."""
+    s = DeficitRoundRobinScheduler()
+    s.register("lo", priority=Priority.LOW)
+    s.register("n1", priority=Priority.NORMAL)
+    s.register("hi", priority=Priority.HIGH)
+    assert s.priority("hi") is Priority.HIGH
+    assert s.priority("n1") is Priority.NORMAL
+    q = _Q({"hi": [2, 2], "n1": [3, 3], "lo": [1, 1, 1]})
+    order = []
+    while True:
+        k = s.pick(q.head)
+        if k is None:
+            break
+        q.pop(k)
+        order.append(k)
+    assert order == ["hi", "hi", "n1", "n1", "lo", "lo", "lo"]
+
+
+def test_lane_preempts_at_op_granularity():
+    """Work landing in a higher lane mid-campaign preempts the lower
+    lane at the next pick — the in-flight op always finishes."""
+    s = DeficitRoundRobinScheduler()
+    s.register("hi", priority=Priority.HIGH)
+    s.register("lo", priority=Priority.LOW)
+    q = _Q({"hi": [], "lo": [4, 4, 4]})
+    assert s.pick(q.head) == "lo"
+    q.pop("lo")
+    q.q["hi"] = [4, 4]  # urgent work arrives
+    assert s.pick(q.head) == "hi"
+    q.pop("hi")
+    assert s.pick(q.head) == "hi"
+    q.pop("hi")
+    assert s.pick(q.head) == "lo"
+
+
+def test_skipped_idle_lane_forfeits_credit_keeps_debt():
+    """An idle higher lane forfeits banked CREDIT when a lower lane
+    serves (idle banks no credit), but co-fusion DEBT is kept."""
+    s = DeficitRoundRobinScheduler(quantum=3)
+    s.register("hi", priority=Priority.HIGH)
+    s.register("lo", priority=Priority.LOW)
+    # hi serves a cost-5 op with quantum 3: two credits, one debit,
+    # leaving 1 unit of banked credit.
+    q = _Q({"hi": [5], "lo": [2]})
+    assert s.pick(q.head) == "hi"
+    q.pop("hi")
+    assert s.deficit("hi") == 1
+    # hi is now idle; the LOW lane serves — hi's credit is forfeited.
+    assert s.pick(q.head) == "lo"
+    q.pop("lo")
+    assert s.deficit("hi") == 0
+
+    # Debt survives the same transition: h2 pre-pays a ride on h1's
+    # fused launch, empties, and still owes when the low lane serves.
+    s2 = DeficitRoundRobinScheduler()
+    s2.register("h1", priority=Priority.HIGH)
+    s2.register("h2", priority=Priority.HIGH)
+    s2.register("lo", priority=Priority.LOW)
+    q2 = _Q({"h1": [4], "h2": [4], "lo": [2]})
+
+    def gk(k):
+        # lo's head is a different composition — it never co-fuses.
+        return ("K" if k != "lo" and q2.head(k) is not None else None)
+
+    g = s2.pick_group(q2.head, gk, 8)
+    assert sorted(g) == ["h1", "h2"]
+    for k in g:
+        q2.pop(k)
+    lead = g[0]
+    rider = g[1]
+    assert s2.deficit(rider) == -4  # pre-paid, not yet credited
+    assert s2.pick(q2.head) == "lo"
+    q2.pop("lo")
+    assert s2.deficit(rider) == -4  # debt kept across the lane switch
+    assert s2.deficit(lead) == 0
+
+
+def test_low_lane_ride_along_bound_under_saturated_high():
+    """The starvation bound, end to end at the scheduler: compatible
+    LOW heads ride every HIGH-led fused launch; incompatible LOW work
+    waits for the high lane to drain, then serves first (its sibling
+    carries ride-along debt)."""
+    s = DeficitRoundRobinScheduler()
+    s.register("h1", priority=Priority.HIGH)
+    s.register("h2", priority=Priority.HIGH)
+    s.register("lo_compat", priority=Priority.LOW)
+    s.register("lo_other", priority=Priority.LOW)
+    rounds = 6
+    q = _Q({
+        "h1": [4] * rounds, "h2": [4] * rounds,
+        "lo_compat": [4] * rounds, "lo_other": [4] * rounds,
+    })
+    keys = {"h1": "K", "h2": "K", "lo_compat": "K", "lo_other": "X"}
+
+    def gk(k):
+        return keys[k] if q.head(k) is not None else None
+
+    served = {k: 0 for k in keys}
+    for _ in range(rounds):
+        g = s.pick_group(q.head, gk, 8)
+        assert sorted(g) == ["h1", "h2", "lo_compat"]
+        for k in g:
+            q.pop(k)
+            served[k] += 1
+    # The compatible LOW session advanced at the fused cadence; the
+    # incompatible one did not move while the high lane was saturated.
+    assert served["lo_compat"] == rounds
+    assert served["lo_other"] == 0
+    assert s.deficit("lo_compat") == -4 * rounds
+    # High lane drained: lo_other serves FIRST (lo_compat owes its
+    # ride-along debt), and alone (keys differ).
+    g = s.pick_group(q.head, gk, 8)
+    assert g == ["lo_other"]
+    q.pop("lo_other")
+
+
+def test_all_normal_is_the_flat_scheduler():
+    """Default-priority registration reproduces the flat round-11
+    pick sequence bit for bit (same costs as the exact-deficit pin in
+    tests/test_service.py)."""
+    flat_costs = {"a": [5, 5], "b": [3, 3, 3], "c": [1] * 8}
+    picks = {}
+    for arm in ("default", "explicit"):
+        s = DeficitRoundRobinScheduler(quantum=4)
+        for k in ("a", "b", "c"):
+            if arm == "default":
+                s.register(k)
+            else:
+                s.register(k, priority=Priority.NORMAL)
+        q = _Q(flat_costs)
+        seq = []
+        while True:
+            k = s.pick(q.head)
+            if k is None:
+                break
+            q.pop(k)
+            seq.append(k)
+        picks[arm] = (seq, {k: s.deficit(k) for k in ("a", "b", "c")})
+    assert picks["default"] == picks["explicit"]
+
+
+def test_unregister_adjusts_lane_ring():
+    s = DeficitRoundRobinScheduler()
+    for k in ("a", "b", "c"):
+        s.register(k, priority=Priority.HIGH)
+    q = _Q({"a": [1, 1], "b": [1, 1], "c": [1, 1]})
+    assert s.pick(q.head) == "a"
+    q.pop("a")
+    s.unregister("a")
+    with pytest.raises(ValueError, match="not registered"):
+        s.unregister("a")
+    order = []
+    while True:
+        k = s.pick(q.head)
+        if k is None:
+            break
+        q.pop(k)
+        order.append(k)
+    assert sorted(order) == ["b", "b", "c", "c"]
+
+
+# ---------------------------------------------------------------------------
+# Admission control (live service)
+# ---------------------------------------------------------------------------
+
+def test_admission_refusal_is_stateless_and_recovers():
+    """A transport op over budget refuses with the structured error,
+    BEFORE the caller's flying buffer is zeroed; reads still admit;
+    the budget frees as the worker drains and the campaign lands
+    bitwise on the solo facade."""
+    mesh = _mesh()
+    svc = TallyService(autostart=False, admission_budget=N + 10)
+    try:
+        h = svc.open_session(PumiTally(mesh, N, _cfg()),
+                             session_id="s0", max_queue=8)
+        (src, dests), = _campaign(11, moves=2)
+        h.copy_initial_position(src.copy())  # cost N: admitted
+        flying = np.ones(N, np.int8)
+        with pytest.raises(ServiceOverloadedError) as ei:
+            h.move(None, dests[0].copy(), flying=flying)
+        assert ei.value.budget == N + 10
+        assert ei.value.admitted == N
+        assert ei.value.cost == N
+        # Refused => no side effects: the flying buffer still holds
+        # the caller's bytes, nothing joined the queue.
+        np.testing.assert_array_equal(flying, np.ones(N, np.int8))
+        st = svc.stats()
+        assert st["admission"]["refused_ops"] == 1
+        assert st["admission"]["admitted_cost"] == N
+        assert st["admission"]["queued_cost"] == N
+        assert st["admission"]["inflight_cost"] == 0
+        assert st["sessions"]["s0"]["pending"] == 1
+        # Reads are cost-1 "call" ops — never counted, never refused.
+        f_flux = h.flux()
+        # Worker drains the source: budget frees, the retry admits and
+        # zeroes the flying buffer (accept-then-zero).
+        svc.start()
+        f_flux.result(timeout=300)
+        fut = h.move(None, dests[0].copy(), flying=flying)
+        np.testing.assert_array_equal(flying, np.zeros(N, np.int8))
+        fut.result(timeout=300)
+        h.move(None, dests[1].copy()).result(timeout=300)
+        got = np.asarray(h.flux().result(timeout=300))
+        st = svc.stats()
+        assert st["admission"]["admitted_cost"] == 0
+    finally:
+        svc.shutdown(drain=False)
+    solo = PumiTally(mesh, N, _cfg())
+    solo.CopyInitialPosition(src.copy())
+    for d in dests:
+        solo.MoveToNextLocation(None, d.copy())
+    np.testing.assert_array_equal(got, np.asarray(solo.flux))
+
+
+def test_open_refused_while_budget_full_and_close_bypasses():
+    """``open_session`` refuses while the budget is already full (the
+    session would be unservable anyway); the close sentinel is never
+    refused, so teardown stays live under overload."""
+    mesh = _mesh()
+    svc = TallyService(autostart=False, admission_budget=N)
+    try:
+        h = svc.open_session(PumiTally(mesh, N, _cfg()),
+                             session_id="s0", max_queue=8)
+        (src, _), = _campaign(12, moves=1)
+        h.copy_initial_position(src.copy())  # fills the budget exactly
+        with pytest.raises(ServiceOverloadedError):
+            svc.open_session(PumiTally(mesh, N, _cfg()),
+                             session_id="s1", max_queue=8)
+        assert svc.stats()["admission"]["refused_sessions"] == 1
+        assert svc.session_ids() == ("s0",)
+        # Teardown under a full budget: the close sentinel bypasses
+        # the gate (kind == "call").
+        f_close = h.close()
+        svc.start()
+        f_close.result(timeout=300)
+        # Budget freed: the refused open now succeeds.
+        h1 = svc.open_session(PumiTally(mesh, N, _cfg()),
+                              session_id="s1", max_queue=8)
+        assert h1.id == "s1"
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_stats_schema_priorities_and_latency():
+    """The ``stats()`` snapshot: per-session priority names, queue
+    cost, and populated p50/p99 submit->resolve latency after a
+    served campaign; admission ledger consistent."""
+    mesh = _mesh()
+    svc = TallyService(admission_budget=10_000)
+    try:
+        hi = svc.open_session(PumiTally(mesh, N, _cfg()),
+                              session_id="hi", max_queue=8,
+                              priority=Priority.HIGH)
+        lo = svc.open_session(PumiTally(mesh, N, _cfg()),
+                              session_id="lo", max_queue=8,
+                              priority=Priority.LOW)
+        for h, seed in ((hi, 21), (lo, 22)):
+            (src, dests), = _campaign(seed, moves=2)
+            h.copy_initial_position(src.copy())
+            futs = [h.move(None, d.copy()) for d in dests]
+            for f in futs:
+                f.result(timeout=300)
+        st = svc.stats()
+        assert set(st) >= {"sessions", "fusion", "admission"}
+        assert set(st["admission"]) == {
+            "budget", "admitted_cost", "queued_cost", "inflight_cost",
+            "refused_ops", "refused_sessions",
+        }
+        assert st["admission"]["budget"] == 10_000
+        for sid, pr in (("hi", "high"), ("lo", "low")):
+            row = st["sessions"][sid]
+            assert set(row) == {
+                "state", "priority", "pending", "queued_cost",
+                "ops_completed", "moves_completed", "latency_p50_ms",
+                "latency_p99_ms",
+            }
+            assert row["priority"] == pr
+            assert row["moves_completed"] == 2
+            assert row["latency_p50_ms"] > 0.0
+            assert row["latency_p99_ms"] >= row["latency_p50_ms"]
+    finally:
+        svc.shutdown(drain=False)
+
+
+def test_mixed_priority_fused_streaming_bitwise():
+    """A LOW streaming session whose staged moves are chunk-compatible
+    with a HIGH lead rides its fused launches — and both land bitwise
+    on their solo campaigns (the service-level half of the starvation
+    bound)."""
+    mesh = _mesh()
+    chunk = 24
+    works = {"hi": _campaign(31, moves=2), "lo": _campaign(32, moves=2)}
+    svc = TallyService(autostart=False, admission_budget=10_000)
+    got = {}
+    try:
+        handles = {}
+        for sid, pr in (("hi", Priority.HIGH), ("lo", Priority.LOW)):
+            t = StreamingTally(mesh, N, chunk_size=chunk, config=_cfg())
+            if sid == "lo":
+                # Localize LOW's source directly so its queued head is
+                # a MOVE when the HIGH lead serves — the ride-along
+                # window. (Pre-open direct calls are the caller's to
+                # make; the service owns the facade only after open.)
+                t.CopyInitialPosition(works[sid][0][0].copy())
+            handles[sid] = svc.open_session(t, session_id=sid,
+                                            max_queue=8, priority=pr)
+        futs = []
+        (src, dests) = works["hi"][0]
+        futs.append(handles["hi"].copy_initial_position(src.copy()))
+        for m in range(2):
+            for sid in ("hi", "lo"):
+                futs.append(handles[sid].move(
+                    None, works[sid][0][1][m].copy()
+                ))
+        svc.start()
+        for f in futs:
+            f.result(timeout=300)
+        for sid in ("hi", "lo"):
+            got[sid] = np.asarray(handles[sid].flux().result(timeout=300))
+        assert svc.fusion_stats["fused_moves"] >= 2  # lo rode hi's lead
+    finally:
+        svc.shutdown(drain=False)
+    for sid in ("hi", "lo"):
+        solo = StreamingTally(mesh, N, chunk_size=chunk, config=_cfg())
+        (src, dests) = works[sid][0]
+        solo.CopyInitialPosition(src.copy())
+        for d in dests:
+            solo.MoveToNextLocation(None, d.copy())
+        np.testing.assert_array_equal(got[sid], np.asarray(solo.flux),
+                                      err_msg=sid)
+
+
+# ---------------------------------------------------------------------------
+# Wire schema (NDJSON front end)
+# ---------------------------------------------------------------------------
+
+def _rpc(f, req):
+    f.write((json.dumps(req) + "\n").encode("utf-8"))
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_socket_priority_stats_and_overloaded_reply():
+    """Socket half of the round-20 schema: ``open`` takes a priority
+    name (unknown names answer a structured error), ``stats`` returns
+    the full snapshot, ``ping`` the aggregate load, and an
+    admission-budget refusal answers ``"overloaded": true`` (distinct
+    from per-session ``"busy"``)."""
+    import base64
+    import socket as sk
+
+    svc = TallyService(admission_budget=N)
+    fe = SocketFrontend(svc)
+    fe.start()
+    try:
+        with sk.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": N, "priority": "urgent",
+                         "mesh": {"box": [1, 1, 1, 3, 3, 3]}})
+            assert r["ok"] is False and r["error"] == "ValueError"
+            assert "unknown priority" in r["message"]
+            assert r["busy"] is False and r["overloaded"] is False
+
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": N, "priority": "high",
+                         "max_queue": 8,
+                         "mesh": {"box": [1, 1, 1, 3, 3, 3]}})
+            assert r["ok"] is True
+            sid = r["session"]
+
+            st = _rpc(f, {"op": "stats"})
+            assert st["ok"] is True
+            assert st["stats"]["sessions"][sid]["priority"] == "high"
+
+            ping = _rpc(f, {"op": "ping"})
+            assert ping["ok"] is True and ping["draining"] is False
+            assert set(ping["load"]) == {
+                "sessions", "queued_cost", "inflight_cost",
+                "admitted_cost", "budget",
+            }
+            assert ping["load"]["sessions"] == 1
+            assert ping["load"]["budget"] == N
+            assert set(ping["fusion"]) == {
+                "fused_groups", "fused_moves", "solo_moves",
+                "solo_other",
+            }
+
+            # Fill the budget with an unserved source (wait=False so
+            # the reply returns while the op may still be queued),
+            # then a second transport refuses with "overloaded".
+            (src, dests), = _campaign(41, moves=1)
+            b64 = base64.b64encode(
+                np.ascontiguousarray(src, "<f8").tobytes()
+            ).decode("ascii")
+            d64 = base64.b64encode(
+                np.ascontiguousarray(dests[0], "<f8").tobytes()
+            ).decode("ascii")
+            # Stall the worker behind nothing — instead, drive the
+            # refusal deterministically by shrinking to a service
+            # whose budget a single source fills (cost N == budget).
+            r = _rpc(f, {"op": "source", "session": sid,
+                         "positions": b64, "wait": False})
+            assert r["ok"] is True
+            r = _rpc(f, {"op": "move", "session": sid, "dests": d64,
+                         "wait": False})
+            if not r["ok"]:  # the source may already have completed
+                assert r["error"] == "ServiceOverloadedError"
+                assert r["overloaded"] is True and r["busy"] is False
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+def test_socket_overloaded_reply_deterministic():
+    """The overload refusal on the wire, deterministically: with the
+    worker never started, a queued source holds the whole budget."""
+    import base64
+    import socket as sk
+
+    svc = TallyService(autostart=False, admission_budget=N)
+    fe = SocketFrontend(svc)
+    fe.start()
+    try:
+        with sk.create_connection((fe.host, fe.port)) as conn:
+            f = conn.makefile("rwb")
+            r = _rpc(f, {"op": "open", "facade": "mono",
+                         "num_particles": N, "max_queue": 8,
+                         "mesh": {"box": [1, 1, 1, 3, 3, 3]}})
+            sid = r["session"]
+            (src, dests), = _campaign(42, moves=1)
+
+            def enc(a):
+                return base64.b64encode(
+                    np.ascontiguousarray(a, "<f8").tobytes()
+                ).decode("ascii")
+
+            r = _rpc(f, {"op": "source", "session": sid,
+                         "positions": enc(src), "wait": False})
+            assert r["ok"] is True
+            r = _rpc(f, {"op": "move", "session": sid,
+                         "dests": enc(dests[0]), "wait": False})
+            assert r["ok"] is False
+            assert r["error"] == "ServiceOverloadedError"
+            assert r["overloaded"] is True and r["busy"] is False
+    finally:
+        fe.stop()
+        svc.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain under load (subprocess)
+# ---------------------------------------------------------------------------
+
+def _run_driver(ckpt_dir, out_dir, *extra, timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PUMIUMTALLY_FAULT", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    return subprocess.run(
+        [sys.executable, DRIVER, "--ckpt-dir", str(ckpt_dir),
+         "--out-dir", str(out_dir), "--stream-pair", *extra],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env=env,
+    )
+
+
+def _last_json(stdout):
+    return json.loads(
+        [ln for ln in stdout.splitlines() if ln.startswith("{")][-1]
+    )
+
+
+LOAD_FLAGS = ("--priorities", "high,low", "--admission-budget", "383")
+
+
+def test_stream_pair_drain_under_load_batch_aligned_bitwise(tmp_path):
+    """SIGTERM against a stream-pair campaign running with priority
+    lanes and a near-full admission budget (383 of the 384 cost units
+    a batch round stages, so the gate refuses and the driver's retry
+    loop is live): exit 0, one BATCH-ALIGNED generation per session,
+    and the resumed campaigns land bitwise on the uninterrupted
+    reference — which, run without lanes, actually chunk-fuses."""
+    from tests._service_driver import MOVES as DRV_MOVES
+    from tests._service_driver import STREAM_PAIR_SESSIONS
+
+    # Uninterrupted reference (no lanes: DRR interleaves the pair, so
+    # the campaign coalesces chunk-wise — the round-20 fusion path).
+    r = _run_driver(tmp_path / "ck_base", tmp_path / "out_base")
+    assert r.returncode == 0, r.stderr
+    assert _last_json(r.stdout)["fusion"]["fused_moves"] > 0
+    base = {
+        s: np.load(tmp_path / "out_base" / f"{s}.npy")
+        for s in STREAM_PAIR_SESSIONS
+    }
+
+    r = _run_driver(tmp_path / "ck", tmp_path / "out", *LOAD_FLAGS,
+                    "--sigterm-after-batch", "1")
+    assert r.returncode == 0, r.stderr
+    assert not (tmp_path / "out").exists()
+    drained = _last_json(r.stdout)
+    assert set(drained["drained"]) == set(STREAM_PAIR_SESSIONS)
+    assert all(g is not None for g in drained["drained"].values())
+
+    r = _run_driver(tmp_path / "ck", tmp_path / "out", *LOAD_FLAGS,
+                    "--resume")
+    assert r.returncode == 0, r.stderr
+    for s in STREAM_PAIR_SESSIONS:
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith(f"resumed session {s} ")][0]
+        iter_count = int(line.rsplit("iter_count ", 1)[1].rstrip(")"))
+        assert iter_count % DRV_MOVES == 0  # batch-aligned
+        assert iter_count == 2 * DRV_MOVES  # drained after batch 1
+        np.testing.assert_array_equal(
+            np.load(tmp_path / "out" / f"{s}.npy"), base[s],
+            err_msg=f"{s}: resume arm (lanes + admission gate live)",
+        )

@@ -6,8 +6,8 @@ one padded launch (service/fusion.py). This tool measures what that
 buys, non-interactively (one JSON line — bench.py's "service_fusion"
 row consumes it):
 
-For N_sessions in {1, 4, 8}: the IDENTICAL per-session campaigns run
-through two services —
+For N_sessions in {1, 4, 8, 32}: the IDENTICAL per-session campaigns
+run through two services —
 
 - ``unfused``: ``TallyService(fuse_sessions=False)`` — the round-11
   one-op-at-a-time serving path;
@@ -40,6 +40,14 @@ The default per-session batch is a power of two, so equal-sized
 sessions pack with ZERO padding rows (fusion.padded_total) — the
 serving sweet spot. Override via PUMIUMTALLY_AB_N etc. to probe other
 regimes (a non-pow2 n measures the dead-row tax too).
+
+Round 20 adds the STREAMING arm (``facade="stream"`` /
+PUMIUMTALLY_AB_FACADE=stream): the identical campaigns on
+``StreamingTally`` facades, whose queued moves coalesce CHUNK-WISE —
+one ``walk_fused`` launch per chunk index with spans
+``(chunk_size,) * K``, so one trace key covers every chunk wave of a
+K-way group. Same bitwise gates, same telemetry (dispatches count
+scheduler pick_group decisions, not per-chunk XLA launches).
 """
 
 from __future__ import annotations
@@ -54,7 +62,7 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-SESSION_COUNTS = (1, 4, 8)
+SESSION_COUNTS = (1, 4, 8, 32)
 
 
 def _campaign(seed: int, n: int, batches: int, moves: int):
@@ -73,19 +81,28 @@ def _drive_direct(t, work):
             t.MoveToNextLocation(None, d.reshape(-1).copy())
 
 
-def _run_arm(mesh, n, works, fuse, batches, moves):
+def _build(mesh, n, facade, chunk_size):
+    from pumiumtally_tpu import PumiTally, StreamingTally, TallyConfig
+
+    cfg = TallyConfig(check_found_all=False, fenced_timing=False)
+    if facade == "stream":
+        return StreamingTally(mesh, n, chunk_size=chunk_size, config=cfg)
+    return PumiTally(mesh, n, cfg)
+
+
+def _run_arm(mesh, n, works, fuse, batches, moves, facade="mono",
+             chunk_size=None):
     """One serving arm: pre-queue every campaign, start the worker,
     time the drain. Returns (seconds, per-session flux, dispatch
     telemetry)."""
     import time
 
-    from pumiumtally_tpu import PumiTally, TallyConfig, TallyService
+    from pumiumtally_tpu import TallyService
 
-    cfg = TallyConfig(check_found_all=False, fenced_timing=False)
     depth = batches * (moves + 1) + 2
     with TallyService(fuse_sessions=fuse, autostart=False) as svc:
         handles = {
-            sid: svc.open_session(PumiTally(mesh, n, cfg),
+            sid: svc.open_session(_build(mesh, n, facade, chunk_size),
                                   session_id=sid, max_queue=depth)
             for sid in works
         }
@@ -121,10 +138,18 @@ def run_ab(
     batches: int = 8,
     session_counts=SESSION_COUNTS,
     trials: int = 2,
+    facade: str = "mono",
+    chunk_size: int | None = None,
 ) -> dict:
-    from pumiumtally_tpu import PumiTally, TallyConfig, build_box
+    """facade="stream" runs the round-20 arm: streaming facades whose
+    queued chunk launches coalesce chunk-wise (one ``walk_fused``
+    launch per chunk index, spans ``(chunk_size,) * K``) instead of
+    one whole-slab launch per move wave."""
+    from pumiumtally_tpu import build_box
     from pumiumtally_tpu.utils.profiling import retrace_guard
 
+    if facade == "stream" and chunk_size is None:
+        chunk_size = max(1, n // 2)
     mesh = build_box(1.0, 1.0, 1.0, div, div, div)
     per = {}
     timed_compiles = 0
@@ -141,12 +166,13 @@ def run_ab(
                 time (least interference) wins; every measured pass
                 must be compile-free."""
                 nonlocal timed_compiles
-                _run_arm(mesh, n, works, fuse, batches, moves)
+                _run_arm(mesh, n, works, fuse, batches, moves,
+                         facade, chunk_size)
                 best = None
                 for _ in range(max(1, trials)):
                     with retrace_guard(raise_on_exceed=False) as tg:
                         got = _run_arm(mesh, n, works, fuse, batches,
-                                       moves)
+                                       moves, facade, chunk_size)
                     timed_compiles += tg.total_compiles
                     if best is None or got[0] < best[0]:
                         best = got
@@ -158,8 +184,7 @@ def run_ab(
             # number is reported.
             for i in range(s_count):
                 sid = f"s{i}"
-                solo = PumiTally(mesh, n, TallyConfig(
-                    check_found_all=False, fenced_timing=False))
+                solo = _build(mesh, n, facade, chunk_size)
                 _drive_direct(solo, works[sid])
                 solo_flux = np.asarray(solo.flux)
                 if not np.array_equal(unf_flux[sid], solo_flux):
@@ -187,6 +212,7 @@ def run_ab(
             }
     return {
         "row": "service_fusion",
+        "facade": facade,
         "per_sessions": per,
         "flux_parity_bitwise": True,
         "compiles": {
@@ -197,6 +223,7 @@ def run_ab(
         "workload": {
             "particles_per_session": n, "mesh_tets": 6 * div**3,
             "moves_per_batch": moves, "batches": batches,
+            "chunk_size": chunk_size,
         },
     }
 
@@ -207,14 +234,17 @@ def main() -> None:
     moves = int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2))
     batches = int(os.environ.get("PUMIUMTALLY_AB_BATCHES", 8))
     trials = int(os.environ.get("PUMIUMTALLY_AB_TRIALS", 2))
+    facade = os.environ.get("PUMIUMTALLY_AB_FACADE", "mono")
+    chunk = os.environ.get("PUMIUMTALLY_AB_CHUNK")
     counts = tuple(
         int(x) for x in os.environ.get(
-            "PUMIUMTALLY_AB_SESSIONS", "1,4,8"
+            "PUMIUMTALLY_AB_SESSIONS", "1,4,8,32"
         ).split(",")
     )
     print(json.dumps(
         run_ab(n=n, div=div, moves=moves, batches=batches,
-               session_counts=counts, trials=trials),
+               session_counts=counts, trials=trials, facade=facade,
+               chunk_size=None if chunk is None else int(chunk)),
         default=float,
     ))
 

@@ -1,0 +1,220 @@
+"""Served-throughput-under-load row: scripted clients vs the router (r20).
+
+bench.py's ``service_load`` row consumes this. It is the heavy-traffic
+story measured end to end: ``tools/loadgen.py`` drives >= 100 scripted
+OpenMC-style clients — DETERMINISTIC seeded Poisson arrivals, mixed
+HIGH/NORMAL/LOW priorities, per-client seeded campaigns — through a
+2-worker ``SessionRouter`` (the ``pumiumtally route`` topology), every
+client a streaming facade whose moves chunk-fuse with its co-arrivals,
+and reports what a capacity planner needs:
+
+- served moves/s and particle-moves/s over the wall clock;
+- client-observed p50/p99 submit->resolve latency (the ``wait: true``
+  round trip, the number an OpenMC step actually blocks on);
+- per-lane served-work Jain fairness;
+- refusal counts (per-session busy retries, service-wide admission
+  refusals) — the back-pressure the budget converts from OOM risk
+  into structured, retryable errors.
+
+Gates enforced HERE, before any number is reported:
+
+- **bitwise spot-check parity**: sampled clients return their flux
+  over the wire; each is replayed SOLO on a bare facade from the same
+  seeded campaign (``loadgen.client_campaign`` is pure) and must match
+  bit for bit — serving under load changes dispatch, never state;
+- **compiles.timed == 0**: the measured run dispatches only group
+  compositions the warmup ladder pre-compiled (every fused group size
+  1..max_fuse at the one (n, chunk) shape all clients share), so no
+  compile lands inside the timed window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _loadgen():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import loadgen
+
+    return loadgen
+
+
+def _warm_ladder(n: int, div: int, chunk: int, max_fuse: int,
+                 moves: int) -> None:
+    """Compile every program the measured run can dispatch: for each
+    group size K in 1..max_fuse, stage K co-fusable streaming sessions
+    against a stopped worker and drain them — K=1 holds the solo
+    streaming walk and the chunked localize, K>1 the K-way
+    ``walk_fused`` (spans ``(chunk,) * K``, one trace key per K). The
+    jit cache keys on shapes and static args, not mesh identity, so a
+    ladder-local mesh of the same box spec warms the router workers'
+    meshes too."""
+    from pumiumtally_tpu import (
+        StreamingTally,
+        TallyConfig,
+        TallyService,
+        build_box,
+    )
+
+    mesh = build_box(1.0, 1.0, 1.0, div, div, div)
+    cfg = TallyConfig(check_found_all=False, fenced_timing=False)
+    rng = np.random.default_rng(2020)
+    for k in range(1, max_fuse + 1):
+        with TallyService(autostart=False) as svc:
+            handles = [
+                svc.open_session(
+                    StreamingTally(mesh, n, chunk_size=chunk,
+                                   config=cfg),
+                    session_id=f"warm{k}_{i}", max_queue=moves + 2,
+                )
+                for i in range(k)
+            ]
+            futs = []
+            for h in handles:
+                futs.append(h.copy_initial_position(
+                    rng.uniform(0.1, 0.9, n * 3)
+                ))
+            for _ in range(moves):
+                for h in handles:
+                    futs.append(h.move(
+                        None, rng.uniform(0.1, 0.9, n * 3)
+                    ))
+            svc.start()
+            for f in futs:
+                f.result(timeout=600)
+            if k == 1:
+                # The parity spot-check clients read flux over the
+                # wire; hold that program's compile here too.
+                handles[0].flux().result(timeout=600)
+
+
+def run_load_row(
+    n: int = 512,
+    div: int = 6,
+    clients: int = 120,
+    rate: float = 300.0,
+    moves: int = 2,
+    batches: int = 1,
+    chunk_divisor: int = 2,
+    workers: int = 2,
+    max_fuse: int = 8,
+    seed: int = 20,
+    parity_clients: int = 3,
+    timeout: float = 600.0,
+) -> dict:
+    from pumiumtally_tpu import (
+        StreamingTally,
+        TallyConfig,
+        TallyService,
+        build_box,
+    )
+    from pumiumtally_tpu.service import SessionRouter, SocketFrontend
+    from pumiumtally_tpu.utils.profiling import retrace_guard
+
+    lg = _loadgen()
+    chunk = max(1, n // chunk_divisor)
+    box = (1.0, 1.0, 1.0, div, div, div)
+    # Budget ~max_fuse concurrent client batches per worker: generous
+    # enough to serve, finite enough that arrival bursts exercise the
+    # overloaded-refusal path loadgen retries through.
+    budget = max_fuse * n * (moves + 1)
+    timed_compiles = 0
+    with retrace_guard(raise_on_exceed=False) as guard:
+        _warm_ladder(n, div, chunk, max_fuse, moves)
+        svcs = [
+            TallyService(admission_budget=budget, max_fuse=max_fuse)
+            for _ in range(workers)
+        ]
+        fes = [SocketFrontend(s) for s in svcs]
+        for fe in fes:
+            fe.start()
+        router = SessionRouter([(fe.host, fe.port) for fe in fes])
+        router.start()
+        try:
+            with retrace_guard(raise_on_exceed=False) as tg:
+                report = lg.run_load(
+                    router.host, router.port, clients=clients,
+                    rate=rate, particles=n, batches=batches,
+                    moves=moves, facade="stream", chunk_size=chunk,
+                    mesh_box=box, seed=seed,
+                    collect_flux=parity_clients, timeout=timeout,
+                )
+            timed_compiles = tg.total_compiles
+        finally:
+            router.stop()
+            for fe in fes:
+                fe.stop()
+            for s in svcs:
+                s.shutdown(drain=False)
+    if report["clients_failed"] or report["clients_timed_out"]:
+        raise RuntimeError(
+            f"load run unhealthy: {report['clients_failed']} failed, "
+            f"{report['clients_timed_out']} timed out: "
+            f"{report['errors'][:3]}"
+        )
+    # Bitwise spot-check parity gate: the sampled clients' served flux
+    # vs solo replays of their (pure, seeded) campaigns.
+    for p in report["parity"]:
+        solo = StreamingTally(
+            build_box(*box), n, chunk_size=chunk,
+            config=TallyConfig(check_found_all=False,
+                               fenced_timing=False),
+        )
+        for src, dests in lg.client_campaign(seed, p["client"], n,
+                                             batches, moves):
+            solo.CopyInitialPosition(src.copy())
+            for d in dests:
+                solo.MoveToNextLocation(None, d.copy())
+        if not np.array_equal(np.asarray(solo.flux, np.float64),
+                              np.asarray(p["flux"], np.float64)):
+            raise RuntimeError(
+                f"client {p['client']}: served flux diverged bitwise "
+                "from the solo replay"
+            )
+    return {
+        "row": "service_load",
+        "clients": report["clients"],
+        "moves_per_s": report["moves_per_s"],
+        "particle_moves_per_s": report["particle_moves_per_s"],
+        "latency_ms": report["latency_ms"],
+        "lanes": report["lanes"],
+        "refusals": report["refusals"],
+        "parity_bitwise": True,
+        "parity_clients": len(report["parity"]),
+        "compiles": {
+            "total": guard.total_compiles,
+            "timed": timed_compiles,
+            **guard.compiles,
+        },
+        "workload": {
+            "particles_per_client": n, "mesh_tets": 6 * div**3,
+            "moves_per_batch": moves, "batches": batches,
+            "chunk_size": chunk, "workers": workers,
+            "arrival_rate_hz": rate, "admission_budget": budget,
+            "seed": seed,
+        },
+    }
+
+
+def main() -> None:
+    print(json.dumps(run_load_row(
+        n=int(os.environ.get("PUMIUMTALLY_AB_N", 512)),
+        div=int(os.environ.get("PUMIUMTALLY_AB_DIV", 6)),
+        clients=int(os.environ.get("PUMIUMTALLY_AB_CLIENTS", 120)),
+        rate=float(os.environ.get("PUMIUMTALLY_AB_RATE", 300.0)),
+        moves=int(os.environ.get("PUMIUMTALLY_AB_MOVES", 2)),
+        seed=int(os.environ.get("PUMIUMTALLY_AB_SEED", 20)),
+    ), default=float))
+
+
+if __name__ == "__main__":
+    main()

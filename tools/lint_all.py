@@ -48,6 +48,7 @@ JAXLINT_TARGETS = [
     "tools/exp_scoring_ab.py", "tools/exp_service_ab.py",
     "tools/exp_fusion_ab.py", "tools/exp_distributed_ab.py",
     "tools/exp_pallas_walk_ab.py", "tools/exp_placement_ab.py",
+    "tools/loadgen.py", "tools/exp_service_load.py",
 ]
 
 

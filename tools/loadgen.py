@@ -1,0 +1,351 @@
+"""Scripted-client load generator for the serving layer (round 20).
+
+Drives hundreds of OpenMC-style clients — open, then batches of
+source + moves, then close — against a ``pumiumtally serve`` worker
+or a ``pumiumtally route`` router over the NDJSON socket protocol,
+and reports the heavy-traffic numbers ROADMAP item 1 asks for:
+
+- served moves/s (completed move ops across all clients / wall time);
+- p50/p99 submit→resolve latency per move, client-observed (the
+  request/reply round trip of a ``wait=true`` move — queueing, DRR
+  turn, device walk, ack);
+- per-lane fairness: Jain's index J = (Σx)² / (n·Σx²) over each
+  priority lane's per-client served-move counts (1.0 = perfectly
+  fair, 1/n = one client got everything);
+- refusal counts: per-session busy retries and service-wide admission
+  refusals (``"overloaded": true`` replies), plus hard errors.
+
+The SCHEDULE is deterministic given ``seed``: Poisson arrivals
+(exponential inter-arrival gaps), per-client priorities drawn from
+``priority_mix``, and per-client campaign positions all come from
+``numpy.random.default_rng`` seeded with (seed, client index) — so a
+bench row can replay client 0's exact campaign solo and gate on
+bitwise flux parity (bench.py ``service_load``). Timing, and
+therefore the reported rates/latencies, is of course load- and
+host-dependent; the WORK is not.
+
+Session churn is inherent: clients arrive over ~clients/rate seconds,
+run finite campaigns, close, and disconnect, so the service sees
+opens and closes throughout the run, not one static fleet.
+
+Pure stdlib + numpy on purpose — the load generator must be runnable
+against a remote service from a host with no jax installed, and keeps
+the client side honest: everything it measures crosses the wire.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_PRIORITIES = ("high", "normal", "low")
+
+
+# -- wire helpers (standalone twins of service/server.py's; importing
+# them from there would drag in the full service stack + jax) ---------
+def _b64_f64(a) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(a, dtype="<f8").tobytes()
+    ).decode("ascii")
+
+
+def _b64_i8(a) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(a, dtype="<i1").tobytes()
+    ).decode("ascii")
+
+
+def _dec_f64(payload: str) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(payload), dtype="<f8"
+    ).copy()
+
+
+def client_campaign(seed: int, idx: int, particles: int, batches: int,
+                    moves: int) -> List[Tuple[np.ndarray, List[np.ndarray]]]:
+    """Client ``idx``'s deterministic campaign: ``batches`` entries of
+    (source positions [3n], [dest positions [3n]] * moves), every
+    coordinate in (0.01, 0.99) of the unit box scaled by the mesh —
+    the same generator bench.py replays solo for the parity gate."""
+    rng = np.random.default_rng([int(seed), int(idx)])
+    return [
+        (rng.random(3 * particles) * 0.98 + 0.01,
+         [rng.random(3 * particles) * 0.98 + 0.01
+          for _ in range(moves)])
+        for _ in range(batches)
+    ]
+
+
+def jain(xs: List[int]) -> Optional[float]:
+    """Jain's fairness index over per-client totals (None when the
+    lane is empty, 1.0 for a single client by construction)."""
+    if not xs:
+        return None
+    s = float(sum(xs))
+    ss = float(sum(x * x for x in xs))
+    if ss == 0.0:
+        return 1.0  # nobody served anything: vacuously even
+    return (s * s) / (len(xs) * ss)
+
+
+class _ClientResult:
+    __slots__ = ("priority", "moves_done", "latencies", "busy_retries",
+                 "overload_refusals", "error", "flux")
+
+    def __init__(self, priority: str):
+        self.priority = priority
+        self.moves_done = 0
+        self.latencies: List[float] = []  # seconds, per served move
+        self.busy_retries = 0
+        self.overload_refusals = 0
+        self.error: Optional[str] = None
+        self.flux: Optional[np.ndarray] = None
+
+
+def _rpc(f, req: dict) -> dict:
+    f.write(json.dumps(req).encode("utf-8") + b"\n")
+    f.flush()
+    line = f.readline()
+    if not line:
+        raise ConnectionError("server closed the connection")
+    return json.loads(line.decode("utf-8"))
+
+
+def _rpc_admitted(f, req: dict, res: _ClientResult, *,
+                  max_retries: int, retry_sleep: float) -> dict:
+    """One op with retry-on-refusal: busy (per-session queue full) and
+    overloaded (service admission budget) replies re-send the same
+    bytes after a short sleep — both refusals leave server-side state
+    untouched, which is exactly what makes blind resend correct."""
+    for _ in range(int(max_retries)):
+        r = _rpc(f, req)
+        if r.get("ok"):
+            return r
+        if r.get("busy"):
+            res.busy_retries += 1
+        elif r.get("overloaded"):
+            res.overload_refusals += 1
+        else:
+            raise RuntimeError(
+                f"{r.get('error')}: {r.get('message')}"
+            )
+        time.sleep(retry_sleep)
+    raise RuntimeError(
+        f"op {req.get('op')!r} refused {max_retries} times "
+        "(busy/overloaded): service never admitted it"
+    )
+
+
+def _run_client(host: str, port: int, idx: int, res: _ClientResult,
+                t_start: float, *, seed: int, particles: int,
+                batches: int, moves: int, facade: str,
+                chunk_size: Optional[int], mesh_box, collect_flux: bool,
+                max_retries: int, retry_sleep: float) -> None:
+    delay = t_start - time.perf_counter()
+    if delay > 0:
+        time.sleep(delay)
+    work = client_campaign(seed, idx, particles, batches, moves)
+    ones = np.ones(particles, dtype=np.int8)
+    with socket.create_connection((host, int(port))) as conn:
+        f = conn.makefile("rwb")
+        open_req: Dict[str, Any] = {
+            "op": "open", "facade": facade,
+            "num_particles": particles, "priority": res.priority,
+            "mesh": {"box": list(mesh_box)},
+            # Deep enough that one client can pipeline a full batch;
+            # global pressure is the admission budget's job.
+            "max_queue": moves + 2,
+        }
+        if chunk_size is not None:
+            open_req["chunk_size"] = int(chunk_size)
+        r = _rpc_admitted(f, open_req, res, max_retries=max_retries,
+                          retry_sleep=retry_sleep)
+        sid = r["session"]
+        for src, dests in work:
+            _rpc_admitted(
+                f, {"op": "source", "session": sid,
+                    "positions": _b64_f64(src)},
+                res, max_retries=max_retries, retry_sleep=retry_sleep,
+            )
+            for d in dests:
+                req = {"op": "move", "session": sid,
+                       "dests": _b64_f64(d), "flying": _b64_i8(ones),
+                       "wait": True}
+                t0 = time.perf_counter()
+                _rpc_admitted(f, req, res, max_retries=max_retries,
+                              retry_sleep=retry_sleep)
+                res.latencies.append(time.perf_counter() - t0)
+                res.moves_done += 1
+        if collect_flux:
+            r = _rpc(f, {"op": "flux", "session": sid})
+            if not r.get("ok"):
+                raise RuntimeError(
+                    f"flux failed: {r.get('message')}"
+                )
+            res.flux = _dec_f64(r["flux"])
+        _rpc(f, {"op": "close", "session": sid})
+
+
+def _quantile(xs: List[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    a = sorted(xs)
+    hi = len(a) - 1
+    return a[min(hi, int(p * hi + 0.5))]
+
+
+def run_load(host: str, port: int, *, clients: int = 100,
+             rate: float = 200.0, particles: int = 64,
+             batches: int = 1, moves: int = 2, facade: str = "mono",
+             chunk_size: Optional[int] = None,
+             mesh_box=(1.0, 1.0, 1.0, 3, 3, 3),
+             priority_mix: Tuple[float, float, float] = (0.2, 0.6, 0.2),
+             seed: int = 0, collect_flux: int = 0,
+             max_retries: int = 2000, retry_sleep: float = 0.002,
+             timeout: float = 600.0) -> Dict[str, Any]:
+    """Run the load and return the report dict (see module docstring).
+
+    Args:
+      host, port: a ``serve`` worker or a ``route`` router.
+      clients: scripted clients total (each: open → ``batches`` ×
+        (source + ``moves`` moves) → close).
+      rate: Poisson arrival rate, clients/second.
+      facade, particles, chunk_size, mesh_box: the campaign every
+        client runs (chunk_size only for facade="stream").
+      priority_mix: (high, normal, low) lane probabilities.
+      seed: the whole schedule's seed (arrivals, priorities,
+        positions).
+      collect_flux: return the final flux of the first k clients
+        (``"parity"`` in the report) for a solo-replay bitwise gate.
+      max_retries / retry_sleep: per-op refusal retry policy.
+      timeout: per-client-thread join bound.
+    """
+    mix = np.asarray(priority_mix, dtype=np.float64)
+    if mix.shape != (3,) or (mix < 0).any() or mix.sum() <= 0:
+        raise ValueError(
+            f"priority_mix must be 3 non-negative weights, got "
+            f"{priority_mix!r}"
+        )
+    rng = np.random.default_rng(int(seed))
+    gaps = rng.exponential(1.0 / float(rate), size=int(clients))
+    arrivals = np.cumsum(gaps)
+    priorities = rng.choice(_PRIORITIES, size=int(clients),
+                            p=mix / mix.sum())
+    results = [_ClientResult(str(p)) for p in priorities]
+    t0 = time.perf_counter()
+    threads = []
+    for i in range(int(clients)):
+        res = results[i]
+
+        def body(i=i, res=res):
+            try:
+                _run_client(
+                    host, port, i, res, t0 + float(arrivals[i]),
+                    seed=int(seed), particles=int(particles),
+                    batches=int(batches), moves=int(moves),
+                    facade=str(facade), chunk_size=chunk_size,
+                    mesh_box=mesh_box,
+                    collect_flux=i < int(collect_flux),
+                    max_retries=int(max_retries),
+                    retry_sleep=float(retry_sleep),
+                )
+            except Exception as e:  # noqa: BLE001 — per-client
+                # containment: one client's failure is a report row,
+                # not a crashed run.
+                res.error = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=body, daemon=True,
+                             name=f"loadgen-c{i}")
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=float(timeout))
+    wall = time.perf_counter() - t0
+    alive = sum(1 for t in threads if t.is_alive())
+
+    all_lat = [x for r in results for x in r.latencies]
+    served = sum(r.moves_done for r in results)
+    by_lane: Dict[str, List[int]] = {p: [] for p in _PRIORITIES}
+    for r in results:
+        by_lane[r.priority].append(r.moves_done)
+    report: Dict[str, Any] = {
+        "clients": int(clients),
+        "clients_failed": sum(1 for r in results if r.error),
+        "clients_timed_out": alive,
+        "wall_s": wall,
+        "served_moves": served,
+        "moves_per_s": served / wall if wall > 0 else 0.0,
+        "particle_moves_per_s":
+            served * int(particles) / wall if wall > 0 else 0.0,
+        "latency_ms": {
+            "p50": (lambda q: None if q is None else q * 1e3)(
+                _quantile(all_lat, 0.50)
+            ),
+            "p99": (lambda q: None if q is None else q * 1e3)(
+                _quantile(all_lat, 0.99)
+            ),
+        },
+        "lanes": {
+            p: {
+                "clients": len(by_lane[p]),
+                "served_moves": sum(by_lane[p]),
+                "jain": jain(by_lane[p]),
+            }
+            for p in _PRIORITIES
+        },
+        "refusals": {
+            "busy_retries": sum(r.busy_retries for r in results),
+            "overload_refusals":
+                sum(r.overload_refusals for r in results),
+        },
+        "errors": [
+            {"client": i, "error": r.error}
+            for i, r in enumerate(results) if r.error
+        ],
+    }
+    if collect_flux:
+        report["parity"] = [
+            {"client": i, "flux": results[i].flux}
+            for i in range(min(int(collect_flux), int(clients)))
+        ]
+    return report
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """The human-readable summary the CLI prints (--json skips it)."""
+    lat = report["latency_ms"]
+
+    def ms(x):
+        return "n/a" if x is None else f"{x:.2f}ms"
+
+    lines = [
+        f"clients          {report['clients']}"
+        f" (failed {report['clients_failed']},"
+        f" timed out {report['clients_timed_out']})",
+        f"wall             {report['wall_s']:.2f}s",
+        f"served moves     {report['served_moves']}"
+        f" ({report['moves_per_s']:.1f} moves/s,"
+        f" {report['particle_moves_per_s']:.0f} particle-moves/s)",
+        f"latency          p50 {ms(lat['p50'])}  p99 {ms(lat['p99'])}",
+        "refusals         "
+        f"busy_retries={report['refusals']['busy_retries']} "
+        f"overload={report['refusals']['overload_refusals']}",
+    ]
+    for p in _PRIORITIES:
+        ln = report["lanes"][p]
+        j = "n/a" if ln["jain"] is None else f"{ln['jain']:.3f}"
+        lines.append(
+            f"lane {p:<7}     clients={ln['clients']} "
+            f"served={ln['served_moves']} jain={j}"
+        )
+    for e in report["errors"][:5]:
+        lines.append(f"client {e['client']} FAILED: {e['error']}")
+    if len(report["errors"]) > 5:
+        lines.append(f"... and {len(report['errors']) - 5} more failures")
+    return "\n".join(lines)

@@ -1,0 +1,94 @@
+#!/bin/bash
+# r15 on-chip suite (PR 19 — the streaming chunk-wise fusion +
+# heavy-traffic round; suites number by PR-line like r8-r14 before
+# it). Fired by a probe loop (tools/r5_probe_loop.sh pattern) the
+# moment the TPU tunnel answers. ORDER MATTERS (r4 lesson): a QUICK
+# headline bench first (a short window must still yield a fresh
+# cached measurement), then the full bench (whose row set now
+# includes the SERVICE_LOAD row and the service_fusion streaming
+# sub-row), then THIS round's measurements —
+#   service_load: >= 100 scripted clients with a deterministic seeded
+#     Poisson schedule through a 2-worker router
+#     (tools/exp_service_load.py on top of tools/loadgen.py): served
+#     moves/s, client-observed p50/p99 submit->resolve latency,
+#     per-lane Jain fairness, refusal counts. The tool's gates
+#     (bitwise spot-check parity vs solo replays, compiles.timed == 0
+#     via the warmup ladder) apply on-chip unchanged.
+#   fusion_ab_stream: the r20 chunk-wise fused STREAMING arm of the
+#     fusion A/B at 4/8/16/32 sessions. Ship/kill rule
+#     (docs/PERF_NOTES.md "Streaming chunk-wise fusion"): SHIP chunk
+#     fusion as the streaming serving default if the fused arm
+#     >= 1.15x the unfused arm's served moves/s at 4+ streaming
+#     sessions on chip; KILL (gate streaming out of fusion keys
+#     again) below 1.0x, and record a wash honestly — the CPU A/B's
+#     number rides dispatch overhead that the chip may not share.
+# then the inherited subsystem A/Bs and engine experiments; chipless
+# AOT compiles go last (the remote compile helper remains the prime
+# wedge suspect).
+#
+# Crash-safety: stage logs stream DIRECTLY into the repo dir, the
+# digest regenerates before AND after every stage, and its write is
+# atomic (tmp + mv) so a kill mid-write cannot destroy the last good
+# one.
+set -u
+RD=/root/repo/tools/r15_onchip
+mkdir -p "$RD"
+cd /root/repo
+echo "suite started $(date)" > "$RD/status"
+STAGES=""
+write_digest() {
+  local DG="$RD/digest.md"
+  {
+    echo "# r15 on-chip suite digest"
+    cat "$RD/status"
+    echo
+    for f in $STAGES; do
+      echo "## $f"
+      grep -E '"metric"|"row"|moves/s|OK|SKIP|FAILED|FATAL|FAILURE|rc=' "$RD/$f.log" 2>/dev/null | tail -20
+      echo
+    done
+  } > "$DG.tmp" 2>/dev/null && mv "$DG.tmp" "$DG"
+}
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  STAGES="$STAGES $name"
+  echo "$name started $(date)" >> "$RD/status"
+  write_digest
+  timeout "$tmo" "$@" > "$RD/$name.log" 2>&1
+  local rc=$?
+  echo "$name done $(date) rc=$rc" >> "$RD/status"
+  write_digest
+}
+# Quick headline FIRST (~6 min): if the window closes mid-suite, a
+# fresh on-chip measurement is already cached (record_success).
+run bench_quick 900 env PUMIUMTALLY_BENCH_AUTOTUNE=0 PUMIUMTALLY_BENCH_VMEM=0 PUMIUMTALLY_BENCH_GATHER_BLOCKED=0 PUMIUMTALLY_BENCH_PINCELL_TUNED=0 PUMIUMTALLY_BENCH_CPU_BASELINE=0 PUMIUMTALLY_BENCH_TABLE_PRECISION=0 PUMIUMTALLY_BENCH_BATCH_STATS=0 PUMIUMTALLY_BENCH_SCORING=0 PUMIUMTALLY_BENCH_RESILIENCE=0 PUMIUMTALLY_BENCH_SENTINEL=0 PUMIUMTALLY_BENCH_SERVICE=0 PUMIUMTALLY_BENCH_SERVICE_FUSION=0 PUMIUMTALLY_BENCH_SERVICE_LOAD=0 PUMIUMTALLY_BENCH_DISTRIBUTED=0 PUMIUMTALLY_BENCH_PALLAS_WALK=0 PUMIUMTALLY_BENCH_PLACEMENT=0 PUMIUMTALLY_BENCH_MAX_WAIT=120 python bench.py
+run bench_clean 2700 python bench.py
+# THE round-20 measurements: served throughput under scripted load,
+# and the chunk-fused streaming arm whose >= 1.15x gate decides the
+# ship/kill rule in the header.
+run service_load 1800 env PUMIUMTALLY_AB_N=100000 PUMIUMTALLY_AB_CLIENTS=200 PUMIUMTALLY_AB_RATE=100 PUMIUMTALLY_AB_DIV=12 python tools/exp_service_load.py
+run fusion_ab_stream 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=4,8,16,32 PUMIUMTALLY_AB_TRIALS=3 PUMIUMTALLY_AB_FACADE=stream python tools/exp_fusion_ab.py
+# The round-14..19 re-measures, unchanged shapes so rounds compare
+# like-for-like.
+run placement_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 python tools/exp_placement_ab.py
+run pallas_walk_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_TRIALS=3 PUMIUMTALLY_AB_BLOCK_ELEMS=8192 python tools/exp_pallas_walk_ab.py
+run distributed_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_DIV=20 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_distributed_ab.py
+run fusion_ab 1800 env PUMIUMTALLY_AB_N=32768 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 PUMIUMTALLY_AB_SESSIONS=1,4,8,16,32 PUMIUMTALLY_AB_TRIALS=3 python tools/exp_fusion_ab.py
+run service_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=4 PUMIUMTALLY_AB_BATCHES=10 python tools/exp_service_ab.py
+# Inherited subsystem A/Bs (r7-r10 lineage), unchanged shapes so
+# rounds compare like-for-like.
+run scoring_ab  1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_MOVES=6 python tools/exp_scoring_ab.py
+run sentinel_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_sentinel_ab.py
+run resilience_ab 1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_resilience_ab.py
+run stats_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_BATCHES=12 python tools/exp_stats_ab.py
+run table_ab    1800 env PUMIUMTALLY_AB_N=500000 PUMIUMTALLY_AB_TRIALS=5 python tools/exp_table_precision_ab.py
+run blocked     3300 python tools/exp_r5_blocked.py 500000 4
+run frontier_ab 1800 python tools/exp_frontier_ab.py
+run native      1500 bash -c 'python -m pumiumtally_tpu.cli box --nx 20 --ny 20 --nz 20 /tmp/bench48k.osh && make -C native bench_host && PYTHONPATH=/root/repo ./native/bench_host /tmp/bench48k.osh 500000 6'
+# Chipless-certified compiles go last (wedge suspects). The pallas
+# harness self-limits with SIGALRM deadlines — SKIP, never a wedge.
+run aot_pallas  1200 python tools/aot_pallas_walk_compile.py
+run aot_pallas_blocked 1200 python tools/aot_pallas_walk_compile.py 4096 1024 2048 6 2
+run vmem_prod   1800 python tools/exp_r4_vmem_compile.py 500000
+echo "suite finished $(date)" >> "$RD/status"
+write_digest
