@@ -2,7 +2,7 @@
 
 ruff and clang-tidy (.github/workflows/static-analysis.yml) are the
 generic correctness backstop; this package is the JAX-specific one,
-organised as four passes over one shared parse + module index:
+organised as six passes over one shared parse + module index:
 
 * **Trace safety (JL000–JL005)** — understands where the TRACE
   BOUNDARY lies (``jax.jit`` / ``lax.while_loop`` / ``lax.scan`` /
@@ -28,6 +28,20 @@ organised as four passes over one shared parse + module index:
   (JL302), and blocking calls while holding a lock (JL303). Thread
   entry points come from the ``THREAD_ROOTS`` registry in
   ``analysis/concurrency.py``.
+* **Trace-key cardinality (JL401–JL404)** — the static half of the
+  retrace-budget contract: a registered entry point whose
+  statically-enumerable knob domains multiply past its
+  ``config.RETRACE_BUDGETS`` entry (JL401) and per-call-varying
+  values (``len(batch)``, ``x.shape``) reaching static key positions
+  (JL404) are caught per-file; the repo-wide ``--trace-keys`` audit
+  adds dead budgets (JL402) and unbudgeted entry points (JL403) and
+  prints the calibration inventory (``analysis/tracekeys.py``).
+* **Determinism (JL501–JL503)** — host seams of the bitwise
+  contract: unordered set iteration feeding device ops, wire
+  replies, or checkpoint key order (JL501), non-stable sorts on
+  segmented-commit paths (JL502), and host-side float
+  re-accumulation — builtin ``sum()`` over device fetches — inside
+  parity-gated tools (JL503) (``analysis/determinism.py``).
 
 Pure stdlib: no jax import, no code execution — safe for CI.
 
@@ -36,6 +50,8 @@ Usage::
     python -m pumiumtally_tpu.analysis pumiumtally_tpu/   # lint a tree
     python -m pumiumtally_tpu.analysis --format json ...  # machine use
     python -m pumiumtally_tpu.analysis --contracts        # facade audit
+    python -m pumiumtally_tpu.analysis --trace-keys       # budget audit
+    python -m pumiumtally_tpu.analysis --wire             # wire audit
     python -m pumiumtally_tpu.analysis --explain JL101    # rule docs
     python tools/jaxlint.py ...                           # same CLI
 
@@ -43,6 +59,12 @@ Usage::
 streaming, partitioned, streaming_partitioned) against the shared hook
 surface — batch-close, move-end, checkpoint rows, lane-bank registry,
 fusion-key — and prints the drift table referenced by ROADMAP item 5.
+``--trace-keys`` is the same idea for the retrace-budget table
+(ROADMAP item 5's other recurring tax), and ``--wire`` for the NDJSON
+socket protocol: every encoder (tools/loadgen.py, the test driver,
+the examples, the router's own forwarded pings) is cross-checked
+against the op allowlist and reply schemas AST-extracted from
+``service/server.py`` (``analysis/wire.py``).
 
 Suppression (justification REQUIRED — see docs/STATIC_ANALYSIS.md)::
 
@@ -55,6 +77,8 @@ is ``pumiumtally_tpu.utils.profiling.retrace_guard``.
 """
 
 from pumiumtally_tpu.analysis.contracts import audit_contracts
+from pumiumtally_tpu.analysis.tracekeys import audit_trace_keys
+from pumiumtally_tpu.analysis.wire import audit_wire
 from pumiumtally_tpu.analysis.core import (
     Analyzer,
     Diagnostic,
@@ -70,6 +94,8 @@ __all__ = [
     "RULES",
     "Rule",
     "audit_contracts",
+    "audit_trace_keys",
+    "audit_wire",
     "iter_python_files",
     "lint_paths",
     "lint_source",

@@ -20,7 +20,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m pumiumtally_tpu.analysis",
         description="jaxlint: JAX-aware static analyzer (trace safety "
         "JL00x, collective safety JL1xx, Pallas kernels JL2xx, host "
-        "concurrency JL3xx; docs/STATIC_ANALYSIS.md)",
+        "concurrency JL3xx, trace-key cardinality JL4xx, determinism "
+        "JL5xx; docs/STATIC_ANALYSIS.md)",
     )
     ap.add_argument(
         "paths", nargs="*", default=["pumiumtally_tpu"],
@@ -38,6 +39,20 @@ def main(argv: list[str] | None = None) -> int:
         "--contracts", action="store_true",
         help="audit the five tally facades against the shared hook "
         "surface instead of linting (exit 1 on a missing hook)",
+    )
+    ap.add_argument(
+        "--trace-keys", action="store_true", dest="trace_keys",
+        help="audit RETRACE_BUDGETS against every registered jit "
+        "entry point instead of linting (exit 1 on a dead budget or "
+        "an unbudgeted entry point) and print the static-key "
+        "calibration table",
+    )
+    ap.add_argument(
+        "--wire", action="store_true",
+        help="audit every NDJSON wire encoder against the "
+        "AST-extracted SocketFrontend op/reply schema instead of "
+        "linting (exit 1 on an unknown op, missing field, or reply "
+        "drift)",
     )
     ap.add_argument(
         "--format", choices=("text", "json"), default="text",
@@ -66,6 +81,28 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         report, code = audit_contracts()
+        render = render_json if args.format == "json" else render_text
+        print(render(report))
+        return code
+    if args.trace_keys:
+        from pumiumtally_tpu.analysis.tracekeys import (
+            audit_trace_keys,
+            render_json,
+            render_text,
+        )
+
+        report, code = audit_trace_keys()
+        render = render_json if args.format == "json" else render_text
+        print(render(report))
+        return code
+    if args.wire:
+        from pumiumtally_tpu.analysis.wire import (
+            audit_wire,
+            render_json,
+            render_text,
+        )
+
+        report, code = audit_wire()
         render = render_json if args.format == "json" else render_text
         print(render(report))
         return code

@@ -501,16 +501,20 @@ class Analyzer:
             self._check_traced_function(root, spec, index, seen)
         self._check_donation(tree, index)
         self._check_static_defaults(tree, index)
-        # The JL1xx/2xx/3xx passes share this parse + index and feed
-        # the same dedup/pragma pipeline below. Imported lazily:
-        # the pass modules import Diagnostic/_ModuleIndex from here.
+        # The JL1xx/2xx/3xx/4xx/5xx passes share this parse + index
+        # and feed the same dedup/pragma pipeline below. Imported
+        # lazily: the pass modules import Diagnostic/_ModuleIndex
+        # from here.
         from pumiumtally_tpu.analysis import (
             collective,
             concurrency,
+            determinism,
             pallas,
+            tracekeys,
         )
 
-        for check in (collective.check, pallas.check, concurrency.check):
+        for check in (collective.check, pallas.check, concurrency.check,
+                      tracekeys.check, determinism.check):
             self.diags.extend(check(tree, index, self.path))
         # Nested defs are reachable both through their own walk and the
         # enclosing function's — keep the first of any exact duplicate.
@@ -996,18 +1000,25 @@ class Analyzer:
 
 
 def iter_python_files(paths: Iterable[str]) -> list[str]:
+    """Every lintable file under ``paths``, fully deterministic:
+    caches (``__pycache__``), VCS internals, and scratch dirs/files
+    (``.tmp-*`` — editors and the A/B harnesses drop them) are
+    pruned, the walk itself visits directories in sorted order, and
+    the result is sorted — so ``--format json`` output is byte-stable
+    across filesystems and readdir orders."""
     out: list[str] = []
     for p in paths:
         if os.path.isdir(p):
             for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = [
+                dirnames[:] = sorted(
                     dn for dn in dirnames
                     if dn not in ("__pycache__", ".git")
-                ]
+                    and not dn.startswith(".tmp-")
+                )
                 out.extend(
                     os.path.join(dirpath, f)
-                    for f in filenames
-                    if f.endswith(".py")
+                    for f in sorted(filenames)
+                    if f.endswith(".py") and not f.startswith(".tmp-")
                 )
         elif p.endswith(".py"):
             out.append(p)

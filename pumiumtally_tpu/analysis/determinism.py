@@ -1,0 +1,254 @@
+"""jaxlint determinism pass: rules JL501-JL503 (pure stdlib).
+
+The engine's headline contract is bitwise determinism (PAPER.md §0:
+atomically-accumulated track-length tallies reproduced exactly, pinned
+by every parity test in the suite). The device side earns it with
+sorted segmented commits and stable sorts; this pass guards the HOST
+seams where Python can silently re-randomize an order the device side
+worked to fix:
+
+* JL501 — unordered-set iteration (or ``list(...)``/``tuple(...)``
+  materialization of a set) feeding an order-sensitive sink: a device
+  op, a wire reply (``json.dumps``/socket send), or accumulating
+  ``append``/``extend`` state such as checkpoint key order. Python
+  ``set`` iteration order varies with hash seeding and insertion
+  history — route through ``sorted(...)`` instead. Dict iteration is
+  insertion-ordered and is NOT flagged.
+* JL502 — a non-stable ``argsort`` in a function that also performs a
+  segmented commit (``.at[...].add``/``.at[...].set`` or a
+  ``segment_sum``): the fused-scatter stability proof assumes ties
+  keep their lane order, which ``np.argsort``'s default quicksort does
+  not guarantee. ``jnp.argsort`` is stable by default and only flagged
+  when explicitly made unstable (``stable=False`` or an unstable
+  ``kind=``).
+* JL503 — host-side float re-accumulation: builtin ``sum()`` over
+  device fetches (``jax.device_get(...)`` / ``.tolist()``). Left-fold
+  float addition on host re-orders the rounding the device commit
+  pinned; parity-gated A/B tools must compare device-reduced scalars.
+
+Same no-false-positive bias as every other pass: JL501 needs BOTH an
+unambiguously unordered iterable and a recognized sink in the loop
+body; JL502 needs the commit and the sort in the same function;
+``sorted(set(...))`` is the endorsed spelling and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from pumiumtally_tpu.analysis.core import Diagnostic, _ModuleIndex
+
+#: Method names whose call inside an unordered-iteration body means
+#: the iteration order escapes into durable/ordered state.
+_SINK_METHODS = (
+    "append",
+    "extend",
+    "sendall",
+    "send",
+    "write",
+    "writelines",
+)
+
+#: Dotted-call prefixes that put iteration order onto the device.
+_DEVICE_PREFIXES = ("jax.", "jnp.", "jax_graft.")
+
+#: numpy argsort kinds that guarantee stability.
+_STABLE_KINDS = ("stable", "mergesort")
+
+
+def _is_unordered(node: ast.AST, index: _ModuleIndex) -> bool:
+    """True when ``node`` evaluates to a Python set (iteration order
+    depends on hashing, not program history)."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        d = index.dotted(node.func)
+        if d in ("set", "frozenset"):
+            return True
+        # set algebra on an already-unordered operand: set(a) | b etc.
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        return _is_unordered(node.left, index) or _is_unordered(
+            node.right, index
+        )
+    return False
+
+
+def _is_device_call(call: ast.Call, index: _ModuleIndex) -> bool:
+    d = index.dotted(call.func)
+    if not d:
+        return False
+    return any(d.startswith(p) for p in _DEVICE_PREFIXES)
+
+
+def _body_sink(body: List[ast.stmt], index: _ModuleIndex
+               ) -> Optional[str]:
+    """The first order-sensitive sink inside a loop body, described,
+    or None."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_device_call(node, index):
+                return index.dotted(node.func) or "a device op"
+            d = index.dotted(node.func)
+            if d and (d == "json.dumps" or d.endswith(".dumps")):
+                return d
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SINK_METHODS
+            ):
+                return f".{node.func.attr}(...)"
+    return None
+
+
+def _sort_knobs(call: ast.Call):
+    """(kind, stable) literal keyword values of a sort call, None for
+    each when absent or non-literal."""
+    kind = None
+    stable = None
+    for kw in call.keywords:
+        if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+            kind = kw.value.value
+        if kw.arg == "stable" and isinstance(kw.value, ast.Constant):
+            stable = kw.value.value
+    return kind, stable
+
+
+def _argsort_finding(call: ast.Call, index: _ModuleIndex
+                     ) -> Optional[str]:
+    d = index.dotted(call.func)
+    if not d or not d.endswith("argsort"):
+        return None
+    kind, stable = _sort_knobs(call)
+    is_jax = d.startswith("jax.") or d.startswith("jnp.")
+    if is_jax:
+        if stable is False:
+            return f"{d}(..., stable=False)"
+        if kind is not None and kind not in _STABLE_KINDS:
+            return f"{d}(..., kind={kind!r})"
+        return None
+    if d.startswith("numpy.") or d.startswith("np."):
+        if stable is True or kind in _STABLE_KINDS:
+            return None
+        return f"{d} (numpy default quicksort)"
+    return None
+
+
+def _is_commit(node: ast.Call, index: _ModuleIndex) -> bool:
+    """``x.at[...].add(...)`` / ``x.at[...].set(...)`` or a
+    segment_sum — the segmented-commit shapes the stability proof
+    (docs/DESIGN notes, PR 9's fused scatter) covers."""
+    d = index.dotted(node.func)
+    if d and "segment_sum" in d:
+        return True
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("add", "set", "max", "min")
+        and isinstance(f.value, ast.Subscript)
+        and isinstance(f.value.value, ast.Attribute)
+        and f.value.value.attr == "at"
+    )
+
+
+def _fetch_inside(node: ast.AST, index: _ModuleIndex) -> Optional[str]:
+    """A device-fetch expression inside ``node`` (``jax.device_get``
+    or ``.tolist()``), described, or None."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        d = index.dotted(n.func)
+        if d and d.endswith("device_get"):
+            return d
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "tolist":
+            return ".tolist()"
+    return None
+
+
+def check(tree: ast.Module, index: _ModuleIndex, path: str
+          ) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+
+    # JL501: unordered iteration with an order-sensitive sink, and
+    # unordered materialization via list()/tuple().
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if _is_unordered(node.iter, index):
+                sink = _body_sink(list(node.body), index)
+                if sink is not None:
+                    diags.append(Diagnostic(
+                        path, node.lineno, "JL501",
+                        "iteration over an unordered set feeds an "
+                        f"order-sensitive sink ({sink}): set order "
+                        "varies run-to-run — iterate "
+                        "`sorted(...)` to keep the bitwise contract",
+                    ))
+        elif isinstance(node, ast.Call):
+            d = index.dotted(node.func)
+            if (
+                d in ("list", "tuple")
+                and node.args
+                and _is_unordered(node.args[0], index)
+            ):
+                diags.append(Diagnostic(
+                    path, node.lineno, "JL501",
+                    f"{d}(...) materializes a set in hash order: the "
+                    "result's element order varies run-to-run — use "
+                    "`sorted(...)` instead",
+                ))
+
+    # A bare set-driven comprehension used for membership stays
+    # legal; ordered escapes are covered by the For and
+    # list()/tuple() shapes above.
+
+    # JL502: non-stable argsort in a function that also commits.
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        commits = False
+        sorts: List[tuple] = []
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            if _is_commit(inner, index):
+                commits = True
+            reason = _argsort_finding(inner, index)
+            if reason is not None:
+                sorts.append((inner.lineno, reason))
+        if commits:
+            for line, reason in sorts:
+                diags.append(Diagnostic(
+                    path, line, "JL502",
+                    f"non-stable sort `{reason}` in a function that "
+                    "performs a segmented commit: ties may swap lane "
+                    "order between runs and break the fused-scatter "
+                    "stability proof — use kind='stable' (numpy) or "
+                    "leave jnp.argsort at its stable default",
+                ))
+
+    # JL503: builtin sum() over device fetches.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Name) and node.func.id == "sum"
+        ):
+            continue
+        if index.resolve_function("sum") is not None:
+            continue  # locally shadowed — not the builtin
+        for arg in node.args:
+            fetch = _fetch_inside(arg, index)
+            if fetch is not None:
+                diags.append(Diagnostic(
+                    path, node.lineno, "JL503",
+                    f"host-side float re-accumulation: builtin sum() "
+                    f"over a device fetch ({fetch}) left-folds with "
+                    "host rounding order — reduce on device (e.g. "
+                    "jnp.sum) and fetch the scalar, or compare the "
+                    "device-reduced value directly",
+                ))
+                break
+    return diags

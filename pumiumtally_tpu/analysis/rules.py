@@ -474,3 +474,156 @@ the lock); calls with a timeout argument are exempt (bounded).
 """,
 )
 
+
+_rule(
+    "JL401",
+    "statically-possible trace-key cardinality exceeds the budget",
+    """
+Every value reaching a static key position of a registered jit entry
+point multiplies the number of programs XLA may compile for it. When
+the full set of call sites passes only statically-enumerable values —
+literals, or loop variables ranging over literal tuples — the possible
+cardinality is a provable number, and it must fit inside
+`config.RETRACE_BUDGETS[name]` or the runtime tripwire
+(tests/conftest.py) WILL eventually fire on some knob combination CI
+happened not to exercise.
+
+    bad:
+        _step = register_entry_point("walk", jax.jit(
+            step, static_argnames=("mode", "order")))
+        for mode in ("fast", "exact", "paranoid"):
+            for order in (1, 2, 3, 4):
+                _step(state, mode=mode, order=order)
+        # 3 x 4 = 12 possible keys vs RETRACE_BUDGETS["walk"] = 3
+
+    good:
+        # shrink the knob domain, fold knobs together, or raise the
+        # budget with a justifying comment in config.py:
+        for mode in ("fast", "exact"):
+            _step(state, mode=mode)           # 2 <= budget
+
+One runtime-valued knob makes the cardinality unknowable and the check
+skips the entry point entirely (the runtime tripwire still guards it).
+""",
+)
+
+_rule(
+    "JL402",
+    "dead retrace budget: no matching entry point",
+    """
+A `config.RETRACE_BUDGETS` key with no `register_entry_point` site
+declaring that name bounds nothing: the tripwire looks up budgets by
+the REGISTERED name, so a stale key silently stops guarding the entry
+point it used to describe (typically after a rename).
+
+    bad:   RETRACE_BUDGETS = {"walk_v1": 3}   # renamed to "walk"
+    good:  RETRACE_BUDGETS = {"walk": 3}      # matches the live site
+
+Reported by the repo-wide audit (`--trace-keys`), not the per-file
+lint: prune the dead key or restore the registration.
+""",
+)
+
+_rule(
+    "JL403",
+    "unbudgeted entry point: compiles counted but never bounded",
+    """
+A `register_entry_point` site whose name has no
+`config.RETRACE_BUDGETS` entry is profiled but untripwired: its
+recompiles show up in `PUMIUMTALLY_RETRACE_RECORD` output yet no test
+can ever fail on a retrace storm there.
+
+    bad:   _step = register_entry_point("walk_v2", jax.jit(step))
+           # RETRACE_BUDGETS has no "walk_v2" key
+    good:  add `"walk_v2": <measured + headroom>` to RETRACE_BUDGETS
+           with a justifying comment (tools/retrace_calibrate.py
+           prints the measured number).
+
+Reported by the repo-wide audit (`--trace-keys`); a registration whose
+name is not a string literal is reported the same way (it cannot be
+audited against the budget table at all).
+""",
+)
+
+_rule(
+    "JL404",
+    "per-call-varying value in a static jit key position",
+    """
+Passing a data-dependent size — `len(batch)`, `x.shape[0]`, `x.size`
+of a function argument — into a static key position of a registered
+entry point compiles one program PER DISTINCT VALUE: unbounded retrace
+bait that JL004's single-function view cannot see, because the
+varying value crosses the caller/entry-point boundary.
+
+    bad:
+        def serve(batch):
+            return _step(state, n=len(batch))   # n is static
+
+    good:
+        def serve(batch):
+            padded = pad_to_bucket(batch)       # quantize the domain
+            return _step(state, padded)         # size is traced shape
+
+Route the value through a traced operand, or quantize it to a small
+literal bucket set so the cardinality is provable again.
+""",
+)
+
+_rule(
+    "JL501",
+    "unordered set iteration feeding an order-sensitive sink",
+    """
+Python `set` iteration order depends on hash seeding and insertion
+history — it is not stable across runs, let alone hosts. Feeding it to
+a device op, a wire reply, or accumulating `append`/`extend` state
+(checkpoint key order) silently re-randomizes an order the device side
+worked to pin, breaking the bitwise-determinism contract.
+`list(...)`/`tuple(...)` of a set materializes the same hazard.
+
+    bad:
+        for sid in active_sessions:            # a set
+            replies.append(encode(sid))        # wire order varies
+
+    good:
+        for sid in sorted(active_sessions):
+            replies.append(encode(sid))
+
+Dict iteration is insertion-ordered and is NOT flagged; a set used for
+membership tests stays legal.
+""",
+)
+
+_rule(
+    "JL502",
+    "non-stable sort on a segmented-commit path",
+    """
+The fused-scatter stability proof (PR 9's commit contract) assumes
+ties keep their lane order through the sort that groups segments.
+`np.argsort` defaults to quicksort, which reorders equal keys
+run-to-run; in a function that also performs a segmented commit
+(`.at[...].add/.set` or a `segment_sum`) that tie-break leaks into
+the committed accumulation order.
+
+    bad:   order = np.argsort(bins)            # quicksort ties
+           acc = acc.at[bins[order]].add(w[order])
+
+    good:  order = np.argsort(bins, kind="stable")
+           # jnp.argsort is stable by default and stays unflagged
+           # unless explicitly made unstable (stable=False).
+""",
+)
+
+_rule(
+    "JL503",
+    "host-side float re-accumulation over device fetches",
+    """
+Builtin `sum()` over device fetches (`jax.device_get(...)` /
+`.tolist()`) left-folds with HOST rounding order — a different
+association than the device's pinned segmented reduction, so two runs
+(or host/device) disagree in the last ulp and a parity gate flakes.
+
+    bad:   total = sum(jax.device_get(flux).tolist())
+    good:  total = float(jnp.sum(flux))        # reduce on device,
+           # fetch one scalar; compare device-reduced values only.
+""",
+)

@@ -1,0 +1,607 @@
+"""jaxlint trace-cardinality prover: rules JL401-JL404 (pure stdlib).
+
+Every jitted hot-loop program in the engine is registered through
+``profiling.register_entry_point(name, jitted_fn)`` and bounded by a
+compile budget in ``config.RETRACE_BUDGETS`` — the runtime tripwire
+(tests/conftest.py) fails any test whose entry point compiles past its
+budget. This pass is the STATIC half of that contract, in two parts:
+
+* The per-module lint rules, run by ``Analyzer.run()`` like every
+  other pass: JL401 flags a registration whose statically-possible
+  trace-key cardinality (the product of the literal value domains
+  reaching its static-argument positions across all call sites)
+  provably exceeds the declared budget; JL404 flags a per-call-varying
+  value — ``len(x)`` or ``x.shape[...]``/``x.size`` of a runtime
+  argument — reaching a static key position, the unbounded retrace
+  bait JL004's single-function view cannot see.
+* The repo-wide audit (``python -m pumiumtally_tpu.analysis
+  --trace-keys``, ``audit_trace_keys()``): cross-checks the
+  ``RETRACE_BUDGETS`` table against every ``register_entry_point``
+  site in the package — a budget with no matching entry point is dead
+  (JL402), an entry point with no budget is untripwired (JL403) — and
+  prints the per-entry static-key inventory that serves as the live
+  calibration table (the way ``--contracts`` is for the facade hook
+  surface).
+
+Like the rest of jaxlint, everything here is best-effort STATIC
+reasoning with a hard no-false-positive bias: JL401 only fires when
+every value reaching every static position of an entry point is
+statically enumerable (a literal, or a loop variable ranging over a
+literal module-level tuple); one runtime-valued knob makes the
+cardinality unknowable and the check skips, never guesses. Budgets are
+read by PARSING ``config.py`` (never importing it — the package
+``__init__`` imports jax).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from pumiumtally_tpu.analysis.core import (
+    Diagnostic,
+    JitSpec,
+    _ModuleIndex,
+)
+
+#: Budget keys that are guard configuration, not entry-point names
+#: (``retrace_guard`` treats "total" as the whole-block compile bound).
+EXEMPT_BUDGET_KEYS = ("total",)
+
+
+def package_root() -> str:
+    """The ``pumiumtally_tpu`` package dir, valid from any cwd."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def read_budgets(root: Optional[str] = None) -> Dict[str, int]:
+    """``RETRACE_BUDGETS`` parsed out of ``config.py`` as a literal —
+    the module itself is never imported (its package imports jax).
+    Returns {} when the table cannot be read or is not a literal dict
+    (callers then skip budget-dependent checks rather than guess)."""
+    root = root or package_root()
+    path = os.path.join(root, "config.py")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if "RETRACE_BUDGETS" not in names:
+            continue
+        try:
+            raw = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            return {}
+        if not isinstance(raw, dict):
+            return {}
+        out: Dict[str, int] = {}
+        for k, v in raw.items():
+            if isinstance(k, str) and isinstance(v, int):
+                out[k] = v
+        return out
+    return {}
+
+
+_CACHED_BUDGETS: Optional[Dict[str, int]] = None
+
+
+def _budgets_cached() -> Dict[str, int]:
+    global _CACHED_BUDGETS
+    if _CACHED_BUDGETS is None:
+        _CACHED_BUDGETS = read_budgets()
+    return _CACHED_BUDGETS
+
+
+# ---------------------------------------------------------------------------
+# Registration discovery (shared by the lint pass and the audit)
+
+
+@dataclass
+class _Registration:
+    """One ``register_entry_point(name, fn)`` site."""
+
+    name: Optional[str]  # None = non-literal name
+    line: int
+    call: ast.Call
+    target: Optional[str] = None  # assigned local/module name
+    spec: Optional[JitSpec] = None
+    fn_def: Optional[ast.AST] = None  # wrapped FunctionDef when known
+    dynamic_name_expr: Optional[str] = None
+
+
+def _is_reg_call(index: _ModuleIndex, call: ast.Call) -> bool:
+    d = index.dotted(call.func)
+    return bool(d) and (
+        d == "register_entry_point"
+        or d.endswith(".register_entry_point")
+    )
+
+
+def _resolve_spec(
+    index: _ModuleIndex, call: ast.Call
+) -> Tuple[Optional[JitSpec], Optional[ast.AST]]:
+    """(JitSpec, wrapped FunctionDef) of a registration's callable:
+    either an inline jit wrapping (``register_entry_point("walk",
+    jax.jit(f, ...))`` and the partial form) or a previously-jitted
+    named function."""
+    found = index._find_jit_wrapping(call)
+    fn_expr: Optional[ast.AST] = None
+    spec: Optional[JitSpec] = None
+    if found is not None:
+        spec, fn_expr = found
+        if isinstance(fn_expr, ast.Call):
+            td = index.dotted(fn_expr.func)
+            if td in ("functools.partial", "partial") and fn_expr.args:
+                fn_expr = fn_expr.args[0]
+    elif len(call.args) > 1:
+        fn_expr = call.args[1]
+    fn_def = None
+    if isinstance(fn_expr, ast.Name):
+        fn_def = index.resolve_function(fn_expr.id)
+        if spec is None and fn_def is not None:
+            spec = index.jit_specs.get(id(fn_def))
+    return spec, fn_def
+
+
+def _registrations(
+    tree: ast.Module, index: _ModuleIndex
+) -> List[_Registration]:
+    regs: List[_Registration] = []
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        target = None
+        call: Optional[ast.Call] = None
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            call = node.value
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            target = names[0] if names else None
+        elif isinstance(node, ast.Call):
+            call = node
+        if call is None or id(call) in seen:
+            continue
+        if not _is_reg_call(index, call) or not call.args:
+            continue
+        seen.add(id(call))
+        name_node = call.args[0]
+        name = (
+            name_node.value
+            if isinstance(name_node, ast.Constant)
+            and isinstance(name_node.value, str)
+            else None
+        )
+        spec, fn_def = _resolve_spec(index, call)
+        regs.append(_Registration(
+            name=name,
+            line=call.lineno,
+            call=call,
+            target=target,
+            spec=spec,
+            fn_def=fn_def,
+            dynamic_name_expr=(
+                None if name is not None
+                else ast.unparse(name_node)
+            ),
+        ))
+    return regs
+
+
+def _param_names(fn_def: Optional[ast.AST]) -> List[str]:
+    if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    a = fn_def.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _static_params(reg: _Registration) -> Optional[List[str]]:
+    """The entry point's static parameter NAMES, or None when they
+    cannot be fully resolved (argnums with no visible function def)."""
+    if reg.spec is None:
+        return None
+    names = list(reg.spec.static_argnames)
+    if reg.spec.static_argnums:
+        params = _param_names(reg.fn_def)
+        if not params:
+            return None
+        for i in reg.spec.static_argnums:
+            if i >= len(params):
+                return None
+            if params[i] not in names:
+                names.append(params[i])
+    return names
+
+
+# ---------------------------------------------------------------------------
+# Lint pass: JL401 (provable cardinality overflow) + JL404 (per-call
+# varying value in a static key position)
+
+
+def _walk_with_ancestors(root: ast.AST):
+    stack: List[Tuple[ast.AST, Tuple[ast.AST, ...]]] = [(root, ())]
+    while stack:
+        node, anc = stack.pop()
+        yield node, anc
+        child_anc = anc + (node,)
+        stack.extend(
+            (c, child_anc) for c in ast.iter_child_nodes(node)
+        )
+
+
+def _enclosing_params(anc: Tuple[ast.AST, ...]) -> Set[str]:
+    """Parameter names of the nearest enclosing function def — the
+    values that vary per CALL of the surrounding code."""
+    for node in reversed(anc):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            params = {
+                p.arg
+                for p in list(a.posonlyargs) + list(a.args)
+                + list(a.kwonlyargs)
+            }
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+            params.discard("self")
+            params.discard("cls")
+            return params
+    return set()
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _varying_reason(
+    expr: ast.AST, params: Set[str]
+) -> Optional[str]:
+    """Why ``expr`` is per-call varying, or None. Fires only on the
+    unambiguous data-size shapes — ``len(arg)``, ``arg.shape[...]``,
+    ``arg.size`` — of a surrounding-function parameter."""
+    for n in ast.walk(expr):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id == "len"
+            and n.args
+        ):
+            root = _root_name(n.args[0])
+            if root in params:
+                return f"len({root})"
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "size"):
+            root = _root_name(n.value)
+            if root in params:
+                return f"{root}.{n.attr}"
+    return None
+
+
+def _literal_elements(
+    node: ast.AST, tree: ast.Module
+) -> Optional[Set[str]]:
+    """repr()s of a literal sequence's elements; follows one level of
+    module-level ``KNOBS = (…)`` indirection. None = not literal."""
+    if isinstance(node, ast.Name):
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == node.id
+                for t in stmt.targets
+            ):
+                node = stmt.value
+                break
+        else:
+            return None
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    try:
+        vals = [ast.literal_eval(e) for e in node.elts]
+    except (ValueError, SyntaxError):
+        return None
+    return {repr(v) for v in vals}
+
+
+def _value_domain(
+    expr: ast.AST, anc: Tuple[ast.AST, ...], tree: ast.Module
+) -> Optional[Set[str]]:
+    """Statically-possible values of ``expr`` at a call site: a
+    literal, or a loop variable ranging over a literal sequence. None
+    = not enumerable (the caller must then skip, not guess)."""
+    try:
+        return {repr(ast.literal_eval(expr))}
+    except (ValueError, SyntaxError):
+        pass
+    if isinstance(expr, ast.Name):
+        for node in reversed(anc):
+            if (
+                isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == expr.id
+            ):
+                return _literal_elements(node.iter, tree)
+    return None
+
+
+def _static_args_at_call(
+    call: ast.Call, reg: _Registration, static_names: List[str]
+) -> Optional[List[Tuple[str, ast.AST]]]:
+    """(static param name, value expr) pairs at one call site; None
+    when the site cannot be mapped (``*args``/``**kwargs``
+    forwarding)."""
+    out: List[Tuple[str, ast.AST]] = []
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return None
+    params = _param_names(reg.fn_def)
+    for i, a in enumerate(call.args):
+        if params and i < len(params) and params[i] in static_names:
+            out.append((params[i], a))
+    for kw in call.keywords:
+        if kw.arg is None:  # **kwargs
+            return None
+        if kw.arg in static_names:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def check(tree: ast.Module, index: _ModuleIndex, path: str
+          ) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    regs = [
+        r for r in _registrations(tree, index)
+        if r.name is not None and r.target is not None
+    ]
+    if not regs:
+        return diags
+    budgets = _budgets_cached()
+    nodes = list(_walk_with_ancestors(tree))
+    for reg in regs:
+        static_names = _static_params(reg)
+        if not static_names:
+            continue
+        sites = [
+            (node, anc)
+            for node, anc in nodes
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == reg.target
+            and node is not reg.call
+        ]
+        domains: Dict[str, Set[str]] = {}
+        enumerable = bool(sites)
+        for call, anc in sites:
+            pairs = _static_args_at_call(call, reg, static_names)
+            if pairs is None:
+                enumerable = False
+                continue
+            params = _enclosing_params(anc)
+            for sname, expr in pairs:
+                reason = _varying_reason(expr, params)
+                if reason is not None:
+                    diags.append(Diagnostic(
+                        path, call.lineno, "JL404",
+                        f"per-call-varying value `{reason}` reaches "
+                        f"static key position {sname!r} of entry "
+                        f"point {reg.name!r}: every distinct value "
+                        "compiles a new program — pass it as a traced "
+                        "operand (or a padded/quantized static) "
+                        "instead",
+                    ))
+                    enumerable = False
+                    continue
+                dom = _value_domain(expr, anc, tree)
+                if dom is None:
+                    enumerable = False
+                    continue
+                domains.setdefault(sname, set()).update(dom)
+        budget = budgets.get(reg.name)
+        if enumerable and domains and budget is not None:
+            card = math.prod(
+                len(v) for v in domains.values() if v
+            )
+            if card > budget:
+                knobs = ", ".join(
+                    f"{k}:{len(v)}"
+                    for k, v in sorted(domains.items())
+                )
+                diags.append(Diagnostic(
+                    path, reg.line, "JL401",
+                    f"entry point {reg.name!r} has a statically-"
+                    f"possible trace-key cardinality of {card} "
+                    f"({knobs}) exceeding RETRACE_BUDGETS"
+                    f"[{reg.name!r}] = {budget}; shrink the static "
+                    "knob domain or raise the budget with a "
+                    "justifying comment in config.py",
+                ))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide audit: --trace-keys (JL402 dead budget / JL403 unbudgeted
+# entry point) + the calibration inventory table
+
+
+def _iter_package_files(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            dn for dn in dirnames
+            if dn not in ("__pycache__", ".git")
+            and not dn.startswith(".tmp-")
+        )
+        for f in sorted(filenames):
+            if f.endswith(".py") and not f.startswith(".tmp-"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+@dataclass
+class _EntryRow:
+    name: str
+    module: str
+    line: int
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    jit_resolved: bool = False
+    budget: Optional[int] = None
+    findings: List[str] = field(default_factory=list)
+
+
+def audit_trace_keys(root: Optional[str] = None) -> Tuple[dict, int]:
+    """Cross-check ``config.RETRACE_BUDGETS`` against every
+    ``register_entry_point`` site under ``root`` (default: the
+    installed package). Returns (report, exit_code): 0 = every
+    registered entry point budgeted and every budget live, 1 = any
+    JL402 (dead budget), JL403 (unbudgeted entry point), or a
+    registration whose name is not a string literal (unauditable)."""
+    root = root or package_root()
+    budgets = read_budgets(root)
+    rows: List[_EntryRow] = []
+    findings: List[dict] = []
+    for path in _iter_package_files(root):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        index = _ModuleIndex(tree)
+        for reg in _registrations(tree, index):
+            if reg.name is None:
+                findings.append({
+                    "rule": "JL403",
+                    "name": reg.dynamic_name_expr,
+                    "module": rel,
+                    "line": reg.line,
+                    "message": (
+                        "register_entry_point name is not a string "
+                        "literal — the retrace budget table cannot "
+                        "be audited against it"
+                    ),
+                })
+                continue
+            rows.append(_EntryRow(
+                name=reg.name,
+                module=rel,
+                line=reg.line,
+                static_argnums=(
+                    reg.spec.static_argnums if reg.spec else ()
+                ),
+                static_argnames=(
+                    reg.spec.static_argnames if reg.spec else ()
+                ),
+                jit_resolved=reg.spec is not None,
+                budget=budgets.get(reg.name),
+            ))
+    registered = {r.name for r in rows}
+    budget_names = {
+        k for k in budgets if k not in EXEMPT_BUDGET_KEYS
+    }
+    for name in sorted(budget_names - registered):
+        findings.append({
+            "rule": "JL402",
+            "name": name,
+            "message": (
+                f"RETRACE_BUDGETS[{name!r}] = {budgets[name]} is a "
+                "dead budget: no register_entry_point site declares "
+                "this name — prune it (or restore the registration)"
+            ),
+        })
+    for row in rows:
+        if row.budget is None:
+            row.findings.append("JL403")
+            findings.append({
+                "rule": "JL403",
+                "name": row.name,
+                "module": row.module,
+                "line": row.line,
+                "message": (
+                    f"entry point {row.name!r} "
+                    f"({row.module}:{row.line}) has no "
+                    "RETRACE_BUDGETS entry: its compiles are "
+                    "counted but never bounded — add a budget with "
+                    "a justifying comment in config.py"
+                ),
+            })
+    rows.sort(key=lambda r: (r.name, r.module, r.line))
+    report = {
+        "budgets": dict(sorted(budgets.items())),
+        "entry_points": [
+            {
+                "name": r.name,
+                "module": r.module,
+                "line": r.line,
+                "budget": r.budget,
+                "static_argnums": list(r.static_argnums),
+                "static_argnames": list(r.static_argnames),
+                "jit_resolved": r.jit_resolved,
+            }
+            for r in rows
+        ],
+        "findings": findings,
+    }
+    return report, (1 if findings else 0)
+
+
+def render_text(report: dict) -> str:
+    grid = [["entry point", "budget", "registered at", "static key args"]]
+    for row in report["entry_points"]:
+        statics = ", ".join(
+            [str(i) for i in row["static_argnums"]]
+            + list(row["static_argnames"])
+        )
+        if not row["jit_resolved"]:
+            statics = statics or "(jit not statically resolvable)"
+        grid.append([
+            row["name"],
+            "—" if row["budget"] is None else str(row["budget"]),
+            f"{row['module']}:{row['line']}",
+            statics or "(none)",
+        ])
+    widths = [max(len(r[i]) for r in grid) for i in range(len(grid[0]))]
+    lines = []
+    for i, r in enumerate(grid):
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+        if i == 0:
+            lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    n_entries = len(report["entry_points"])
+    n_budgets = len([
+        k for k in report["budgets"] if k not in EXEMPT_BUDGET_KEYS
+    ])
+    lines.append("")
+    lines.append(
+        f"{n_entries} registered entry point(s), {n_budgets} "
+        "budget(s)"
+    )
+    for f in report["findings"]:
+        where = (
+            f" ({f['module']}:{f['line']})" if "module" in f else ""
+        )
+        lines.append(f"{f['rule']}: {f['name']}{where} — {f['message']}")
+    if not report["findings"]:
+        lines.append(
+            "every budget live, every entry point budgeted"
+        )
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=True)

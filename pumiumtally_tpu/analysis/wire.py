@@ -1,0 +1,672 @@
+"""Wire-protocol auditor for the NDJSON-TCP service (pure stdlib).
+
+``python -m pumiumtally_tpu.analysis --wire`` — the contracts.py
+sibling for the socket surface. The NDJSON protocol has exactly one
+authority: ``SocketFrontend._dispatch`` in service/server.py, whose
+op allowlist, required request fields, and per-op reply dictionaries
+ARE the schema (plus the ``SessionRouter`` augmentations: the
+fleet-shape ping reply and the ``home``-qualified open reply). Every
+other file that speaks the protocol — the load generator, the test
+driver, the service examples, the router's own forwarded pings — is
+an ENCODER that can silently drift: an op renamed on the server turns
+a client loop into a flaky socket test instead of a CI failure.
+
+This module AST-extracts the schema from the server (never importing
+it — the package imports jax) and cross-checks every encoder:
+
+* request dicts (any dict literal with a literal ``"op"`` key,
+  including keys added later via ``d["k"] = v`` in the same scope)
+  must name a known op and carry that op's required fields
+  (``MISSING-FIELD`` / ``UNKNOWN-OP``);
+* reply reads (``r["k"]`` / ``r.get("k")`` on a name bound from a
+  call that was handed a request dict) must name a key the server can
+  actually send for that op — the op's reply schema, the structured
+  error reply, or a router augmentation (``REPLY-DRIFT``);
+* every encoder file the audit is pinned to must exist
+  (``MISSING-ENCODER`` — deleting the load generator doesn't silently
+  shrink the audit).
+
+Best-effort static reasoning with the usual no-false-positive bias:
+a request whose op is not a string literal, or a reply bound from a
+call whose request cannot be traced, is counted (``dynamic``) but
+never guessed at. Exit 0 = every encoder speaks the server's
+protocol; exit 1 = any finding (CI fails on drift).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+#: The single source of truth for the protocol.
+SERVER_FILE = "pumiumtally_tpu/service/server.py"
+
+#: Every file that encodes wire requests / decodes wire replies.
+#: server.py audits itself: the SessionRouter originates ping
+#: requests over the same protocol it forwards.
+ENCODER_FILES = (
+    "pumiumtally_tpu/service/server.py",
+    "tools/loadgen.py",
+    "tests/_service_driver.py",
+    "examples/multi_client_service.py",
+)
+
+
+def repo_root() -> str:
+    """The repository root (the dir holding ``pumiumtally_tpu/``)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dict_keys(node: ast.Dict) -> Optional[Set[str]]:
+    """Literal string keys of a dict display; None when any key is
+    dynamic (then the dict cannot be schema-checked)."""
+    keys: Set[str] = set()
+    for k in node.keys:
+        s = None if k is None else _const_str(k)
+        if s is None:
+            return None
+        keys.add(s)
+    return keys
+
+
+def _dict_op(node: ast.Dict) -> Tuple[bool, Optional[str]]:
+    """(is_request, op): is_request when the dict has an ``"op"``
+    key; op is its literal value or None when dynamic."""
+    for k, v in zip(node.keys, node.values):
+        if k is not None and _const_str(k) == "op":
+            return True, _const_str(v)
+    return False, None
+
+
+# ---------------------------------------------------------------------------
+# Server-side schema extraction
+
+
+@dataclass
+class _Schema:
+    ops: Set[str] = field(default_factory=set)
+    required: Dict[str, Set[str]] = field(default_factory=dict)
+    replies: Dict[str, Set[str]] = field(default_factory=dict)
+    error_keys: Set[str] = field(default_factory=set)
+
+
+def _test_ops(test: ast.expr) -> Optional[List[str]]:
+    """ops named by ``op == "x"`` / ``op in ("a", "b")``, else None."""
+    if not (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and test.left.id == "op"
+        and len(test.ops) == 1
+    ):
+        return None
+    cmp = test.comparators[0]
+    if isinstance(test.ops[0], ast.Eq):
+        s = _const_str(cmp)
+        return [s] if s is not None else None
+    if isinstance(test.ops[0], ast.In) and isinstance(
+        cmp, (ast.Tuple, ast.List)
+    ):
+        vals = [_const_str(e) for e in cmp.elts]
+        if all(v is not None for v in vals):
+            return list(vals)
+    return None
+
+
+def _allowlist_ops(test: ast.expr) -> Optional[List[str]]:
+    """ops named by the ``op not in (...)`` guard, else None."""
+    if (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and test.left.id == "op"
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.NotIn)
+        and isinstance(test.comparators[0], (ast.Tuple, ast.List))
+    ):
+        vals = [_const_str(e) for e in test.comparators[0].elts]
+        if all(v is not None for v in vals):
+            return list(vals)
+    return None
+
+
+def _return_key_union(fn: ast.AST) -> Set[str]:
+    """Union of literal dict keys over every ``return {...}`` in a
+    helper (``_ack``/``_sync``)."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Dict
+        ):
+            keys.update(_dict_keys(node.value) or set())
+    return keys
+
+
+def _extract_dispatch(
+    fn: ast.FunctionDef, methods: Dict[str, ast.FunctionDef]
+) -> _Schema:
+    """Walk ``_dispatch``'s op-branch chain: required ``req[...]``
+    fields and reply dict keys per op; fields/replies outside any op
+    branch are shared (the post-allowlist ``req["session"]`` and the
+    fall-through close reply)."""
+    schema = _Schema()
+    allow: List[str] = []
+    branch_ops: Set[str] = set()
+    shared_required: Set[str] = set()
+    shared_replies: List[Set[str]] = []
+    var_keys: Dict[object, Dict[str, Set[str]]] = {}
+
+    def reply_of(value: ast.expr, label) -> Optional[Set[str]]:
+        if isinstance(value, ast.Dict):
+            return _dict_keys(value)
+        if isinstance(value, ast.Name):
+            for lab in (label, None):
+                got = var_keys.get(lab, {}).get(value.id)
+                if got is not None:
+                    return got
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and isinstance(value.func.value, ast.Name)
+            and value.func.value.id == "self"
+            and value.func.attr in methods
+        ):
+            return _return_key_union(methods[value.func.attr])
+        return None
+
+    def record(stmt: ast.stmt, label) -> None:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "req"
+                and isinstance(node.ctx, ast.Load)
+            ):
+                key = _const_str(node.slice)
+                if key is not None:
+                    if label is None:
+                        shared_required.add(key)
+                    else:
+                        for op in label:
+                            schema.required.setdefault(
+                                op, set()
+                            ).add(key)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(
+                    node.value, ast.Dict
+                ):
+                    keys = _dict_keys(node.value)
+                    if keys is not None:
+                        var_keys.setdefault(label, {})[t.id] = keys
+                elif (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                ):
+                    key = _const_str(t.slice)
+                    if key is not None:
+                        for lab in (label, None):
+                            got = var_keys.get(lab, {}).get(t.value.id)
+                            if got is not None:
+                                got.add(key)
+                                break
+            elif isinstance(node, ast.Return) and node.value is not None:
+                keys = reply_of(node.value, label)
+                if keys is None:
+                    continue
+                if label is None:
+                    shared_replies.append(set(keys))
+                else:
+                    for op in label:
+                        schema.replies.setdefault(
+                            op, set()
+                        ).update(keys)
+
+    def visit(stmts: List[ast.stmt], label) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                a_ops = _allowlist_ops(stmt.test)
+                t_ops = _test_ops(stmt.test)
+                if a_ops is not None:
+                    allow.extend(a_ops)
+                    visit(stmt.orelse, label)
+                elif t_ops is not None:
+                    branch_ops.update(t_ops)
+                    visit(stmt.body, tuple(t_ops))
+                    visit(stmt.orelse, label)
+                else:
+                    record(stmt, label)
+            else:
+                record(stmt, label)
+
+    visit(list(fn.body), None)
+    schema.ops = branch_ops | set(allow)
+    for op in allow:
+        schema.required.setdefault(op, set()).update(shared_required)
+        # The fall-through reply belongs to allowlist ops with no
+        # branch of their own (today: "close").
+        if op not in schema.replies:
+            for keys in shared_replies:
+                schema.replies.setdefault(op, set()).update(keys)
+    return schema
+
+
+def _extract_router(fn: ast.FunctionDef, schema: _Schema) -> None:
+    """Fold ``SessionRouter._route`` into the schema: its own reply
+    shapes (fleet ping) and reply augmentations (``dict(reply,
+    session=..., home=...)`` on open) widen what a client may read."""
+
+    def visit(stmts: List[ast.stmt], label) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                t_ops = _test_ops(stmt.test)
+                if t_ops is not None:
+                    visit(stmt.body, tuple(t_ops))
+                    visit(stmt.orelse, label)
+                    continue
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Dict)
+                    and label is not None
+                ):
+                    keys = _dict_keys(node.value)
+                    if keys:
+                        for op in label:
+                            schema.replies.setdefault(
+                                op, set()
+                            ).update(keys)
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "dict"
+                    and node.args
+                    and label is not None
+                ):
+                    extra = {
+                        kw.arg for kw in node.keywords
+                        if kw.arg is not None
+                    }
+                    if extra:
+                        for op in label:
+                            schema.replies.setdefault(
+                                op, set()
+                            ).update(extra)
+
+    visit(list(fn.body), None)
+
+
+def _extract_error_keys(tree: ast.Module) -> Set[str]:
+    """Keys of the structured error reply: any dict literal carrying
+    both "ok" and "error" (the _serve_conn except arm)."""
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            dk = _dict_keys(node)
+            if dk and "ok" in dk and "error" in dk:
+                keys.update(dk)
+    return keys
+
+
+def _extract_schema(server_path: str) -> Optional[_Schema]:
+    try:
+        with open(server_path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=server_path)
+    except (OSError, SyntaxError):
+        return None
+    dispatch = None
+    route = None
+    methods: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    if item.name == "_dispatch":
+                        dispatch = item
+                        methods = {
+                            m.name: m for m in node.body
+                            if isinstance(m, ast.FunctionDef)
+                        }
+                    elif item.name == "_route":
+                        route = item
+    if dispatch is None:
+        return None
+    schema = _extract_dispatch(dispatch, methods)
+    if route is not None:
+        _extract_router(route, schema)
+    schema.error_keys = _extract_error_keys(tree)
+    return schema
+
+
+# ---------------------------------------------------------------------------
+# Encoder-side audit
+
+
+def _scopes(tree: ast.Module):
+    """(name, stmts) per lexical scope, nested defs excluded from the
+    enclosing scope so each request/reply name binds once."""
+    defs = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.append(node)
+    module_stmts = [
+        s for s in tree.body
+        if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+    ]
+    yield "<module>", module_stmts
+    for d in defs:
+        yield d.name, list(d.body)
+
+
+def _scope_nodes(stmts: List[ast.stmt]):
+    """Every node under ``stmts`` except inside nested defs, in
+    source order."""
+    out = []
+    stack = list(reversed(stmts))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            stack.append(child)
+    out.sort(key=lambda n: (
+        getattr(n, "lineno", 0), getattr(n, "col_offset", 0)
+    ))
+    return out
+
+
+@dataclass
+class _Request:
+    op: Optional[str]
+    keys: Set[str]
+    line: int
+
+
+def _request_arg_op(
+    call: ast.Call, env_req: Dict[str, _Request]
+) -> Optional[str]:
+    """The op of the request dict handed to ``call``, when any
+    argument is an inline request dict or a tracked request name."""
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, ast.Dict):
+            is_req, op = _dict_op(a)
+            if is_req:
+                return op
+        if isinstance(a, ast.Name) and a.id in env_req:
+            return env_req[a.id].op
+    return None
+
+
+def _audit_encoder(
+    path: str, rel: str, schema: _Schema
+) -> Tuple[dict, List[dict]]:
+    findings: List[dict] = []
+    stats = {"path": rel, "requests": 0, "reply_reads": 0,
+             "dynamic": 0}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except OSError:
+        findings.append({
+            "kind": "MISSING-ENCODER",
+            "path": rel,
+            "line": 0,
+            "message": (
+                f"pinned encoder file {rel} is missing: the wire "
+                "audit set silently shrank — restore the file or "
+                "update ENCODER_FILES"
+            ),
+        })
+        return stats, findings
+    except SyntaxError as e:
+        findings.append({
+            "kind": "MISSING-ENCODER",
+            "path": rel,
+            "line": int(e.lineno or 0),
+            "message": f"encoder file {rel} failed to parse: {e.msg}",
+        })
+        return stats, findings
+
+    def check_read(op: Optional[str], key: str, line: int) -> None:
+        stats["reply_reads"] += 1
+        if op is None or op not in schema.replies:
+            return
+        allowed = (
+            schema.replies[op] | schema.error_keys | {"ok"}
+        )
+        if key not in allowed:
+            findings.append({
+                "kind": "REPLY-DRIFT",
+                "path": rel,
+                "line": line,
+                "message": (
+                    f"reads reply key {key!r} of op {op!r}, which "
+                    f"the server never sends (reply schema: "
+                    f"{sorted(allowed)})"
+                ),
+            })
+
+    for _scope_name, stmts in _scopes(tree):
+        env_req: Dict[str, _Request] = {}
+        env_reply: Dict[str, Optional[str]] = {}
+        requests: List[_Request] = []
+        seen_dicts: Set[int] = set()
+        for node in _scope_nodes(stmts):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(
+                    node.value, ast.Dict
+                ):
+                    is_req, op = _dict_op(node.value)
+                    if is_req:
+                        r = _Request(
+                            op,
+                            _dict_keys(node.value) or set(),
+                            node.value.lineno,
+                        )
+                        env_req[t.id] = r
+                        requests.append(r)
+                        seen_dicts.add(id(node.value))
+                        env_reply.pop(t.id, None)
+                        continue
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in env_req
+                ):
+                    key = _const_str(t.slice)
+                    if key is not None:
+                        env_req[t.value.id].keys.add(key)
+                if isinstance(t, ast.Name) and isinstance(
+                    node.value, ast.Call
+                ):
+                    op = _request_arg_op(node.value, env_req)
+                    if op is not None or any(
+                        isinstance(a, ast.Dict) and _dict_op(a)[0]
+                        for a in node.value.args
+                    ):
+                        env_reply[t.id] = op
+                        env_req.pop(t.id, None)
+            elif isinstance(node, ast.Dict):
+                if id(node) in seen_dicts:
+                    continue
+                is_req, op = _dict_op(node)
+                if is_req:
+                    requests.append(_Request(
+                        op, _dict_keys(node) or set(), node.lineno
+                    ))
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in env_reply
+                and isinstance(node.ctx, ast.Load)
+            ):
+                key = _const_str(node.slice)
+                if key is not None:
+                    check_read(
+                        env_reply[node.value.id], key, node.lineno
+                    )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+            ):
+                key = _const_str(node.args[0])
+                if key is None:
+                    continue
+                base = node.func.value
+                if (
+                    isinstance(base, ast.Name)
+                    and base.id in env_reply
+                ):
+                    check_read(
+                        env_reply[base.id], key, node.lineno
+                    )
+                elif isinstance(base, ast.Call):
+                    op = _request_arg_op(base, env_req)
+                    if op is not None:
+                        check_read(op, key, node.lineno)
+        for r in requests:
+            stats["requests"] += 1
+            if r.op is None:
+                stats["dynamic"] += 1
+                continue
+            if r.op not in schema.ops:
+                findings.append({
+                    "kind": "UNKNOWN-OP",
+                    "path": rel,
+                    "line": r.line,
+                    "message": (
+                        f"encodes unknown op {r.op!r} (server "
+                        f"allowlist: {sorted(schema.ops)})"
+                    ),
+                })
+                continue
+            missing = sorted(
+                schema.required.get(r.op, set()) - r.keys
+            )
+            if missing:
+                findings.append({
+                    "kind": "MISSING-FIELD",
+                    "path": rel,
+                    "line": r.line,
+                    "message": (
+                        f"op {r.op!r} request is missing required "
+                        f"field(s) {missing} — the server raises "
+                        "KeyError (error reply) on every send"
+                    ),
+                })
+    return stats, findings
+
+
+# ---------------------------------------------------------------------------
+# Entry point + renderers
+
+
+def audit_wire(root: Optional[str] = None) -> Tuple[dict, int]:
+    """Cross-check every pinned encoder against the AST-extracted
+    ``SocketFrontend``/``SessionRouter`` wire schema. Returns
+    (report, exit_code): 0 = every encoder speaks the server's
+    protocol, 1 = any finding."""
+    root = root or repo_root()
+    server_path = os.path.join(root, SERVER_FILE)
+    schema = _extract_schema(server_path)
+    findings: List[dict] = []
+    encoders: List[dict] = []
+    if schema is None or not schema.ops:
+        findings.append({
+            "kind": "NO-SERVER",
+            "path": SERVER_FILE,
+            "line": 0,
+            "message": (
+                f"could not extract the wire schema from "
+                f"{SERVER_FILE} (missing file or no _dispatch op "
+                "chain) — the protocol has no authority to audit "
+                "against"
+            ),
+        })
+    else:
+        for rel in ENCODER_FILES:
+            stats, f = _audit_encoder(
+                os.path.join(root, rel), rel, schema
+            )
+            encoders.append(stats)
+            findings.extend(f)
+    findings.sort(
+        key=lambda f: (f["path"], f["line"], f["kind"])
+    )
+    report = {
+        "server": {
+            "path": SERVER_FILE,
+            "ops": sorted(schema.ops) if schema else [],
+            "required": {
+                op: sorted(v)
+                for op, v in (schema.required if schema else {}).items()
+            },
+            "replies": {
+                op: sorted(v)
+                for op, v in (schema.replies if schema else {}).items()
+            },
+            "error_keys": sorted(
+                schema.error_keys if schema else []
+            ),
+        },
+        "encoders": encoders,
+        "findings": findings,
+    }
+    return report, (1 if findings else 0)
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    srv = report["server"]
+    lines.append(
+        f"wire protocol ({srv['path']}): {len(srv['ops'])} op(s)"
+    )
+    lines.append("  " + ", ".join(srv["ops"]))
+    lines.append(
+        f"  error reply keys: {', '.join(srv['error_keys'])}"
+    )
+    lines.append("")
+    grid = [["encoder", "requests", "reply reads", "dynamic"]]
+    for enc in report["encoders"]:
+        grid.append([
+            enc["path"],
+            str(enc["requests"]),
+            str(enc["reply_reads"]),
+            str(enc["dynamic"]),
+        ])
+    widths = [max(len(r[i]) for r in grid) for i in range(len(grid[0]))]
+    for i, r in enumerate(grid):
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+        if i == 0:
+            lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    lines.append("")
+    if report["findings"]:
+        for f in report["findings"]:
+            lines.append(
+                f"{f['kind']}: {f['path']}:{f['line']} — "
+                f"{f['message']}"
+            )
+    else:
+        lines.append("every encoder speaks the server's protocol")
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=True)

@@ -54,6 +54,17 @@ def default_float_dtype() -> Any:
 #   shared jit cache across facades; 2 engines x 3 phase programs).
 # - "sharded_*" (parallel/sharded.py): measured max 2 (device-count +
 #   chunk-shape sweeps).
+#
+# This table is machine-audited (round 20): `python -m
+# pumiumtally_tpu.analysis --trace-keys` cross-checks it against every
+# register_entry_point site and fails CI on a dead budget (JL402) or
+# an unbudgeted entry point (JL403). The round-20 audit found the
+# table exactly bijective — 19 budgets, 19 registered entry points,
+# nothing pruned, nothing added — so every key below is live.
+# Recalibrate with tools/retrace_calibrate.py over a
+# PUMIUMTALLY_RETRACE_RECORD run instead of hand-editing. Keep the
+# dict a LITERAL: the auditor reads it with ast.literal_eval (no jax
+# import), so computed values would blind it.
 RETRACE_BUDGETS: dict = {
     "walk": 3,
     "walk_continue": 3,

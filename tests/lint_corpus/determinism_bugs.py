@@ -1,0 +1,42 @@
+"""Seeded determinism bugs (JL501-JL503). Parsed by jaxlint in
+tests/test_jaxlint.py, never executed. Line pins live in that test —
+keep the two in sync when editing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def broadcast_order(active_sessions, replies):
+    # JL501 target: set iteration order varies run-to-run, and
+    # .append is an order-sensitive sink (wire reply order).
+    for sid in set(active_sessions):
+        replies.append(sid)
+    return replies
+
+
+def checkpoint_rows(keys):
+    # JL501 target: list() materializes the set in hash order.
+    return list({k for k in keys})
+
+
+def commit_quicksort(acc, bins, w):
+    # JL502 target: numpy default quicksort reorders equal bins, and
+    # this function commits through a segmented .at[].add.
+    order = np.argsort(bins)
+    return acc.at[bins[order]].add(w[order])
+
+
+def commit_forced_unstable(acc, seg, w):
+    # JL502 target: jnp.argsort is stable by default, but this site
+    # explicitly opts OUT on a segment_sum path.
+    order = jnp.argsort(seg, stable=False)
+    return acc + jax.ops.segment_sum(w[order], seg[order], 8)
+
+
+def host_total(flux):
+    # JL503 target: builtin sum() left-folds the fetched values in
+    # host order — a different rounding association than the device
+    # reduction the parity gates pin.
+    return sum(jax.device_get(flux).tolist())

@@ -1,0 +1,45 @@
+"""Seeded trace-cardinality bugs (JL401, JL404). Parsed by jaxlint in
+tests/test_jaxlint.py, never executed. Line pins live in that test —
+keep the two in sync when editing.
+
+The registrations reuse REAL budget names ("walk" = 3, "locate" = 2 in
+config.RETRACE_BUDGETS) so the cardinality prover folds the seeded
+knob domains against the live table; JL402/JL403 are audit-side
+(--trace-keys over a doctored tree) and have no corpus lines here.
+"""
+
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _step(state, mode, order):
+    return state
+
+
+def _locate_impl(state, n):
+    return state
+
+
+# JL401 target: the two static knobs below enumerate 3 x 4 = 12
+# possible cache keys, but RETRACE_BUDGETS["walk"] allows 3.
+_walk = register_entry_point(
+    "walk", jax.jit(_step, static_argnames=("mode", "order"))
+)
+
+_locate = register_entry_point(
+    "locate", jax.jit(_locate_impl, static_argnames=("n",))
+)
+
+
+def drive(state):
+    for mode in ("fast", "exact", "paranoid"):
+        for order in (1, 2, 3, 4):
+            state = _walk(state, mode=mode, order=order)
+    return state
+
+
+def serve(batch, state):
+    # JL404 target: a per-call batch size reaching the static key
+    # position `n` — one compile per distinct len(batch).
+    return _locate(state, n=len(batch))

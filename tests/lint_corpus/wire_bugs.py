@@ -1,0 +1,26 @@
+"""Seeded wire-protocol drift for the --wire auditor. Never executed;
+tests/test_jaxlint.py installs this file AS tools/loadgen.py inside a
+doctored tree (next to the real service/server.py) and pins the exact
+(kind, line) findings audit_wire() must report. Unlike the other
+corpus files this is encoder drift, not a lint rule: the audit, not
+the per-file analyzer, is what must catch it.
+"""
+
+
+def _rpc(f, req):
+    return {}
+
+
+def run(f, sid):
+    # UNKNOWN-OP target: "fluxx" is not on the server allowlist.
+    r = _rpc(f, {"op": "fluxx", "session": sid})
+    # MISSING-FIELD target: source requires "positions".
+    _rpc(f, {"op": "source", "session": sid})
+    # MISSING-FIELD target: move requires "dests" (augmented keys
+    # count — "wait" rides along but does not satisfy it).
+    req = {"op": "move", "session": sid}
+    req["wait"] = False
+    _rpc(f, req)
+    r2 = _rpc(f, {"op": "flux", "session": sid})
+    # REPLY-DRIFT target: the flux reply carries "flux", not "fluxes".
+    return r.get("ok"), r2["fluxes"]

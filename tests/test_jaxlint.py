@@ -441,6 +441,8 @@ def test_rule_registry_complete():
         "JL101", "JL102", "JL103", "JL104",
         "JL201", "JL202", "JL203", "JL204",
         "JL301", "JL302", "JL303",
+        "JL401", "JL402", "JL403", "JL404",
+        "JL501", "JL502", "JL503",
     ]
     # Registration order == id order (the --list-rules contract).
     assert list(RULES) == sorted(RULES)
@@ -1449,7 +1451,7 @@ def test_corpus_outside_acceptance_lint_set():
 # ---------------------------------------------------------------------------
 
 def test_analysis_package_registered_and_pragma_free():
-    """The four-pass suite must actually be wired: the pass modules
+    """The six-pass suite must actually be wired: the pass modules
     exist, Analyzer.run() dispatches them, and the analyzer's own code
     holds the strongest form of the clean contract (zero violations,
     zero pragmas) — a linter that needs to suppress itself has lost
@@ -1463,10 +1465,12 @@ def test_analysis_package_registered_and_pragma_free():
     names = {os.path.basename(f) for f in files}
     assert {"__init__.py", "__main__.py", "core.py", "rules.py",
             "collective.py", "pallas.py", "concurrency.py",
-            "contracts.py"} <= names
+            "contracts.py", "tracekeys.py", "determinism.py",
+            "wire.py"} <= names
     with open(os.path.join(ana_dir, "core.py")) as fh:
         core_src = fh.read()
-    for mod in ("collective", "pallas", "concurrency"):
+    for mod in ("collective", "pallas", "concurrency", "tracekeys",
+                "determinism"):
         assert f"{mod}.check" in core_src, (
             f"Analyzer.run() must dispatch the {mod} pass"
         )
@@ -1495,3 +1499,597 @@ def test_lint_all_runs_contracts_stage():
     assert "--contracts" in src
     # Pin drift is a FAILURE with remediation, not a warning.
     assert "pip install ruff==" in src
+    # The two round-20 audits are failing stages beside contracts.
+    assert "--trace-keys" in src
+    assert "--wire" in src
+
+
+# ---------------------------------------------------------------------------
+# JL401/JL404 — trace-key cardinality. The snippets register REAL
+# budget names ("walk" = 3, "locate" = 2) so the prover folds the
+# seeded domains against the live config.RETRACE_BUDGETS table.
+# ---------------------------------------------------------------------------
+
+def test_jl401_enumerable_domain_over_budget():
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _step(state, mode):
+    return state
+
+
+_walk = register_entry_point(
+    "walk", jax.jit(_step, static_argnames=("mode",))
+)
+
+
+def drive(state):
+    for mode in ("fast", "exact", "paranoid", "audit"):
+        state = _walk(state, mode=mode)
+    return state
+"""
+    assert ids(lint_source(src)) == [("JL401", 10)]
+
+
+def test_jl401_within_budget_is_clean():
+    # Three enumerable keys against a budget of three: tight but legal.
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _step(state, mode):
+    return state
+
+
+_walk = register_entry_point(
+    "walk", jax.jit(_step, static_argnames=("mode",))
+)
+
+
+def drive(state):
+    for mode in ("fast", "exact", "paranoid"):
+        state = _walk(state, mode=mode)
+    return state
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl401_runtime_knob_never_guessed():
+    # A knob whose values the prover cannot enumerate is counted as
+    # dynamic and skipped — no-false-positive bias, not a guess.
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _step(state, mode):
+    return state
+
+
+_walk = register_entry_point(
+    "walk", jax.jit(_step, static_argnames=("mode",))
+)
+
+
+def drive(state, mode):
+    return _walk(state, mode=mode)
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl404_len_reaches_static_key():
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _locate_impl(state, n):
+    return state
+
+
+_locate = register_entry_point(
+    "locate", jax.jit(_locate_impl, static_argnames=("n",))
+)
+
+
+def serve(batch, state):
+    return _locate(state, n=len(batch))
+"""
+    assert ids(lint_source(src)) == [("JL404", 16)]
+
+
+def test_jl404_shape_reaches_static_key():
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+
+def _locate_impl(state, n):
+    return state
+
+
+_locate = register_entry_point(
+    "locate", jax.jit(_locate_impl, static_argnames=("n",))
+)
+
+
+def serve(state):
+    return _locate(state, n=state.shape[0])
+"""
+    assert ids(lint_source(src)) == [("JL404", 16)]
+
+
+def test_jl404_module_constant_is_clean():
+    # A module-level constant reaching the static slot is ONE key.
+    src = """\
+import jax
+
+from pumiumtally_tpu.utils.profiling import register_entry_point
+
+CHUNK = 4096
+
+
+def _locate_impl(state, n):
+    return state
+
+
+_locate = register_entry_point(
+    "locate", jax.jit(_locate_impl, static_argnames=("n",))
+)
+
+
+def serve(state):
+    return _locate(state, n=CHUNK)
+"""
+    assert ids(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# JL501–JL503 — determinism
+# ---------------------------------------------------------------------------
+
+def test_jl501_set_iteration_into_sink():
+    src = """\
+def broadcast(sessions, out):
+    for sid in set(sessions):
+        out.append(sid)
+    return out
+"""
+    assert ids(lint_source(src)) == [("JL501", 2)]
+
+
+def test_jl501_list_of_set_materialization():
+    src = """\
+def rows(keys):
+    return list({k for k in keys})
+"""
+    assert ids(lint_source(src)) == [("JL501", 2)]
+
+
+def test_jl501_sorted_set_is_clean():
+    src = """\
+def broadcast(sessions, out):
+    for sid in sorted(set(sessions)):
+        out.append(sid)
+    return out
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl501_membership_only_set_is_clean():
+    src = """\
+def dedupe(items):
+    seen = set()
+    out = []
+    for x in items:
+        if x in seen:
+            continue
+        seen.add(x)
+        out.append(x)
+    return out
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl502_numpy_default_sort_in_commit():
+    src = """\
+import numpy as np
+
+
+def commit(acc, bins, w):
+    order = np.argsort(bins)
+    return acc.at[bins[order]].add(w[order])
+"""
+    assert ids(lint_source(src)) == [("JL502", 5)]
+
+
+def test_jl502_stable_kind_is_clean():
+    src = """\
+import numpy as np
+
+
+def commit(acc, bins, w):
+    order = np.argsort(bins, kind="stable")
+    return acc.at[bins[order]].add(w[order])
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl502_no_commit_path_is_clean():
+    src = """\
+import numpy as np
+
+
+def rank(bins):
+    return np.argsort(bins)
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl502_jnp_default_is_stable_and_clean():
+    src = """\
+import jax.numpy as jnp
+
+
+def commit(acc, seg, w):
+    order = jnp.argsort(seg)
+    return acc.at[seg[order]].add(w[order])
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl503_host_sum_over_fetch():
+    src = """\
+import jax
+
+
+def total(flux):
+    return sum(jax.device_get(flux).tolist())
+"""
+    assert ids(lint_source(src)) == [("JL503", 5)]
+
+
+def test_jl503_plain_python_sum_is_clean():
+    src = """\
+def total(weights):
+    return sum(weights)
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_jl503_device_reduction_is_clean():
+    src = """\
+import jax.numpy as jnp
+
+
+def total(flux):
+    return float(jnp.sum(flux))
+"""
+    assert ids(lint_source(src)) == []
+
+
+def test_seeded_tracekeys_corpus():
+    assert lint_corpus_file("tracekeys_bugs.py") == [
+        ("JL401", 26), ("JL404", 45),
+    ]
+
+
+def test_seeded_determinism_corpus():
+    assert lint_corpus_file("determinism_bugs.py") == [
+        ("JL501", 14), ("JL501", 21), ("JL502", 27), ("JL502", 34),
+        ("JL503", 42),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# --trace-keys: the budget/entry-point audit (JL402/JL403)
+# ---------------------------------------------------------------------------
+
+JAXLINT = os.path.join(REPO, "tools", "jaxlint.py")
+
+
+def test_cli_trace_keys_table_clean_at_head():
+    proc = subprocess.run(
+        [sys.executable, JAXLINT, "--trace-keys"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "walk_fused" in proc.stdout
+    assert "every budget live, every entry point budgeted" in (
+        proc.stdout
+    )
+
+
+def test_cli_trace_keys_json_bijective():
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, JAXLINT, "--trace-keys", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    report = json.loads(proc.stdout)
+    assert report["findings"] == []
+    # The invariant the audit exists to hold: registered entry points
+    # and (non-exempt) budgets are the SAME set, and every jit wrapper
+    # resolved statically.
+    names = {r["name"] for r in report["entry_points"]}
+    budget_names = {
+        k for k in report["budgets"] if k != "total"
+    }
+    assert names == budget_names
+    assert all(r["jit_resolved"] for r in report["entry_points"])
+    walk = [r for r in report["entry_points"] if r["name"] == "walk"]
+    assert walk and walk[0]["budget"] == report["budgets"]["walk"]
+
+
+def test_trace_keys_detect_dead_and_unbudgeted(tmp_path):
+    """A pruned registration (JL402) and an unbudgeted one (JL403)
+    must fail the audit — proved against a doctored tree."""
+    from pumiumtally_tpu.analysis.tracekeys import audit_trace_keys
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "config.py").write_text(
+        'RETRACE_BUDGETS: dict = {"alive": 2, "dead": 3}\n'
+    )
+    (root / "mod.py").write_text(
+        "import jax\n"
+        "\n"
+        "from pumiumtally_tpu.utils.profiling import (\n"
+        "    register_entry_point,\n"
+        ")\n"
+        "\n"
+        "\n"
+        "def _f(state, k):\n"
+        "    return state\n"
+        "\n"
+        "\n"
+        'alive = register_entry_point("alive", jax.jit(_f))\n'
+        'orphan = register_entry_point("orphan", jax.jit(_f))\n'
+    )
+    report, code = audit_trace_keys(str(root))
+    assert code == 1
+    found = {(f["rule"], f["name"]) for f in report["findings"]}
+    assert found == {("JL402", "dead"), ("JL403", "orphan")}
+
+
+def test_trace_keys_clean_tree_and_total_exempt(tmp_path):
+    from pumiumtally_tpu.analysis.tracekeys import audit_trace_keys
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    # "total" bounds whole-test compiles, not an entry point: never
+    # flagged as a dead budget.
+    (root / "config.py").write_text(
+        'RETRACE_BUDGETS: dict = {"alive": 2, "total": 40}\n'
+    )
+    (root / "mod.py").write_text(
+        "import jax\n"
+        "\n"
+        "from pumiumtally_tpu.utils.profiling import (\n"
+        "    register_entry_point,\n"
+        ")\n"
+        "\n"
+        "\n"
+        "def _f(state):\n"
+        "    return state\n"
+        "\n"
+        "\n"
+        'alive = register_entry_point("alive", jax.jit(_f))\n'
+    )
+    report, code = audit_trace_keys(str(root))
+    assert code == 0, report["findings"]
+    assert report["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# --wire: the wire-protocol auditor
+# ---------------------------------------------------------------------------
+
+def test_cli_wire_clean_at_head():
+    proc = subprocess.run(
+        [sys.executable, JAXLINT, "--wire"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tools/loadgen.py" in proc.stdout
+    assert "every encoder speaks the server's protocol" in proc.stdout
+
+
+def test_cli_wire_json_schema():
+    import json
+
+    from pumiumtally_tpu.analysis.wire import ENCODER_FILES
+
+    proc = subprocess.run(
+        [sys.executable, JAXLINT, "--wire", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    report = json.loads(proc.stdout)
+    assert report["findings"] == []
+    srv = report["server"]
+    assert {"open", "source", "move", "flux", "sync", "close"} <= (
+        set(srv["ops"])
+    )
+    assert srv["required"]["move"] == ["dests", "session"]
+    assert srv["required"]["source"] == ["positions", "session"]
+    assert "flux" in srv["replies"]["flux"]
+    assert {"session", "home"} <= set(srv["replies"]["open"])
+    assert "error" in srv["error_keys"]
+    assert [e["path"] for e in report["encoders"]] == (
+        list(ENCODER_FILES)
+    )
+    loadgen = report["encoders"][1]
+    assert loadgen["requests"] > 0 or loadgen["reply_reads"] > 0
+
+
+def test_wire_detects_doctored_encoder(tmp_path):
+    """wire_bugs.py installed AS the load generator must produce the
+    exact pinned drift findings against the real server schema."""
+    import shutil as _sh
+
+    from pumiumtally_tpu.analysis.wire import ENCODER_FILES, audit_wire
+
+    root = tmp_path / "tree"
+    for rel in ENCODER_FILES:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        _sh.copy(os.path.join(REPO, rel), dst)
+    _sh.copy(
+        os.path.join(CORPUS, "wire_bugs.py"),
+        root / "tools" / "loadgen.py",
+    )
+    report, code = audit_wire(str(root))
+    assert code == 1
+    assert [(f["kind"], f["line"]) for f in report["findings"]] == [
+        ("UNKNOWN-OP", 16),
+        ("MISSING-FIELD", 18),
+        ("MISSING-FIELD", 21),
+        ("REPLY-DRIFT", 26),
+    ]
+    assert all(
+        f["path"] == "tools/loadgen.py" for f in report["findings"]
+    )
+
+
+def test_wire_missing_encoder_fails(tmp_path):
+    """Deleting a pinned encoder must FAIL, not shrink the audit."""
+    import shutil as _sh
+
+    from pumiumtally_tpu.analysis.wire import ENCODER_FILES, audit_wire
+
+    root = tmp_path / "tree"
+    dropped = "examples/multi_client_service.py"
+    for rel in ENCODER_FILES:
+        if rel == dropped:
+            continue
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        _sh.copy(os.path.join(REPO, rel), dst)
+    report, code = audit_wire(str(root))
+    assert code == 1
+    assert [(f["kind"], f["path"]) for f in report["findings"]] == [
+        ("MISSING-ENCODER", dropped),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Deterministic walk: __pycache__/.tmp-* pruned, output byte-stable
+# ---------------------------------------------------------------------------
+
+def test_lint_walk_pruned_and_sorted(tmp_path):
+    from pumiumtally_tpu.analysis.core import iter_python_files
+
+    pkg = tmp_path / "pkg"
+    (pkg / "__pycache__").mkdir(parents=True)
+    (pkg / ".tmp-scratch").mkdir()
+    (pkg / "b.py").write_text("x = 1\n")
+    (pkg / "a.py").write_text("y = 2\n")
+    (pkg / "__pycache__" / "c.py").write_text("z = 3\n")
+    (pkg / ".tmp-scratch" / "d.py").write_text("z = 4\n")
+    (pkg / ".tmp-e.py").write_text("z = 5\n")
+    (pkg / "notes.txt").write_text("not python\n")
+    files = iter_python_files([str(tmp_path)])
+    assert files == [str(pkg / "a.py"), str(pkg / "b.py")]
+    # Deterministic: a second walk is identical.
+    assert files == iter_python_files([str(tmp_path)])
+
+
+def test_cli_json_byte_stable_and_cache_blind(tmp_path):
+    """--format json over the same tree twice is byte-identical, and
+    a violation hidden in __pycache__ neither fires nor perturbs the
+    output."""
+    pkg = tmp_path / "pkg"
+    (pkg / "__pycache__").mkdir(parents=True)
+    (pkg / "clean.py").write_text("x = 1\n")
+    (pkg / "z_bug.py").write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    )
+    (pkg / "__pycache__" / "stale.py").write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    return x.item()\n"
+    )
+    runs = [
+        subprocess.run(
+            [sys.executable, JAXLINT, "--format", "json",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        for _ in range(2)
+    ]
+    assert [p.returncode for p in runs] == [1, 1]
+    assert runs[0].stdout == runs[1].stdout
+    assert "z_bug.py" in runs[0].stdout
+    assert "__pycache__" not in runs[0].stdout
+
+
+def test_ci_runs_trace_keys_and_wire_audits():
+    with open(os.path.join(
+            REPO, ".github", "workflows", "static-analysis.yml")) as fh:
+        wf = fh.read()
+    jaxlint_lines = [ln for ln in wf.splitlines()
+                     if "tools/jaxlint.py" in ln]
+    assert any("--trace-keys" in ln for ln in jaxlint_lines)
+    assert any("--wire" in ln for ln in jaxlint_lines)
+
+
+# ---------------------------------------------------------------------------
+# tools/retrace_calibrate.py — record-vs-budget diff
+# ---------------------------------------------------------------------------
+
+CALIBRATE = os.path.join(REPO, "tools", "retrace_calibrate.py")
+
+
+def _run_calibrate(*argv):
+    return subprocess.run(
+        [sys.executable, CALIBRATE, *argv],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_retrace_calibrate_clean_record(tmp_path):
+    rec = tmp_path / "rt.ndjson"
+    rec.write_text(
+        '{"test": "t::a", "total": 3,'
+        ' "compiles": {"walk": 1, "locate": 2}}\n'
+    )
+    proc = _run_calibrate(str(rec))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "every observed entry point within budget" in proc.stdout
+    assert "OVER" not in proc.stdout
+
+
+def test_retrace_calibrate_flags_over_and_unbudgeted(tmp_path):
+    rec = tmp_path / "rt.ndjson"
+    rec.write_text(
+        '{"test": "t::a", "total": 3,'
+        ' "compiles": {"walk": 99, "ghost": 1}}\n'
+    )
+    proc = _run_calibrate(str(rec))
+    assert proc.returncode == 1
+    assert "OVER" in proc.stdout
+    assert "UNBUDGETED" in proc.stdout
+
+
+def test_retrace_calibrate_missing_record(tmp_path):
+    proc = _run_calibrate(str(tmp_path / "nope.ndjson"))
+    assert proc.returncode == 2

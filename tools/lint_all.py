@@ -14,10 +14,17 @@ Runs, in order:
 2. jaxlint — ``python -m pumiumtally_tpu.analysis pumiumtally_tpu/
    bench.py ...`` (the JAX-aware static analyzer; trace safety JL00x,
    collective safety JL1xx, Pallas kernels JL2xx, host concurrency
-   JL3xx — docs/STATIC_ANALYSIS.md). Always available: pure stdlib.
+   JL3xx, trace-key cardinality JL4xx, determinism JL5xx —
+   docs/STATIC_ANALYSIS.md). Always available: pure stdlib.
 3. contract audit — ``python -m pumiumtally_tpu.analysis --contracts``
    (the five tally facades vs the shared hook surface; a missing hook
    fails, signature drift is reported but does not).
+4. trace-key audit — ``... --trace-keys`` (RETRACE_BUDGETS vs every
+   registered jit entry point; a dead budget or unbudgeted entry
+   point fails).
+5. wire audit — ``... --wire`` (every NDJSON encoder vs the
+   AST-extracted SocketFrontend op/reply schema; an unknown op,
+   missing field, or reply drift fails).
 
 This is the documented pre-PR check (README). Exit status is non-zero
 if ANY stage that ran found issues; a missing ruff does not mask a
@@ -113,8 +120,27 @@ def run_contracts() -> int:
     ).returncode
 
 
+def run_trace_keys() -> int:
+    print("lint_all: jaxlint --trace-keys (retrace-budget audit)")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "jaxlint.py"),
+         "--trace-keys"],
+        cwd=REPO,
+    ).returncode
+
+
+def run_wire() -> int:
+    print("lint_all: jaxlint --wire (wire-protocol audit)")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "jaxlint.py"),
+         "--wire"],
+        cwd=REPO,
+    ).returncode
+
+
 def main() -> int:
-    codes = [run_ruff(), run_jaxlint(), run_contracts()]
+    codes = [run_ruff(), run_jaxlint(), run_contracts(),
+             run_trace_keys(), run_wire()]
     ran = [c for c in codes if c is not None]
     if any(ran):
         print("lint_all: FAILED", file=sys.stderr)

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Diff a ``PUMIUMTALLY_RETRACE_RECORD`` run against RETRACE_BUDGETS.
+
+Recalibrating the retrace tripwire used to be a hand-edit: run the
+suite with ``PUMIUMTALLY_RETRACE_RECORD=/tmp/rt.ndjson``, eyeball the
+NDJSON, guess new numbers. This makes it one command::
+
+    PUMIUMTALLY_RETRACE_RECORD=/tmp/rt.ndjson \
+        JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+    python tools/retrace_calibrate.py /tmp/rt.ndjson
+
+The record is one JSON object per TEST (written by the tripwire in
+tests/conftest.py): ``{"test": nodeid, "total": n, "compiles":
+{entry: count}}``. For every entry point this prints the measured
+per-test maximum, the declared budget, and the headroom, and flags:
+
+* ``OVER``       — measured max exceeds the budget (the tripwire
+  would have failed; the budget needs raising or the retrace fixing);
+* ``UNBUDGETED`` — an entry point observed compiling that has no
+  budget (the static auditor ``--trace-keys`` reports the same thing
+  as JL403 without needing a run);
+* ``STALE``      — a budgeted entry the recorded run never compiled
+  (informational only: the record may cover a test subset, and
+  ``--trace-keys`` JL402 is the authority on truly dead keys).
+
+Exit 1 on OVER or UNBUDGETED, 0 otherwise. The special ``"total"``
+budget bounds each test's whole-block compile count and is compared
+against the per-test ``total`` field. Pure stdlib — runs without jax,
+same stub bootstrap as tools/jaxlint.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+if "pumiumtally_tpu" not in sys.modules:
+    _stub = types.ModuleType("pumiumtally_tpu")
+    _stub.__path__ = [os.path.join(_REPO, "pumiumtally_tpu")]
+    sys.modules["pumiumtally_tpu"] = _stub
+
+from pumiumtally_tpu.analysis.tracekeys import (  # noqa: E402
+    EXEMPT_BUDGET_KEYS,
+    read_budgets,
+)
+
+
+def load_record(path):
+    """(per-entry max compiles, per-test max total, tests read)."""
+    max_compiles = {}
+    max_total = 0
+    ntests = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            ntests += 1
+            max_total = max(max_total, int(row.get("total", 0)))
+            for entry, count in (row.get("compiles") or {}).items():
+                count = int(count)
+                if count > max_compiles.get(entry, 0):
+                    max_compiles[entry] = count
+    return max_compiles, max_total, ntests
+
+
+def calibrate(budgets, max_compiles, max_total):
+    """Rows {entry, budget, measured, headroom, status} sorted by
+    entry name, plus the worst status."""
+    rows = []
+    entries = sorted(set(budgets) | set(max_compiles))
+    for entry in entries:
+        budget = budgets.get(entry)
+        if entry in EXEMPT_BUDGET_KEYS:
+            measured = max_total
+        else:
+            measured = max_compiles.get(entry)
+        if budget is None:
+            status = "UNBUDGETED"
+        elif measured is None:
+            status = "STALE"
+        elif measured > budget:
+            status = "OVER"
+        else:
+            status = "OK"
+        rows.append({
+            "entry": entry,
+            "budget": budget,
+            "measured": measured,
+            "headroom": (
+                None if budget is None or measured is None
+                else budget - measured
+            ),
+            "status": status,
+        })
+    failing = any(
+        r["status"] in ("OVER", "UNBUDGETED") for r in rows
+    )
+    return rows, (1 if failing else 0)
+
+
+def render_text(rows, ntests):
+    grid = [["entry point", "budget", "measured", "headroom",
+             "status"]]
+    for r in rows:
+        grid.append([
+            r["entry"],
+            "—" if r["budget"] is None else str(r["budget"]),
+            "—" if r["measured"] is None else str(r["measured"]),
+            "—" if r["headroom"] is None else str(r["headroom"]),
+            r["status"],
+        ])
+    widths = [max(len(row[i]) for row in grid)
+              for i in range(len(grid[0]))]
+    lines = []
+    for i, row in enumerate(grid):
+        lines.append("  ".join(
+            c.ljust(w) for c, w in zip(row, widths)
+        ).rstrip())
+        if i == 0:
+            lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    lines.append("")
+    lines.append(f"record covers {ntests} test(s)")
+    n_over = len([r for r in rows if r["status"] == "OVER"])
+    n_unb = len([r for r in rows if r["status"] == "UNBUDGETED"])
+    if n_over or n_unb:
+        lines.append(
+            f"{n_over} over-budget, {n_unb} unbudgeted — edit "
+            "config.RETRACE_BUDGETS with a justifying comment"
+        )
+    else:
+        lines.append("every observed entry point within budget")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python tools/retrace_calibrate.py",
+        description="diff a PUMIUMTALLY_RETRACE_RECORD NDJSON run "
+        "against config.RETRACE_BUDGETS (exit 1 on over-budget or "
+        "unbudgeted entries)",
+    )
+    ap.add_argument(
+        "record",
+        help="NDJSON file written by PUMIUMTALLY_RETRACE_RECORD",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.record):
+        print(
+            f"retrace_calibrate: no such record: {args.record}",
+            file=sys.stderr,
+        )
+        return 2
+    budgets = read_budgets()
+    if not budgets:
+        print(
+            "retrace_calibrate: could not read RETRACE_BUDGETS from "
+            "pumiumtally_tpu/config.py",
+            file=sys.stderr,
+        )
+        return 2
+    max_compiles, max_total, ntests = load_record(args.record)
+    rows, code = calibrate(budgets, max_compiles, max_total)
+    if args.format == "json":
+        print(json.dumps(
+            {"tests": ntests, "rows": rows}, indent=2,
+            sort_keys=True,
+        ))
+    else:
+        print(render_text(rows, ntests))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
